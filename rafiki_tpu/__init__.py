@@ -1,0 +1,24 @@
+"""rafiki-tpu: a TPU-native AutoML / ML-as-a-service platform.
+
+A ground-up rebuild of the capabilities of the reference platform
+(pinpom/rafiki — an Admin-orchestrated multi-tenant AutoML system with an
+Advisor proposing hyperparameter trials, TrainWorkers executing them, and a
+Predictor serving the learned ensemble), re-designed for TPU hardware:
+
+- Trials execute under ``jax.jit`` with explicit ``NamedSharding`` over a
+  ``Mesh`` built from a *chip group* — a contiguous range of TPU chips the
+  Admin scheduler allocates per service (the ``CUDA_VISIBLE_DEVICES``
+  replacement; see ``rafiki_tpu.parallel.chips``).
+- The Model SDK (``rafiki_tpu.model``) keeps the reference's BaseModel
+  contract (knob config, train/evaluate/predict/dump/load) and adds a
+  first-class JAX path (``JaxModel``): flax modules, optax optimizers,
+  bfloat16 MXU-friendly compute, AOT-compiled bucketed inference.
+- Serving (``rafiki_tpu.predictor``) ensembles top-k trials on-device,
+  with a ``vmap``-over-parameters fast path for same-architecture members.
+
+Reference parity map lives in SURVEY.md at the repo root; the reference
+checkout was empty at build time, so docstrings cite SURVEY.md sections
+(themselves reconstructions of the upstream layout) instead of file:line.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,111 @@
+"""Platform-wide enums and constants.
+
+Parity: SURVEY.md §2 "Constants" (upstream ``rafiki/constants.py``): service
+types, user types, budget keys, job/trial statuses, task types. The one
+deliberate change is hardware vocabulary: the GPU budget key becomes
+``CHIP_COUNT`` (TPU chips), with ``GPU_COUNT`` kept as an accepted alias so
+reference client scripts run unchanged.
+"""
+
+
+class ServiceType:
+    TRAIN = "TRAIN"
+    INFERENCE = "INFERENCE"
+    PREDICT = "PREDICT"
+    ADVISOR = "ADVISOR"
+
+
+class UserType:
+    SUPERADMIN = "SUPERADMIN"
+    ADMIN = "ADMIN"
+    MODEL_DEVELOPER = "MODEL_DEVELOPER"
+    APP_DEVELOPER = "APP_DEVELOPER"
+
+
+class BudgetOption:
+    MODEL_TRIAL_COUNT = "MODEL_TRIAL_COUNT"
+    TIME_HOURS = "TIME_HOURS"
+    CHIP_COUNT = "CHIP_COUNT"
+    # Accepted alias for reference-script compatibility; normalised to
+    # CHIP_COUNT at the Admin boundary.
+    GPU_COUNT = "GPU_COUNT"
+
+
+DEFAULT_BUDGET = {
+    BudgetOption.MODEL_TRIAL_COUNT: 5,
+    BudgetOption.TIME_HOURS: 1.0,
+    BudgetOption.CHIP_COUNT: 0,
+}
+
+
+class TrainJobStatus:
+    STARTED = "STARTED"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    ERRORED = "ERRORED"
+
+
+class TrialStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    ERRORED = "ERRORED"
+    TERMINATED = "TERMINATED"
+
+
+class InferenceJobStatus:
+    STARTED = "STARTED"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    ERRORED = "ERRORED"
+
+
+class ServiceStatus:
+    STARTED = "STARTED"
+    DEPLOYING = "DEPLOYING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    ERRORED = "ERRORED"
+
+
+class TaskType:
+    IMAGE_CLASSIFICATION = "IMAGE_CLASSIFICATION"
+    POS_TAGGING = "POS_TAGGING"
+    TABULAR_CLASSIFICATION = "TABULAR_CLASSIFICATION"
+    TABULAR_REGRESSION = "TABULAR_REGRESSION"
+
+
+class ModelAccessRight:
+    PUBLIC = "PUBLIC"
+    PRIVATE = "PRIVATE"
+
+
+class ParamsType:
+    """Which shared parameters a trial proposal asks to warm-start from.
+
+    Parity: SURVEY.md §2 "Param store" sharing policies (recent/best
+    params; used heavily by ENAS weight sharing).
+    """
+
+    NONE = "NONE"
+    LOCAL_RECENT = "LOCAL_RECENT"
+    LOCAL_BEST = "LOCAL_BEST"
+    GLOBAL_RECENT = "GLOBAL_RECENT"
+    GLOBAL_BEST = "GLOBAL_BEST"
+
+
+# Environment variable names injected into worker services by the
+# ServicesManager (SURVEY.md §3.1). RAFIKI_TPU_CHIPS is the
+# CUDA_VISIBLE_DEVICES replacement: a comma-separated list of chip indices
+# forming this service's chip group.
+class EnvVars:
+    SERVICE_ID = "RAFIKI_TPU_SERVICE_ID"
+    SERVICE_TYPE = "RAFIKI_TPU_SERVICE_TYPE"
+    SUB_TRAIN_JOB_ID = "RAFIKI_TPU_SUB_TRAIN_JOB_ID"
+    INFERENCE_JOB_ID = "RAFIKI_TPU_INFERENCE_JOB_ID"
+    TRIAL_ID = "RAFIKI_TPU_TRIAL_ID"
+    CHIPS = "RAFIKI_TPU_CHIPS"
+    WORKDIR = "RAFIKI_TPU_WORKDIR"
+    META_URI = "RAFIKI_TPU_META_URI"
+    BUS_URI = "RAFIKI_TPU_BUS_URI"
+    PARAMS_DIR = "RAFIKI_TPU_PARAMS_DIR"

@@ -1,0 +1,5 @@
+"""Dataset preparation (SURVEY.md §2 "Dataset prep scripts")."""
+
+from .synth import make_synthetic_image_dataset
+
+__all__ = ["make_synthetic_image_dataset"]

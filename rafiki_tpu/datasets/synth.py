@@ -1,0 +1,48 @@
+"""Synthetic learnable datasets for tests and benchmarks.
+
+The build environment has no network, so the fashion-MNIST / CIFAR-10 prep
+scripts (``rafiki_tpu/datasets/prep.py``) cannot download; tests and
+benchmarks instead use synthetic datasets with the same shapes and a
+learnable class signal (per-class template + noise), so training curves are
+meaningful (a working model separates the classes; a broken one stays at
+chance).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from ..model.dataset import write_image_dataset_npz
+
+
+def make_synthetic_image_dataset(
+        out_dir: str,
+        n_train: int = 512,
+        n_val: int = 128,
+        image_shape: Tuple[int, int, int] = (28, 28, 1),
+        n_classes: int = 10,
+        noise: float = 0.25,
+        seed: int = 0,
+        name: str = "synth") -> Tuple[str, str]:
+    """Write train/val .npz datasets; returns their paths."""
+    rng = np.random.default_rng(seed)
+    templates = rng.uniform(0, 1, size=(n_classes, *image_shape))
+
+    def make(n, seed2):
+        r = np.random.default_rng(seed2)
+        labels = r.integers(0, n_classes, size=n)
+        imgs = templates[labels] + r.normal(0, noise, size=(n, *image_shape))
+        imgs = np.clip(imgs, 0, 1)
+        return (imgs * 255).astype(np.uint8), labels
+
+    os.makedirs(out_dir, exist_ok=True)
+    tr_imgs, tr_labels = make(n_train, seed + 1)
+    va_imgs, va_labels = make(n_val, seed + 2)
+    train_path = write_image_dataset_npz(
+        tr_imgs, tr_labels, os.path.join(out_dir, f"{name}_train.npz"), n_classes)
+    val_path = write_image_dataset_npz(
+        va_imgs, va_labels, os.path.join(out_dir, f"{name}_val.npz"), n_classes)
+    return train_path, val_path

@@ -1,0 +1,32 @@
+"""Model SDK: the contract between model developers and the platform.
+
+See SURVEY.md §2 (Model SDK rows) for the reference parity map.
+"""
+
+from .base import BaseModel, Params, params_size_bytes
+from .dataset import (CorpusDataset, ImageDataset, load_corpus_dataset,
+                      load_dataset_of_corpus, load_dataset_of_image_files,
+                      load_image_dataset, write_corpus_dataset,
+                      write_image_dataset_npz, write_image_files_dataset)
+from .dev import test_model_class
+from .knobs import (ArchKnob, BaseKnob, CategoricalKnob, FixedKnob, FloatKnob,
+                    IntegerKnob, KnobConfig, Knobs, PolicyKnob,
+                    knob_config_from_json, knob_config_to_json,
+                    knobs_to_vector, sample_knobs, searchable_dims,
+                    validate_knobs, vector_to_knobs)
+from .logger import logger
+
+__all__ = [
+    "BaseModel", "Params", "params_size_bytes",
+    "ImageDataset", "CorpusDataset",
+    "load_image_dataset", "load_dataset_of_image_files",
+    "load_corpus_dataset", "load_dataset_of_corpus",
+    "write_image_dataset_npz", "write_image_files_dataset",
+    "write_corpus_dataset",
+    "test_model_class",
+    "BaseKnob", "CategoricalKnob", "FixedKnob", "FloatKnob", "IntegerKnob",
+    "ArchKnob", "PolicyKnob", "KnobConfig", "Knobs",
+    "knob_config_to_json", "knob_config_from_json", "sample_knobs",
+    "validate_knobs", "knobs_to_vector", "vector_to_knobs", "searchable_dims",
+    "logger",
+]

@@ -1,0 +1,210 @@
+"""Dataset format + loaders for the Model SDK.
+
+Parity: SURVEY.md §2 "Model SDK — dataset utils" (upstream
+``rafiki/model/dataset.py``): the platform dataset format is a single file a
+model's ``train()/evaluate()`` receives by path. Two interchangeable
+encodings are supported:
+
+- ``*.zip`` **image-files dataset** (reference-compatible shape): an
+  ``images.csv`` index with header ``path,class`` plus the image files
+  (PNG) inside the archive.
+- ``*.npz`` **packed dataset** (TPU-native addition): ``images`` as
+  ``(N, H, W, C) uint8``, ``labels`` as ``(N,) int64``, ``n_classes``.
+  One mmap-able file, no per-image decode on the hot path — keeps the
+  input pipeline from starving the MXU.
+
+Corpus datasets (POS tagging): a zip containing ``corpus.tsv`` with one
+``token<TAB>tag`` pair per line and blank lines separating sentences.
+
+All loaders return plain numpy; device placement/sharding is the training
+loop's job (``rafiki_tpu.model.jax_model``).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+import zipfile
+from dataclasses import dataclass
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+
+@dataclass
+class ImageDataset:
+    """An in-memory image-classification dataset."""
+
+    images: np.ndarray  # (N, H, W, C) uint8
+    labels: np.ndarray  # (N,) int64
+    n_classes: int
+
+    @property
+    def size(self) -> int:
+        return int(self.images.shape[0])
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        return tuple(self.images.shape[1:])  # type: ignore[return-value]
+
+    def normalized(self, dtype=np.float32) -> np.ndarray:
+        """Images scaled to [0, 1]."""
+        return self.images.astype(dtype) / 255.0
+
+    def batches(self, batch_size: int, *, shuffle: bool = False,
+                seed: int = 0, drop_remainder: bool = False,
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        idx = np.arange(self.size)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        stop = (self.size // batch_size) * batch_size if drop_remainder else self.size
+        for start in range(0, stop, batch_size):
+            sel = idx[start:start + batch_size]
+            yield self.images[sel], self.labels[sel]
+
+
+@dataclass
+class CorpusDataset:
+    """A token-tagged corpus (e.g. POS tagging)."""
+
+    sentences: List[List[str]]
+    tags: List[List[int]]
+    tag_names: List[str]
+
+    @property
+    def size(self) -> int:
+        return len(self.sentences)
+
+
+# --- Loaders ---
+
+def load_image_dataset(dataset_path: str) -> ImageDataset:
+    """Load an image-classification dataset (.npz packed or .zip of files)."""
+    if not os.path.exists(dataset_path):
+        raise FileNotFoundError(dataset_path)
+    if dataset_path.endswith(".npz"):
+        return _load_image_npz(dataset_path)
+    if zipfile.is_zipfile(dataset_path):
+        return _load_image_zip(dataset_path)
+    raise ValueError(f"Unrecognised dataset format: {dataset_path}")
+
+
+# Reference-compatible alias (upstream: dataset_utils.load_dataset_of_image_files)
+load_dataset_of_image_files = load_image_dataset
+
+
+def _load_image_npz(path: str) -> ImageDataset:
+    with np.load(path) as z:
+        images = np.asarray(z["images"], dtype=np.uint8)
+        labels = np.asarray(z["labels"], dtype=np.int64)
+        n_classes = int(z["n_classes"]) if "n_classes" in z else int(labels.max()) + 1
+    if images.ndim == 3:  # grayscale without channel dim
+        images = images[..., None]
+    return ImageDataset(images=images, labels=labels, n_classes=n_classes)
+
+
+def _load_image_zip(path: str) -> ImageDataset:
+    from PIL import Image
+
+    with zipfile.ZipFile(path) as zf:
+        with zf.open("images.csv") as f:
+            rows = list(csv.DictReader(io.TextIOWrapper(f, "utf-8")))
+        imgs, labels = [], []
+        for row in rows:
+            with zf.open(row["path"]) as imf:
+                arr = np.asarray(Image.open(imf))
+            if arr.ndim == 2:
+                arr = arr[..., None]
+            imgs.append(arr.astype(np.uint8))
+            labels.append(int(row["class"]))
+    images = np.stack(imgs)
+    labels_arr = np.asarray(labels, dtype=np.int64)
+    return ImageDataset(images=images, labels=labels_arr,
+                        n_classes=int(labels_arr.max()) + 1)
+
+
+def load_corpus_dataset(dataset_path: str) -> CorpusDataset:
+    """Load a token-tagged corpus dataset (zip with corpus.tsv + tags.txt)."""
+    with zipfile.ZipFile(dataset_path) as zf:
+        tag_names = zf.read("tags.txt").decode("utf-8").split("\n")
+        tag_names = [t for t in tag_names if t]
+        tag_to_id = {t: i for i, t in enumerate(tag_names)}
+        sentences: List[List[str]] = []
+        tags: List[List[int]] = []
+        cur_toks: List[str] = []
+        cur_tags: List[int] = []
+        for line in zf.read("corpus.tsv").decode("utf-8").split("\n"):
+            line = line.rstrip("\r")
+            if not line:
+                if cur_toks:
+                    sentences.append(cur_toks)
+                    tags.append(cur_tags)
+                    cur_toks, cur_tags = [], []
+                continue
+            tok, tag = line.split("\t")
+            cur_toks.append(tok)
+            cur_tags.append(tag_to_id[tag])
+        if cur_toks:
+            sentences.append(cur_toks)
+            tags.append(cur_tags)
+    return CorpusDataset(sentences=sentences, tags=tags, tag_names=tag_names)
+
+
+load_dataset_of_corpus = load_corpus_dataset
+
+
+# --- Writers (dataset preparation; SURVEY.md §2 "Dataset prep scripts") ---
+
+def write_image_dataset_npz(images: np.ndarray, labels: np.ndarray,
+                            out_path: str, n_classes: int | None = None) -> str:
+    images = np.asarray(images, dtype=np.uint8)
+    if images.ndim == 3:
+        images = images[..., None]
+    labels = np.asarray(labels, dtype=np.int64)
+    if n_classes is None:
+        n_classes = int(labels.max()) + 1
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    np.savez_compressed(out_path if out_path.endswith(".npz") else out_path + ".npz",
+                        images=images, labels=labels, n_classes=n_classes)
+    return out_path if out_path.endswith(".npz") else out_path + ".npz"
+
+
+def write_image_files_dataset(images: np.ndarray, labels: np.ndarray,
+                              out_path: str) -> str:
+    """Write the reference-compatible zip-of-PNGs encoding."""
+    from PIL import Image
+
+    images = np.asarray(images, dtype=np.uint8)
+    if images.ndim == 3:
+        images = images[..., None]
+    labels = np.asarray(labels, dtype=np.int64)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with zipfile.ZipFile(out_path, "w", zipfile.ZIP_DEFLATED) as zf:
+        index = io.StringIO()
+        w = csv.writer(index)
+        w.writerow(["path", "class"])
+        for i, (img, lab) in enumerate(zip(images, labels)):
+            name = f"images/{i}.png"
+            buf = io.BytesIO()
+            arr = img[..., 0] if img.shape[-1] == 1 else img
+            Image.fromarray(arr).save(buf, format="PNG")
+            zf.writestr(name, buf.getvalue())
+            w.writerow([name, int(lab)])
+        zf.writestr("images.csv", index.getvalue())
+    return out_path
+
+
+def write_corpus_dataset(sentences: List[List[str]], tags: List[List[str]],
+                         out_path: str) -> str:
+    tag_names = sorted({t for sent in tags for t in sent})
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with zipfile.ZipFile(out_path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("tags.txt", "\n".join(tag_names) + "\n")
+        lines: List[str] = []
+        for sent, stags in zip(sentences, tags):
+            for tok, tag in zip(sent, stags):
+                lines.append(f"{tok}\t{tag}")
+            lines.append("")
+        zf.writestr("corpus.tsv", "\n".join(lines) + "\n")
+    return out_path

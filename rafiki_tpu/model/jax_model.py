@@ -1,0 +1,367 @@
+"""JaxModel: the JAX/flax implementation path of the BaseModel contract.
+
+Parity + redesign: the reference's model zoo implements ``BaseModel``
+directly against TF1/Torch with hand-rolled session/device management
+(SURVEY.md §2 "Example models"). Here the SDK itself provides the
+TPU-native scaffolding once, and zoo models only declare a flax module plus
+knobs:
+
+- ``train()`` runs a jit-compiled train step over a ``("dp", "tp")`` Mesh
+  built from the service's chip group (``RAFIKI_TPU_CHIPS``), batch
+  data-parallel with gradients psum-ed over ICI by XLA; donated state, so
+  optimizer updates are in-place in HBM.
+- Compute is bfloat16-friendly (modules take a ``dtype``; inputs stay f32
+  and cast at the first matmul/conv) to keep the MXU fed.
+- ``predict()`` AOT-compiles per batch-bucket (powers of two up to
+  ``max_predict_batch``) and pads queries into the nearest bucket —
+  variable serving load never retraces (SURVEY.md §7 "AOT-compiled
+  serving").
+- Parameters interchange as a flat ``{path: ndarray}`` dict
+  (``flax.traverse_util.flatten_dict``), the ParamStore's native format.
+
+Knob conventions the scaffolding understands (all optional):
+``batch_size``, ``learning_rate``, ``max_epochs``, ``weight_decay``,
+``early_stop_epochs``, ``quick_train`` (policy).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import traverse_util
+from flax.training import train_state
+
+from ..parallel import batch_sharding, build_mesh, shard_variables
+from ..parallel.chips import ChipGroup
+from .base import BaseModel, Params
+from .dataset import ImageDataset, load_image_dataset
+from .logger import logger
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = None
+
+
+class JaxModel(BaseModel):
+    """Base for flax-module-backed image classifiers.
+
+    Subclasses implement ``create_module(n_classes, image_shape)`` and may
+    override ``create_optimizer`` / ``augment_batch``.
+    """
+
+    max_predict_batch: int = 512
+
+    def __init__(self, **knobs: Any):
+        super().__init__(**knobs)
+        self._variables: Optional[Dict[str, Any]] = None
+        self._module = None
+        self._meta: Dict[str, Any] = {}
+        self._mesh = None
+        self._predict_cache: Dict[int, Any] = {}
+        self._eval_step = None
+
+    # --- Subclass API ---
+
+    def create_module(self, n_classes: int, image_shape) -> Any:
+        raise NotImplementedError
+
+    def create_optimizer(self, steps_per_epoch: int,
+                         max_epochs: int) -> optax.GradientTransformation:
+        lr = float(self.knobs.get("learning_rate", 1e-3))
+        total = max(1, steps_per_epoch * max_epochs)
+        sched = optax.cosine_decay_schedule(lr, decay_steps=total, alpha=0.01)
+        wd = float(self.knobs.get("weight_decay", 0.0))
+        if wd > 0:
+            return optax.adamw(sched, weight_decay=wd)
+        return optax.adam(sched)
+
+    def augment_batch(self, images: np.ndarray,
+                      rng: np.random.Generator) -> np.ndarray:
+        """Host-side augmentation hook; default identity."""
+        return images
+
+    # --- Mesh / module plumbing ---
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            group = ChipGroup.from_env()
+            tp = int(self.knobs.get("tensor_parallel", 1))
+            self._mesh = build_mesh(group.devices(), tp=tp)
+        return self._mesh
+
+    def _ensure_module(self, n_classes: int, image_shape) -> None:
+        if self._module is None:
+            self._module = self.create_module(n_classes, image_shape)
+            self._meta.update(n_classes=int(n_classes),
+                              image_shape=list(image_shape))
+
+    # --- BaseModel: train ---
+
+    def train(self, dataset_path: str, *,
+              shared_params: Optional[Params] = None, **kwargs: Any) -> None:
+        ds = load_image_dataset(dataset_path)
+        self._ensure_module(ds.n_classes, ds.image_shape)
+        mesh = self.mesh
+        dp = mesh.shape["dp"]
+
+        batch_size = int(self.knobs.get("batch_size", 128))
+        # Never larger than the dataset, and divisible over dp shards.
+        batch_size = min(batch_size, ds.size)
+        batch_size = max(dp, (batch_size // dp) * dp)
+        max_epochs = int(self.knobs.get("max_epochs", 5))
+        if self.knobs.get("quick_train", False):
+            max_epochs = min(max_epochs, 1)
+        steps_per_epoch = max(1, ds.size // batch_size)
+
+        tx = self.create_optimizer(steps_per_epoch, max_epochs)
+
+        init_rng = jax.random.key(int(self.knobs.get("seed", 0)))
+        dummy = jnp.zeros((1, *ds.image_shape), jnp.float32)
+        variables = self._module.init(init_rng, dummy, train=False)
+        if shared_params is not None:
+            variables = self._merge_shared(variables, shared_params)
+
+        variables = shard_variables(variables, mesh)
+        state = TrainState.create(
+            apply_fn=self._module.apply,
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats"),
+            tx=tx,
+        )
+
+        has_bs = state.batch_stats is not None
+        module = self._module
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step(state: TrainState, x, y, step_rng):
+            def loss_fn(params):
+                vs = {"params": params}
+                if has_bs:
+                    vs["batch_stats"] = state.batch_stats
+                    logits, upd = module.apply(
+                        vs, x, train=True, mutable=["batch_stats"],
+                        rngs={"dropout": step_rng})
+                    new_bs = upd["batch_stats"]
+                else:
+                    logits = module.apply(vs, x, train=True,
+                                          rngs={"dropout": step_rng})
+                    new_bs = None
+                logits = logits.astype(jnp.float32)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+                acc = (logits.argmax(-1) == y).mean()
+                return loss, (new_bs, acc)
+
+            (loss, (new_bs, acc)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            state = state.apply_gradients(grads=grads)
+            if has_bs:
+                state = state.replace(batch_stats=new_bs)
+            return state, loss, acc
+
+        logger.define_plot("Training", ["loss", "train_acc"], x_axis="epoch")
+        x_shard = batch_sharding(mesh)
+        rng = np.random.default_rng(int(self.knobs.get("seed", 0)))
+        imgs_f = ds.normalized()
+        key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
+
+        early_stop = int(self.knobs.get("early_stop_epochs", 0))
+        best_loss, bad_epochs = float("inf"), 0
+        t0 = time.time()
+        step = 0
+        for epoch in range(max_epochs):
+            order = rng.permutation(ds.size)
+            ep_loss, ep_acc, nb = 0.0, 0.0, 0
+            for s in range(steps_per_epoch):
+                sel = order[s * batch_size:(s + 1) * batch_size]
+                if len(sel) < batch_size:
+                    break
+                xb = self.augment_batch(imgs_f[sel], rng)
+                yb = ds.labels[sel]
+                xb = jax.device_put(xb, x_shard)
+                yb = jax.device_put(yb, x_shard)
+                key, sub = jax.random.split(key)
+                state, loss, acc = train_step(state, xb, yb, sub)
+                step += 1
+                if s == steps_per_epoch - 1 or s % 50 == 49:
+                    ep_loss += float(loss)
+                    ep_acc += float(acc)
+                    nb += 1
+            ep_loss /= max(nb, 1)
+            ep_acc /= max(nb, 1)
+            logger.log(epoch=epoch, loss=ep_loss, train_acc=ep_acc,
+                       steps_per_sec=step / (time.time() - t0))
+            if early_stop:
+                if ep_loss < best_loss - 1e-4:
+                    best_loss, bad_epochs = ep_loss, 0
+                else:
+                    bad_epochs += 1
+                    if bad_epochs >= early_stop:
+                        break
+
+        variables = {"params": jax.device_get(state.params)}
+        if has_bs:
+            variables["batch_stats"] = jax.device_get(state.batch_stats)
+        self._variables = variables
+        self._invalidate_compiled()
+
+    def _merge_shared(self, variables, shared_params: Params):
+        """Warm-start: overlay shared params whose path+shape match."""
+        flat = traverse_util.flatten_dict(variables, sep="/")
+        n = 0
+        for k, v in shared_params.items():
+            if k.startswith("_"):
+                continue
+            if k in flat and tuple(flat[k].shape) == tuple(v.shape):
+                flat[k] = jnp.asarray(v, dtype=flat[k].dtype)
+                n += 1
+        logger.log(msg=f"warm-started {n} shared tensors")
+        return traverse_util.unflatten_dict(flat, sep="/")
+
+    # --- BaseModel: evaluate ---
+
+    def evaluate(self, dataset_path: str) -> float:
+        assert self._variables is not None, "train() or load_parameters() first"
+        ds = load_image_dataset(dataset_path)
+        self._ensure_module(ds.n_classes, ds.image_shape)
+        mesh = self.mesh
+        variables = shard_variables(self._variables, mesh)
+        module = self._module
+
+        if self._eval_step is None:
+            @jax.jit
+            def eval_step(variables, x, y, w):
+                logits = module.apply(variables, x, train=False)
+                correct = (logits.argmax(-1) == y).astype(jnp.float32) * w
+                return correct.sum()
+
+            self._eval_step = eval_step
+
+        dp = mesh.shape["dp"]
+        bs = max(dp, (min(1024, ds.size) // dp) * dp)
+        x_shard = batch_sharding(mesh)
+        imgs = ds.normalized()
+        correct = 0.0
+        for start in range(0, ds.size, bs):
+            xb = imgs[start:start + bs]
+            yb = ds.labels[start:start + bs]
+            n = xb.shape[0]
+            if n < bs:  # pad final batch; weight mask zeroes the padding
+                pad = bs - n
+                xb = np.concatenate([xb, np.zeros((pad, *xb.shape[1:]), xb.dtype)])
+                yb = np.concatenate([yb, np.zeros((pad,), yb.dtype)])
+            w = np.zeros((bs,), np.float32)
+            w[:n] = 1.0
+            correct += float(self._eval_step(
+                variables,
+                jax.device_put(xb, x_shard),
+                jax.device_put(yb, x_shard),
+                jax.device_put(w, x_shard)))
+        return float(correct / ds.size)
+
+    # --- BaseModel: predict ---
+
+    def predict(self, queries: List[Any]) -> List[Any]:
+        assert self._variables is not None, "train() or load_parameters() first"
+        assert self._meta.get("n_classes"), "model has no trained metadata"
+        if not queries:
+            return []
+        imgs = np.stack([self._query_to_image(q) for q in queries])
+        probs = self.predict_proba(imgs)
+        return [p.tolist() for p in probs]
+
+    def predict_proba(self, images: np.ndarray) -> np.ndarray:
+        """Batched probability prediction with bucketed AOT compilation."""
+        n = images.shape[0]
+        if n == 0:
+            return np.zeros((0, self._meta["n_classes"]), np.float32)
+        out = []
+        for start in range(0, n, self.max_predict_batch):
+            chunk = images[start:start + self.max_predict_batch]
+            out.append(self._predict_bucket(chunk))
+        return np.concatenate(out, axis=0)
+
+    def _predict_bucket(self, chunk: np.ndarray) -> np.ndarray:
+        n = chunk.shape[0]
+        mesh = self.mesh
+        dp = mesh.shape["dp"]
+        bucket = dp
+        while bucket < n:
+            bucket *= 2
+        fn = self._predict_cache.get(bucket)
+        if fn is None:
+            module = self._module
+            variables = shard_variables(self._variables, mesh)
+
+            @jax.jit
+            def predict_fn(variables, x):
+                logits = module.apply(variables, x, train=False)
+                return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+            # AOT-compile for this bucket shape so serving never retraces.
+            x_shape = jax.ShapeDtypeStruct(
+                (bucket, *chunk.shape[1:]), jnp.float32,
+                sharding=batch_sharding(mesh))
+            v_shapes = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+                variables)
+            compiled = predict_fn.lower(v_shapes, x_shape).compile()
+            fn = (compiled, variables)
+            self._predict_cache[bucket] = fn
+        compiled, variables = fn
+        if n < bucket:
+            chunk = np.concatenate(
+                [chunk, np.zeros((bucket - n, *chunk.shape[1:]), chunk.dtype)])
+        x = jax.device_put(chunk.astype(np.float32), batch_sharding(mesh))
+        probs = np.asarray(compiled(variables, x))
+        return probs[:n]
+
+    def _query_to_image(self, q: Any) -> np.ndarray:
+        arr = np.asarray(q)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        expected = tuple(self._meta["image_shape"])
+        if tuple(arr.shape) != expected:
+            raise ValueError(f"query shape {arr.shape} != {expected}")
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        return arr.astype(np.float32)
+
+    # --- BaseModel: parameters ---
+
+    def dump_parameters(self) -> Params:
+        assert self._variables is not None
+        flat = traverse_util.flatten_dict(self._variables, sep="/")
+        out: Params = {k: np.asarray(v) for k, v in flat.items()}
+        out["_meta/n_classes"] = np.asarray(self._meta["n_classes"])
+        out["_meta/image_shape"] = np.asarray(self._meta["image_shape"])
+        return out
+
+    def load_parameters(self, params: Params) -> None:
+        meta_n = params.get("_meta/n_classes")
+        meta_shape = params.get("_meta/image_shape")
+        assert meta_n is not None and meta_shape is not None, \
+            "params missing _meta entries"
+        self._meta = {"n_classes": int(meta_n),
+                      "image_shape": [int(x) for x in np.asarray(meta_shape)]}
+        flat = {k: np.asarray(v) for k, v in params.items()
+                if not k.startswith("_meta/")}
+        self._variables = traverse_util.unflatten_dict(flat, sep="/")
+        self._ensure_module(self._meta["n_classes"], self._meta["image_shape"])
+        self._invalidate_compiled()
+
+    def _invalidate_compiled(self) -> None:
+        self._predict_cache.clear()
+        self._eval_step = None
+
+    def destroy(self) -> None:
+        self._invalidate_compiled()
+        self._variables = None
+        self._module = None

@@ -1,0 +1,5 @@
+"""Bundled model zoo (SURVEY.md §2 "Example models")."""
+
+from .feedforward import JaxFeedForward
+
+__all__ = ["JaxFeedForward"]

@@ -1,0 +1,52 @@
+"""JaxFeedForward: parity model for the reference's ``TfFeedForward``.
+
+Parity: SURVEY.md §2 "Example models" — a small dense network for
+fashion-MNIST-scale image classification, the platform's "CPU-runnable PR1
+reference" config (BASELINE.json configs[0]). Knob space mirrors the
+reference's (hidden layer count/size, learning rate, batch size, epochs),
+expressed with the SDK's typed knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
+from ..model.jax_model import JaxModel
+
+
+class _FeedForward(nn.Module):
+    hidden_layer_count: int
+    hidden_layer_units: int
+    n_classes: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for _ in range(self.hidden_layer_count):
+            x = nn.Dense(self.hidden_layer_units, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.n_classes, dtype=self.dtype)(x)
+
+
+class JaxFeedForward(JaxModel):
+    @staticmethod
+    def get_knob_config():
+        return {
+            "hidden_layer_count": IntegerKnob(1, 3),
+            "hidden_layer_units": IntegerKnob(16, 128),
+            "learning_rate": FloatKnob(1e-4, 1e-2, is_exp=True),
+            "batch_size": CategoricalKnob([32, 64, 128]),
+            "max_epochs": FixedKnob(5),
+        }
+
+    def create_module(self, n_classes: int, image_shape: Sequence[int]):
+        return _FeedForward(
+            hidden_layer_count=int(self.knobs["hidden_layer_count"]),
+            hidden_layer_units=int(self.knobs["hidden_layer_units"]),
+            n_classes=n_classes,
+        )

@@ -1,0 +1,125 @@
+"""Chip groups: the TPU replacement for per-service GPU assignment.
+
+Parity: SURVEY.md §2 "ServicesManager / GPU scheduler" + §7 hard-part
+"chip-range multi-tenancy". The reference Admin assigns device indices to
+worker containers via ``CUDA_VISIBLE_DEVICES``; here the scheduler assigns a
+**chip range** — a contiguous slice of ``jax.devices()`` — communicated to
+the worker process via the ``RAFIKI_TPU_CHIPS`` env var (comma-separated
+global device indices). The worker builds its ``jax.sharding.Mesh`` from
+exactly those devices, so every trial's collectives ride ICI within its own
+group and groups never contend.
+
+Two placement regimes (SURVEY.md §7):
+
+- **resident runner** (default here): one process owns all chips of the host
+  and schedules trials onto ``Mesh`` subsets — no process isolation needed,
+  works on any slice topology.
+- **process-per-group**: workers are separate processes; each sees the full
+  device list but only *uses* its assigned range. (True device isolation à
+  la ``TPU_VISIBLE_CHIPS`` is runtime-dependent; the allocator's contract is
+  identical either way.)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..constants import EnvVars
+
+
+@dataclass(frozen=True)
+class ChipGroup:
+    """An ordered set of global device indices assigned to one service."""
+
+    indices: tuple  # tuple[int, ...] into jax.devices()
+    name: str = ""
+
+    @property
+    def n_chips(self) -> int:
+        return len(self.indices)
+
+    def devices(self) -> List:
+        import jax
+
+        all_devs = jax.devices()
+        return [all_devs[i] for i in self.indices]
+
+    def to_env(self) -> str:
+        return ",".join(str(i) for i in self.indices)
+
+    @staticmethod
+    def from_env(value: Optional[str] = None) -> "ChipGroup":
+        """Build the group from ``RAFIKI_TPU_CHIPS`` (or all devices)."""
+        import jax
+
+        if value is None:
+            value = os.environ.get(EnvVars.CHIPS, "")
+        if value:
+            idx = tuple(int(x) for x in value.split(",") if x != "")
+        else:
+            idx = tuple(range(len(jax.devices())))
+        return ChipGroup(indices=idx)
+
+
+class ChipAllocator:
+    """Carves a device list into non-overlapping chip groups.
+
+    The Admin-side resource manager: thread-safe, contiguous-first-fit so
+    groups stay physically adjacent (contiguous ranges on a v5e slice keep
+    intra-group ICI hops minimal). ``allocate`` returns None when the
+    request cannot be satisfied — callers queue and retry (scheduler
+    fairness is handled one level up, in the ServicesManager).
+    """
+
+    def __init__(self, n_chips: Optional[int] = None):
+        if n_chips is None:
+            import jax
+
+            n_chips = len(jax.devices())
+        self.n_chips = n_chips
+        self._lock = threading.Lock()
+        self._owner: List[Optional[str]] = [None] * n_chips
+        self._groups: Dict[str, ChipGroup] = {}
+
+    def allocate(self, n: int, name: str) -> Optional[ChipGroup]:
+        """First-fit allocation of ``n`` contiguous chips; None if full."""
+        if n <= 0:
+            raise ValueError("n must be positive")
+        with self._lock:
+            if name in self._groups:
+                raise ValueError(
+                    f"group {name!r} already holds chips; release it first")
+            run_start, run_len = None, 0
+            for i in range(self.n_chips):
+                if self._owner[i] is None:
+                    run_start = i if run_len == 0 else run_start
+                    run_len += 1
+                    if run_len == n:
+                        idx = tuple(range(run_start, run_start + n))
+                        for j in idx:
+                            self._owner[j] = name
+                        group = ChipGroup(indices=idx, name=name)
+                        self._groups[name] = group
+                        return group
+                else:
+                    run_len = 0
+            return None
+
+    def release(self, name: str) -> None:
+        with self._lock:
+            group = self._groups.pop(name, None)
+            if group:
+                for i in group.indices:
+                    if self._owner[i] == name:
+                        self._owner[i] = None
+
+    @property
+    def free_chips(self) -> int:
+        with self._lock:
+            return sum(1 for o in self._owner if o is None)
+
+    def utilization(self) -> float:
+        return 1.0 - self.free_chips / self.n_chips

@@ -1,0 +1,83 @@
+"""Mesh construction and sharding rules for trial execution.
+
+The platform's intra-trial parallelism (SURVEY.md §2.9): each trial trains
+under ``jax.jit`` over a 2-D ``Mesh`` with axes ``("dp", "tp")`` built from
+its chip group — batch data-parallel over ``dp``, optional tensor-parallel
+sharding of large kernels over ``tp``. XLA inserts the ICI collectives
+(psum for grads on ``dp``, all-gather/reduce-scatter on ``tp``); nothing
+here issues a collective by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+# Kernels smaller than this are cheaper to replicate than to shard+gather.
+_TP_MIN_FEATURES = 256
+
+
+def build_mesh(devices: Optional[Sequence[Any]] = None, tp: int = 1) -> Mesh:
+    """Arrange ``devices`` into a (dp, tp) mesh; dp = n_devices / tp."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if n % tp != 0:
+        raise ValueError(f"{n} devices not divisible by tp={tp}")
+    arr = np.asarray(devices, dtype=object).reshape(n // tp, tp)
+    return Mesh(arr, (DP_AXIS, TP_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis batch sharding over dp (tp replicates the batch)."""
+    return NamedSharding(mesh, P(DP_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_spec(path: str, arr: Any, tp: int) -> P:
+    """Partition rule for one parameter.
+
+    Dense/conv kernels with a large output-feature axis shard that axis
+    over ``tp`` (column parallelism — each tp shard computes a slice of the
+    output features; XLA all-gathers activations where needed). Biases,
+    norms, and small kernels replicate.
+    """
+    shape = getattr(arr, "shape", ())
+    if tp <= 1 or len(shape) < 2:
+        return P()
+    out_features = shape[-1]
+    if out_features % tp == 0 and out_features >= _TP_MIN_FEATURES:
+        return P(*([None] * (len(shape) - 1)), TP_AXIS)
+    return P()
+
+
+def shard_variables(variables: Any, mesh: Mesh) -> Any:
+    """Device-put a variables pytree with per-leaf NamedShardings."""
+    tp = mesh.shape[TP_AXIS]
+    flat = jax.tree_util.tree_flatten_with_path(variables)
+    specs_flat = [param_spec(jax.tree_util.keystr(kp), leaf, tp)
+                  for kp, leaf in flat[0]]
+    leaves = [leaf for _, leaf in flat[0]]
+    placed = [jax.device_put(leaf, NamedSharding(mesh, spec))
+              for leaf, spec in zip(leaves, specs_flat)]
+    return jax.tree_util.tree_unflatten(flat[1], placed)
+
+
+def variables_shardings(variables: Any, mesh: Mesh) -> Any:
+    """The NamedSharding pytree matching ``shard_variables``' placement."""
+    tp = mesh.shape[TP_AXIS]
+
+    def one(kp, leaf):
+        return NamedSharding(mesh, param_spec(jax.tree_util.keystr(kp), leaf, tp))
+
+    return jax.tree_util.tree_map_with_path(one, variables)

@@ -1,0 +1,51 @@
+import numpy as np
+
+from rafiki_tpu.model import (load_corpus_dataset, load_image_dataset,
+                              write_corpus_dataset, write_image_dataset_npz,
+                              write_image_files_dataset)
+
+
+def test_npz_roundtrip(tmp_path):
+    imgs = np.random.default_rng(0).integers(0, 255, (10, 8, 8, 3), dtype=np.uint8)
+    labels = np.arange(10) % 3
+    p = write_image_dataset_npz(imgs, labels, str(tmp_path / "d.npz"), 3)
+    ds = load_image_dataset(p)
+    assert ds.size == 10 and ds.n_classes == 3
+    assert ds.image_shape == (8, 8, 3)
+    np.testing.assert_array_equal(ds.images, imgs)
+    np.testing.assert_array_equal(ds.labels, labels)
+    assert ds.normalized().max() <= 1.0
+
+
+def test_zip_of_pngs_roundtrip(tmp_path):
+    imgs = np.random.default_rng(1).integers(0, 255, (6, 8, 8, 1), dtype=np.uint8)
+    labels = np.array([0, 1, 2, 0, 1, 2])
+    p = write_image_files_dataset(imgs, labels, str(tmp_path / "d.zip"))
+    ds = load_image_dataset(p)
+    assert ds.size == 6 and ds.n_classes == 3
+    np.testing.assert_array_equal(ds.images, imgs)
+    np.testing.assert_array_equal(ds.labels, labels)
+
+
+def test_batching():
+    imgs = np.zeros((10, 4, 4, 1), np.uint8)
+    labels = np.arange(10)
+    from rafiki_tpu.model.dataset import ImageDataset
+    ds = ImageDataset(imgs, labels, 10)
+    batches = list(ds.batches(4))
+    assert [b[0].shape[0] for b in batches] == [4, 4, 2]
+    batches = list(ds.batches(4, drop_remainder=True))
+    assert [b[0].shape[0] for b in batches] == [4, 4]
+    shuffled = list(ds.batches(10, shuffle=True, seed=1))[0][1]
+    assert not np.array_equal(shuffled, labels)
+    assert set(shuffled) == set(labels)
+
+
+def test_corpus_roundtrip(tmp_path):
+    sents = [["the", "cat", "sat"], ["dogs", "run"]]
+    tags = [["DET", "NOUN", "VERB"], ["NOUN", "VERB"]]
+    p = write_corpus_dataset(sents, tags, str(tmp_path / "c.zip"))
+    ds = load_corpus_dataset(p)
+    assert ds.size == 2
+    assert ds.sentences[0] == ["the", "cat", "sat"]
+    assert [ds.tag_names[t] for t in ds.tags[1]] == ["NOUN", "VERB"]

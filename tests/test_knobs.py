@@ -1,0 +1,78 @@
+import numpy as np
+import pytest
+
+from rafiki_tpu.model import (ArchKnob, CategoricalKnob, FixedKnob, FloatKnob,
+                              IntegerKnob, PolicyKnob, knob_config_from_json,
+                              knob_config_to_json, knobs_to_vector,
+                              sample_knobs, searchable_dims, validate_knobs,
+                              vector_to_knobs)
+
+
+CONFIG = {
+    "lr": FloatKnob(1e-4, 1e-1, is_exp=True),
+    "units": IntegerKnob(16, 256),
+    "act": CategoricalKnob(["relu", "gelu", "tanh"]),
+    "epochs": FixedKnob(3),
+    "share": PolicyKnob("SHARE_PARAMS"),
+}
+
+
+def test_sample_and_validate(rng):
+    for _ in range(20):
+        knobs = sample_knobs(CONFIG, rng)
+        out = validate_knobs(CONFIG, knobs)
+        assert 1e-4 <= out["lr"] <= 1e-1
+        assert 16 <= out["units"] <= 256
+        assert out["act"] in ("relu", "gelu", "tanh")
+        assert out["epochs"] == 3
+
+
+def test_validate_rejects():
+    with pytest.raises(ValueError):
+        validate_knobs(CONFIG, {})
+    knobs = {"lr": 1.0, "units": 32, "act": "relu", "epochs": 3, "share": False}
+    with pytest.raises(ValueError):
+        validate_knobs(CONFIG, knobs)  # lr out of range
+    knobs["lr"] = 1e-2
+    knobs["bogus"] = 1
+    with pytest.raises(ValueError):
+        validate_knobs(CONFIG, knobs)
+
+
+def test_json_roundtrip(rng):
+    cfg2 = knob_config_from_json(knob_config_to_json(CONFIG))
+    assert set(cfg2) == set(CONFIG)
+    knobs = sample_knobs(cfg2, rng)
+    validate_knobs(CONFIG, knobs)
+
+
+def test_vector_embedding_roundtrip(rng):
+    dims = searchable_dims(CONFIG)
+    assert dims == 1 + 1 + 3  # lr + units + act one-hot
+    for _ in range(10):
+        knobs = sample_knobs(CONFIG, rng)
+        x = knobs_to_vector(CONFIG, knobs)
+        assert x.shape == (dims,)
+        assert np.all(x >= 0) and np.all(x <= 1)
+        back = vector_to_knobs(CONFIG, x, rng)
+        assert back["act"] == knobs["act"]
+        assert abs(back["units"] - knobs["units"]) <= 1
+        assert np.isclose(np.log(back["lr"]), np.log(knobs["lr"]), atol=0.05)
+
+
+def test_log_scale_sampling(rng):
+    knob = FloatKnob(1e-4, 1.0, is_exp=True)
+    samples = [knob.sample(rng) for _ in range(500)]
+    # log-uniform → median around geometric mean (1e-2), not arithmetic (0.5)
+    assert 1e-3 < np.median(samples) < 1e-1
+
+
+def test_arch_knob(rng):
+    knob = ArchKnob([[0, 1, 2], [0, 1], [0, 1, 2, 3]])
+    for _ in range(10):
+        v = knob.sample(rng)
+        assert knob.validate(v) == v
+    with pytest.raises(ValueError):
+        knob.validate([0, 5, 0])
+    with pytest.raises(ValueError):
+        knob.validate([0, 1])

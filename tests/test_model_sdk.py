@@ -1,0 +1,62 @@
+import numpy as np
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.model import load_image_dataset, test_model_class
+from rafiki_tpu.models import JaxFeedForward
+
+
+def test_feedforward_end_to_end(synth_image_data):
+    train_path, val_path = synth_image_data
+    ds = load_image_dataset(val_path)
+    queries = [ds.images[i] for i in range(4)]
+    result = test_model_class(
+        JaxFeedForward, TaskType.IMAGE_CLASSIFICATION,
+        train_path, val_path, test_queries=queries,
+        knobs={"hidden_layer_count": 1, "hidden_layer_units": 32,
+               "learning_rate": 3e-3, "batch_size": 32, "max_epochs": 5})
+    # Synthetic data is learnable: must beat chance (0.25) comfortably.
+    assert result.score > 0.5, f"score too low: {result.score}"
+    assert len(result.predictions) == 4
+    for p in result.predictions:
+        assert len(p) == 4
+        assert abs(sum(p) - 1.0) < 1e-3
+    # Training logged plot definitions + per-epoch values.
+    types = {r["type"] for r in result.log_records}
+    assert "plot" in types and "values" in types
+
+
+def test_small_dataset_still_trains(tmp_path):
+    # Regression: dataset smaller than batch_size must still take real steps.
+    from rafiki_tpu.datasets import make_synthetic_image_dataset
+    train_path, val_path = make_synthetic_image_dataset(
+        str(tmp_path), n_train=48, n_val=32, image_shape=(8, 8, 1),
+        n_classes=2, noise=0.1)
+    m = JaxFeedForward(hidden_layer_count=1, hidden_layer_units=32,
+                       learning_rate=5e-3, batch_size=128, max_epochs=8)
+    m.train(train_path)
+    assert m.evaluate(val_path) > 0.8
+
+
+def test_predict_empty_queries(synth_image_data):
+    train_path, _ = synth_image_data
+    m = JaxFeedForward(hidden_layer_count=1, hidden_layer_units=16,
+                       learning_rate=1e-3, batch_size=64, max_epochs=1)
+    m.train(train_path)
+    assert m.predict([]) == []
+
+
+def test_param_roundtrip_exact(synth_image_data):
+    train_path, val_path = synth_image_data
+    knobs = {"hidden_layer_count": 1, "hidden_layer_units": 16,
+             "learning_rate": 1e-3, "batch_size": 64, "max_epochs": 1}
+    m = JaxFeedForward(**knobs)
+    m.train(train_path)
+    params = m.dump_parameters()
+    assert all(isinstance(v, np.ndarray) for v in params.values())
+
+    m2 = JaxFeedForward(**knobs)
+    m2.load_parameters(params)
+    ds = load_image_dataset(val_path)
+    p1 = m.predict_proba(ds.normalized()[:8])
+    p2 = m2.predict_proba(ds.normalized()[:8])
+    np.testing.assert_allclose(p1, p2, atol=1e-5)

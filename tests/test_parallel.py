@@ -1,0 +1,69 @@
+import jax
+import numpy as np
+import pytest
+
+from rafiki_tpu.parallel import (ChipAllocator, ChipGroup, build_mesh,
+                                 param_spec, shard_variables)
+
+
+def test_allocator_first_fit_and_release():
+    a = ChipAllocator(8)
+    g1 = a.allocate(4, "t1")
+    g2 = a.allocate(2, "t2")
+    assert g1.indices == (0, 1, 2, 3)
+    assert g2.indices == (4, 5)
+    assert a.allocate(4, "t3") is None  # only 2 free
+    a.release("t1")
+    g3 = a.allocate(3, "t3")
+    assert g3.indices == (0, 1, 2)
+    assert a.free_chips == 3  # chips 3, 6, 7
+    assert a.utilization() == pytest.approx(5 / 8)
+
+
+def test_allocator_rejects_name_reuse():
+    a = ChipAllocator(4)
+    a.allocate(2, "svc")
+    with pytest.raises(ValueError):
+        a.allocate(2, "svc")
+    a.release("svc")
+    assert a.allocate(2, "svc") is not None
+    a.release("missing")  # no-op, no raise
+
+
+def test_chip_group_env_roundtrip():
+    g = ChipGroup(indices=(2, 3, 4))
+    assert g.to_env() == "2,3,4"
+    g2 = ChipGroup.from_env("2,3,4")
+    assert g2.indices == (2, 3, 4)
+    g_all = ChipGroup.from_env("")
+    assert g_all.n_chips == len(jax.devices())
+
+
+def test_build_mesh_shapes():
+    mesh = build_mesh(jax.devices(), tp=2)
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    mesh = build_mesh(jax.devices())
+    assert mesh.shape["dp"] == 8 and mesh.shape["tp"] == 1
+    with pytest.raises(ValueError):
+        build_mesh(jax.devices(), tp=3)
+
+
+def test_param_spec_rules():
+    big = np.zeros((128, 512))
+    small = np.zeros((16, 8))
+    bias = np.zeros((512,))
+    assert param_spec("k", big, tp=2) == jax.sharding.PartitionSpec(None, "tp")
+    assert param_spec("k", small, tp=2) == jax.sharding.PartitionSpec()
+    assert param_spec("k", bias, tp=2) == jax.sharding.PartitionSpec()
+    assert param_spec("k", big, tp=1) == jax.sharding.PartitionSpec()
+
+
+def test_shard_variables_places_on_mesh():
+    mesh = build_mesh(jax.devices(), tp=2)
+    variables = {"params": {"dense": {"kernel": np.zeros((64, 512)),
+                                      "bias": np.zeros((512,))}}}
+    placed = shard_variables(variables, mesh)
+    kernel = placed["params"]["dense"]["kernel"]
+    assert len(kernel.sharding.device_set) == 8
+    # Sharded over tp on last axis: per-device shard is (64, 256).
+    assert kernel.addressable_shards[0].data.shape == (64, 256)
