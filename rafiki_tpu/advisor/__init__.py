@@ -1,0 +1,29 @@
+"""Advisor: hyperparameter / architecture search strategies.
+
+Parity: SURVEY.md §2 "Advisor" (upstream ``rafiki/advisor/``): given a
+model's knob config and the trial history, propose the next knob assignment;
+the TrainWorkers executing those proposals are what fans the search out
+across the slice. Strategies:
+
+- ``RandomAdvisor`` — uniform sampling (upstream random advisor).
+- ``BayesOptAdvisor`` — GP + expected improvement over the knobs'
+  continuous-box embedding (upstream BTB ``GpTuner`` / skopt equivalent,
+  rebuilt on sklearn's ``GaussianProcessRegressor``).
+- ``EnasAdvisor`` — RNN-policy controller trained with REINFORCE, proposing
+  ``ArchKnob`` encodings with weight sharing via the ParamStore
+  (upstream ENAS controller advisor). Lives in ``enas.py``.
+
+``make_advisor`` picks the right strategy from the knob config, like the
+upstream factory.
+"""
+
+from .base import BaseAdvisor, Proposal
+from .bayes import BayesOptAdvisor
+from .enas import EnasAdvisor
+from .random_advisor import RandomAdvisor
+from .registry import make_advisor
+
+__all__ = [
+    "BaseAdvisor", "Proposal", "RandomAdvisor", "BayesOptAdvisor",
+    "EnasAdvisor", "make_advisor",
+]

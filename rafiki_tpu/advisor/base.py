@@ -1,0 +1,103 @@
+"""Advisor contract: propose knob assignments, learn from trial scores.
+
+Parity: SURVEY.md §3.1 hot loop — the TrainWorker calls
+``advisor.propose()`` before each trial and ``advisor.feedback(...)`` after;
+SURVEY.md §2 "Advisor". The advisor is deliberately transport-agnostic: the
+in-process trial runner holds it directly, while in distributed mode an
+AdvisorWorker owns it and serves propose/feedback over the bus (so many
+TrainWorkers share one search state).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..constants import ParamsType
+from ..model.knobs import KnobConfig, Knobs, PolicyKnob
+
+
+@dataclass
+class Proposal:
+    """One concrete trial request handed to a TrainWorker.
+
+    ``params_type`` tells the worker which shared parameters to warm-start
+    from (ParamStore sharing policy; ENAS weight sharing uses
+    ``GLOBAL_RECENT``). ``meta`` carries advisor-internal bookkeeping that
+    must round-trip through ``feedback`` (e.g. the controller's log-probs
+    index for REINFORCE).
+    """
+
+    trial_no: int
+    knobs: Knobs
+    params_type: str = ParamsType.NONE
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"trial_no": self.trial_no, "knobs": self.knobs,
+                "params_type": self.params_type, "meta": self.meta}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "Proposal":
+        return Proposal(trial_no=int(d["trial_no"]), knobs=d["knobs"],
+                        params_type=d.get("params_type", ParamsType.NONE),
+                        meta=d.get("meta", {}))
+
+
+class BaseAdvisor:
+    """Base search strategy. Thread-safe: one advisor serves many workers."""
+
+    def __init__(self, knob_config: KnobConfig, seed: int = 0):
+        self.knob_config = knob_config
+        self.rng = np.random.default_rng(seed)
+        self._lock = threading.RLock()
+        self._trial_no = 0
+        self._history: List[Tuple[Knobs, float]] = []
+        self._best: Optional[Tuple[Knobs, float]] = None
+
+    # --- Public API (TrainWorker-facing) ---
+
+    def propose(self) -> Proposal:
+        with self._lock:
+            self._trial_no += 1
+            knobs = self._propose_knobs(self._trial_no)
+            knobs = self._fill_policies(knobs, self._trial_no)
+            return Proposal(trial_no=self._trial_no, knobs=knobs,
+                            params_type=self._params_type(self._trial_no))
+
+    def feedback(self, proposal: Proposal, score: float) -> None:
+        with self._lock:
+            self._history.append((proposal.knobs, float(score)))
+            if self._best is None or score > self._best[1]:
+                self._best = (dict(proposal.knobs), float(score))
+            self._observe(proposal, float(score))
+
+    def best(self) -> Optional[Tuple[Knobs, float]]:
+        with self._lock:
+            return self._best
+
+    @property
+    def n_trials(self) -> int:
+        with self._lock:
+            return len(self._history)
+
+    # --- Strategy hooks ---
+
+    def _propose_knobs(self, trial_no: int) -> Knobs:
+        raise NotImplementedError
+
+    def _observe(self, proposal: Proposal, score: float) -> None:
+        """Incorporate one result; called under the lock."""
+
+    def _params_type(self, trial_no: int) -> str:
+        return ParamsType.NONE
+
+    def _fill_policies(self, knobs: Knobs, trial_no: int) -> Knobs:
+        """Default policy activation: all off. Strategies override."""
+        for name, knob in self.knob_config.items():
+            if isinstance(knob, PolicyKnob) and name not in knobs:
+                knobs[name] = False
+        return knobs

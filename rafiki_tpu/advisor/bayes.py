@@ -1,0 +1,87 @@
+"""Bayesian-optimization strategy: GP surrogate + expected improvement.
+
+Parity: SURVEY.md §2 "Advisor" — the upstream Bayesian advisor (BTB
+``GpTuner`` / skopt), rebuilt on sklearn's ``GaussianProcessRegressor``
+since neither btb nor skopt is in this environment. Knobs embed into a
+fixed-dimension [0,1]^d box via their ``to_vector``/``from_vector`` methods
+(see ``rafiki_tpu.model.knobs``), so the GP never special-cases knob types.
+
+Acquisition is maximised by scoring a large random candidate set — for the
+d ≤ ~20 boxes knob configs produce, this is simpler and more robust than
+gradient ascent, and its cost is trivial next to a trial's train time.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List
+
+import numpy as np
+
+from .base import BaseAdvisor, Proposal
+from ..model.knobs import (KnobConfig, Knobs, knobs_to_vector, sample_knobs,
+                           searchable_dims, validate_knobs, vector_to_knobs)
+
+
+class BayesOptAdvisor(BaseAdvisor):
+    """GP + EI over the continuous-box embedding of the knob config."""
+
+    def __init__(self, knob_config: KnobConfig, seed: int = 0,
+                 n_initial: int = 5, n_candidates: int = 1024,
+                 exploration: float = 0.01):
+        super().__init__(knob_config, seed)
+        self.dims = searchable_dims(knob_config)
+        self.n_initial = max(2, n_initial)
+        self.n_candidates = n_candidates
+        self.exploration = exploration
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    def _propose_knobs(self, trial_no: int) -> Knobs:
+        if self.dims == 0 or len(self._y) < self.n_initial:
+            return sample_knobs(self.knob_config, self.rng)
+        x = self._maximize_ei()
+        knobs = vector_to_knobs(self.knob_config, x, self.rng)
+        return validate_knobs(self.knob_config, knobs)
+
+    def _observe(self, proposal: Proposal, score: float) -> None:
+        if self.dims == 0:
+            return
+        self._X.append(knobs_to_vector(self.knob_config, proposal.knobs))
+        self._y.append(score)
+
+    def _maximize_ei(self) -> np.ndarray:
+        from scipy.stats import norm
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import ConstantKernel, Matern
+
+        X = np.stack(self._X)
+        y = np.asarray(self._y, dtype=np.float64)
+        # Normalise scores so the kernel amplitude prior is reasonable.
+        y_mean, y_std = y.mean(), y.std() + 1e-9
+        yn = (y - y_mean) / y_std
+
+        kernel = ConstantKernel(1.0) * Matern(length_scale=np.full(self.dims, 0.5),
+                                              nu=2.5)
+        gp = GaussianProcessRegressor(kernel=kernel, alpha=1e-4,
+                                      normalize_y=False,
+                                      n_restarts_optimizer=1,
+                                      random_state=int(self.rng.integers(2**31)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # GP convergence chatter
+            gp.fit(X, yn)
+
+        # Candidate set: uniform + jittered copies of the incumbents.
+        cand = self.rng.uniform(0, 1, size=(self.n_candidates, self.dims))
+        top = X[np.argsort(yn)[-5:]]
+        jitter = top[self.rng.integers(len(top), size=self.n_candidates // 4)]
+        jitter = np.clip(jitter + self.rng.normal(0, 0.1, jitter.shape), 0, 1)
+        cand = np.concatenate([cand, jitter, X[np.argsort(yn)[-2:]]])
+
+        mu, sigma = gp.predict(cand, return_std=True)
+        best = yn.max()
+        imp = mu - best - self.exploration
+        z = imp / np.maximum(sigma, 1e-9)
+        ei = imp * norm.cdf(z) + sigma * norm.pdf(z)
+        ei[sigma < 1e-9] = 0.0
+        return cand[int(np.argmax(ei))]

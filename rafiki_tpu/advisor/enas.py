@@ -1,0 +1,201 @@
+"""ENAS controller advisor: RNN policy + REINFORCE over ArchKnob encodings.
+
+Parity: SURVEY.md §3.5 — the upstream ENAS controller advisor (an RNN
+policy trained with REINFORCE from child-model validation accuracy, used by
+``TfEnas``). Rebuilt in JAX/flax: an LSTM rolls over the architecture
+positions, emitting a categorical distribution per position; sampling and
+the policy-gradient update are each one jitted function (positions and
+choice counts are static, so there is exactly one compiled graph each —
+no per-architecture recompiles).
+
+Search-phase proposals activate the model's ``SHARE_PARAMS`` /
+``QUICK_TRAIN`` policies and request ``GLOBAL_RECENT`` shared params
+(ParamStore weight sharing); the final stretch of the budget switches to
+full from-scratch training of the controller's best architectures
+(upstream's search→final split).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from .base import BaseAdvisor, Proposal
+from ..constants import ParamsType
+from ..model.knobs import ArchKnob, KnobConfig, Knobs, PolicyKnob, sample_knobs
+
+
+class _Controller(nn.Module):
+    """LSTM policy: one categorical head per architecture position."""
+
+    n_choices: Tuple[int, ...]  # choices available at each position
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, actions: jnp.ndarray):
+        """Teacher-forced pass; returns per-position logits.
+
+        ``actions``: (n_positions,) int32 — the choice taken at each
+        position (used as the next step's input embedding). Logits at step
+        i depend only on actions[:i], so the same weights both sample
+        (feeding back sampled actions) and score (feeding given actions).
+        """
+        max_c = max(self.n_choices)
+        n_pos = len(self.n_choices)
+        cell = nn.LSTMCell(features=self.hidden)
+        embed = nn.Embed(num_embeddings=max_c + 1, features=self.hidden)
+        heads = [nn.Dense(c, name=f"head_{i}")
+                 for i, c in enumerate(self.n_choices)]
+
+        carry = cell.initialize_carry(jax.random.key(0), (self.hidden,))
+        inp = embed(jnp.array(max_c, jnp.int32))  # start token
+        logits_all: List[jnp.ndarray] = []
+        for i in range(n_pos):
+            carry, out = cell(carry, inp)
+            logits = heads[i](out)
+            logits_all.append(jnp.pad(logits, (0, max_c - self.n_choices[i]),
+                                      constant_values=-1e9))
+            inp = embed(actions[i])
+        return jnp.stack(logits_all)  # (n_pos, max_c)
+
+
+class EnasAdvisor(BaseAdvisor):
+    """Architecture search over the config's single ``ArchKnob``."""
+
+    def __init__(self, knob_config: KnobConfig, seed: int = 0,
+                 total_trials: Optional[int] = None,
+                 final_train_frac: float = 0.15,
+                 lr: float = 3e-3, entropy_weight: float = 1e-3,
+                 baseline_decay: float = 0.7):
+        super().__init__(knob_config, seed)
+        arch_items = [(n, k) for n, k in knob_config.items()
+                      if isinstance(k, ArchKnob)]
+        if len(arch_items) != 1:
+            raise ValueError("EnasAdvisor needs exactly one ArchKnob")
+        self.arch_name, self.arch_knob = arch_items[0]
+        self.positions = self.arch_knob.positions
+        self.total_trials = total_trials
+        self.final_train_frac = final_train_frac
+        self.entropy_weight = entropy_weight
+        self.baseline: Optional[float] = None
+        self.baseline_decay = baseline_decay
+        self._policies = {n for n, k in knob_config.items()
+                          if isinstance(k, PolicyKnob)}
+        self._pending_meta: Dict[int, np.ndarray] = {}
+
+        n_choices = tuple(len(p) for p in self.positions)
+        self._choice_values = [list(p) for p in self.positions]
+        self._model = _Controller(n_choices=n_choices)
+        self._key = jax.random.key(seed)
+        params = self._model.init(
+            jax.random.key(seed + 1),
+            jnp.zeros((len(n_choices),), jnp.int32))
+        self._tx = optax.adam(lr)
+        self._opt_state = self._tx.init(params)
+        self._params = params
+        self._build_fns(n_choices)
+
+    def _build_fns(self, n_choices: Tuple[int, ...]) -> None:
+        model = self._model
+        n_pos = len(n_choices)
+        ent_w = self.entropy_weight
+
+        @jax.jit
+        def sample_fn(params, key):
+            """Ancestral sampling by iterated teacher-forced passes.
+
+            The controller is tiny (n_pos ≤ ~40, hidden 64), so the
+            O(n_pos²) re-rolls are negligible next to a child trial; the
+            payoff is a single weights/apply path for sample and update.
+            """
+            actions = jnp.zeros((n_pos,), jnp.int32)
+            keys = jax.random.split(key, n_pos)
+            for i in range(n_pos):
+                logits = model.apply(params, actions)[i]
+                a = jax.random.categorical(keys[i], logits)
+                actions = actions.at[i].set(a.astype(jnp.int32))
+            return actions
+
+        def loss_fn(params, actions, advantage):
+            logits = model.apply(params, actions)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            chosen = jnp.take_along_axis(logp, actions[:, None], axis=-1).sum()
+            probs = jax.nn.softmax(logits, axis=-1)
+            entropy = -(probs * logp).sum()
+            return -advantage * chosen - ent_w * entropy
+
+        @jax.jit
+        def update_fn(params, opt_state, actions, advantage):
+            grads = jax.grad(loss_fn)(params, actions, advantage)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._sample_fn = sample_fn
+        self._update_fn = update_fn
+
+    # --- Phase split ---
+
+    def _is_final(self, trial_no: int) -> bool:
+        if not self.total_trials:
+            return False
+        n_final = max(1, int(self.total_trials * self.final_train_frac))
+        return trial_no > self.total_trials - n_final
+
+    # --- BaseAdvisor hooks ---
+
+    def _propose_knobs(self, trial_no: int) -> Knobs:
+        knobs = sample_knobs(self.knob_config, self.rng)
+        if self._is_final(trial_no) and self._best is not None:
+            # Final phase: retrain the best architecture from scratch.
+            knobs[self.arch_name] = list(self._best[0][self.arch_name])
+            self._pending_meta[trial_no] = None  # no policy update
+        else:
+            self._key, sub = jax.random.split(self._key)
+            idx = np.asarray(self._sample_fn(self._params, sub))
+            knobs[self.arch_name] = [self._choice_values[i][int(a)]
+                                     for i, a in enumerate(idx)]
+            self._pending_meta[trial_no] = idx
+        return knobs
+
+    def _fill_policies(self, knobs: Knobs, trial_no: int) -> Knobs:
+        final = self._is_final(trial_no)
+        for name in self._policies:
+            policy = self.knob_config[name].policy
+            if policy in ("SHARE_PARAMS", "QUICK_TRAIN", "QUICK_EVAL",
+                          "EARLY_STOP", "DOWNSCALE"):
+                knobs[name] = not final
+            else:
+                knobs.setdefault(name, False)
+        return knobs
+
+    def _params_type(self, trial_no: int) -> str:
+        return ParamsType.NONE if self._is_final(trial_no) \
+            else ParamsType.GLOBAL_RECENT
+
+    def _observe(self, proposal: Proposal, score: float) -> None:
+        idx = self._pending_meta.pop(proposal.trial_no, None)
+        if idx is None:
+            return
+        if self.baseline is None:
+            self.baseline = score
+        adv = score - self.baseline
+        self.baseline = (self.baseline_decay * self.baseline
+                         + (1 - self.baseline_decay) * score)
+        self._params, self._opt_state = self._update_fn(
+            self._params, self._opt_state,
+            jnp.asarray(idx, jnp.int32), jnp.float32(adv))
+
+    def arch_probs(self) -> np.ndarray:
+        """Per-position choice probabilities under the current policy
+        (conditioned on its own greedy prefix); for tests/inspection."""
+        actions = jnp.zeros((len(self.positions),), jnp.int32)
+        for i in range(len(self.positions)):
+            logits = self._model.apply(self._params, actions)
+            actions = actions.at[i].set(jnp.argmax(logits[i]).astype(jnp.int32))
+        logits = self._model.apply(self._params, actions)
+        return np.asarray(jax.nn.softmax(logits, axis=-1))

@@ -1,0 +1,15 @@
+"""Uniform-random search strategy.
+
+Parity: SURVEY.md §2 "Advisor" — the upstream random advisor. Also the
+fallback when a knob config has no searchable dimensions.
+"""
+
+from __future__ import annotations
+
+from .base import BaseAdvisor
+from ..model.knobs import Knobs, sample_knobs
+
+
+class RandomAdvisor(BaseAdvisor):
+    def _propose_knobs(self, trial_no: int) -> Knobs:
+        return sample_knobs(self.knob_config, self.rng)

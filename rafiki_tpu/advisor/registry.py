@@ -1,0 +1,40 @@
+"""Advisor factory: pick a strategy from the knob config.
+
+Parity: SURVEY.md §2 "Advisor" — upstream ``make_advisor``. Selection:
+an ``ArchKnob`` → ENAS controller; searchable continuous dims → Bayesian
+GP; otherwise random.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import BaseAdvisor
+from .bayes import BayesOptAdvisor
+from .enas import EnasAdvisor
+from .random_advisor import RandomAdvisor
+from ..model.knobs import ArchKnob, KnobConfig, searchable_dims
+
+ADVISOR_TYPES = {
+    "random": RandomAdvisor,
+    "bayes": BayesOptAdvisor,
+    "enas": EnasAdvisor,
+}
+
+
+def make_advisor(knob_config: KnobConfig, seed: int = 0,
+                 advisor_type: Optional[str] = None,
+                 total_trials: Optional[int] = None) -> BaseAdvisor:
+    if advisor_type is not None:
+        cls = ADVISOR_TYPES.get(advisor_type)
+        if cls is None:
+            raise ValueError(f"Unknown advisor type: {advisor_type!r}; "
+                             f"one of {sorted(ADVISOR_TYPES)}")
+        if cls is EnasAdvisor:
+            return EnasAdvisor(knob_config, seed, total_trials=total_trials)
+        return cls(knob_config, seed)
+    if any(isinstance(k, ArchKnob) for k in knob_config.values()):
+        return EnasAdvisor(knob_config, seed, total_trials=total_trials)
+    if searchable_dims(knob_config) > 0:
+        return BayesOptAdvisor(knob_config, seed)
+    return RandomAdvisor(knob_config, seed)

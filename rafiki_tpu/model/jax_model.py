@@ -64,6 +64,7 @@ class JaxModel(BaseModel):
         self._meta: Dict[str, Any] = {}
         self._mesh = None
         self._predict_cache: Dict[int, Any] = {}
+        self._sharded_vars = None
         self._eval_step = None
 
     # --- Subclass API ---
@@ -182,7 +183,11 @@ class JaxModel(BaseModel):
             for s in range(steps_per_epoch):
                 sel = order[s * batch_size:(s + 1) * batch_size]
                 if len(sel) < batch_size:
-                    break
+                    if s > 0:
+                        break
+                    # Dataset smaller than one dp-divisible batch: wrap so
+                    # the epoch still takes a real optimizer step.
+                    sel = np.resize(order, batch_size)
                 xb = self.augment_batch(imgs_f[sel], rng)
                 yb = ds.labels[sel]
                 xb = jax.device_put(xb, x_shard)
@@ -295,10 +300,13 @@ class JaxModel(BaseModel):
         bucket = dp
         while bucket < n:
             bucket *= 2
-        fn = self._predict_cache.get(bucket)
-        if fn is None:
+        # One sharded device copy of the parameters serves every bucket.
+        if self._sharded_vars is None:
+            self._sharded_vars = shard_variables(self._variables, mesh)
+        variables = self._sharded_vars
+        compiled = self._predict_cache.get(bucket)
+        if compiled is None:
             module = self._module
-            variables = shard_variables(self._variables, mesh)
 
             @jax.jit
             def predict_fn(variables, x):
@@ -313,9 +321,7 @@ class JaxModel(BaseModel):
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
                 variables)
             compiled = predict_fn.lower(v_shapes, x_shape).compile()
-            fn = (compiled, variables)
-            self._predict_cache[bucket] = fn
-        compiled, variables = fn
+            self._predict_cache[bucket] = compiled
         if n < bucket:
             chunk = np.concatenate(
                 [chunk, np.zeros((bucket - n, *chunk.shape[1:]), chunk.dtype)])
@@ -354,11 +360,13 @@ class JaxModel(BaseModel):
         flat = {k: np.asarray(v) for k, v in params.items()
                 if not k.startswith("_meta/")}
         self._variables = traverse_util.unflatten_dict(flat, sep="/")
+        self._module = None  # rebuild for the loaded checkpoint's shape
         self._ensure_module(self._meta["n_classes"], self._meta["image_shape"])
         self._invalidate_compiled()
 
     def _invalidate_compiled(self) -> None:
         self._predict_cache.clear()
+        self._sharded_vars = None
         self._eval_step = None
 
     def destroy(self) -> None:

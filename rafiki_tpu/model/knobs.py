@@ -208,10 +208,15 @@ class FloatKnob(BaseKnob):
                 f"{value} outside [{self.value_min}, {self.value_max}]")
         return value
 
+    def _clip(self, value: float) -> float:
+        # exp(log(x)) != x in float64, so log-scale round-trips can land
+        # epsilon outside the box; clamp so validate() always passes.
+        return min(max(value, self.value_min), self.value_max)
+
     def sample(self, rng):
         if self.is_exp:
             lo, hi = math.log(self.value_min), math.log(self.value_max)
-            return math.exp(rng.uniform(lo, hi))
+            return self._clip(math.exp(rng.uniform(lo, hi)))
         return float(rng.uniform(self.value_min, self.value_max))
 
     @property
@@ -232,8 +237,8 @@ class FloatKnob(BaseKnob):
         t = float(np.clip(x[0], 0.0, 1.0))
         if self.is_exp:
             lo, hi = math.log(self.value_min), math.log(self.value_max)
-            return math.exp(lo + t * (hi - lo))
-        return self.value_min + t * (self.value_max - self.value_min)
+            return self._clip(math.exp(lo + t * (hi - lo)))
+        return self._clip(self.value_min + t * (self.value_max - self.value_min))
 
     def to_json(self):
         return {"kind": "float", "value_min": self.value_min,

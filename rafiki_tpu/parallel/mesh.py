@@ -44,7 +44,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def param_spec(path: str, arr: Any, tp: int) -> P:
+def param_spec(arr: Any, tp: int) -> P:
     """Partition rule for one parameter.
 
     Dense/conv kernels with a large output-feature axis shard that axis
@@ -64,20 +64,14 @@ def param_spec(path: str, arr: Any, tp: int) -> P:
 def shard_variables(variables: Any, mesh: Mesh) -> Any:
     """Device-put a variables pytree with per-leaf NamedShardings."""
     tp = mesh.shape[TP_AXIS]
-    flat = jax.tree_util.tree_flatten_with_path(variables)
-    specs_flat = [param_spec(jax.tree_util.keystr(kp), leaf, tp)
-                  for kp, leaf in flat[0]]
-    leaves = [leaf for _, leaf in flat[0]]
-    placed = [jax.device_put(leaf, NamedSharding(mesh, spec))
-              for leaf, spec in zip(leaves, specs_flat)]
-    return jax.tree_util.tree_unflatten(flat[1], placed)
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(
+            leaf, NamedSharding(mesh, param_spec(leaf, tp))),
+        variables)
 
 
 def variables_shardings(variables: Any, mesh: Mesh) -> Any:
     """The NamedSharding pytree matching ``shard_variables``' placement."""
     tp = mesh.shape[TP_AXIS]
-
-    def one(kp, leaf):
-        return NamedSharding(mesh, param_spec(jax.tree_util.keystr(kp), leaf, tp))
-
-    return jax.tree_util.tree_map_with_path(one, variables)
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(mesh, param_spec(leaf, tp)), variables)

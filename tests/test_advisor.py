@@ -1,0 +1,116 @@
+"""Advisor subsystem tests: proposal contract, GP convergence, ENAS policy.
+
+Mirrors SURVEY.md §4's implication (a): pure-Python unit tests for the
+advisor, no cluster needed.
+"""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.advisor import (BayesOptAdvisor, EnasAdvisor, RandomAdvisor,
+                                make_advisor)
+from rafiki_tpu.constants import ParamsType
+from rafiki_tpu.model import (ArchKnob, CategoricalKnob, FixedKnob, FloatKnob,
+                              IntegerKnob, PolicyKnob)
+
+CONFIG = {
+    "lr": FloatKnob(1e-4, 1e-1, is_exp=True),
+    "units": IntegerKnob(8, 64),
+    "act": CategoricalKnob(["relu", "tanh"]),
+    "epochs": FixedKnob(3),
+}
+
+
+def _quadratic_score(knobs):
+    # Max at lr=1e-2, units=32: a smooth landscape the GP should climb.
+    lr_term = -(np.log10(knobs["lr"]) + 2.0) ** 2
+    units_term = -((knobs["units"] - 32) / 16.0) ** 2
+    return float(lr_term + units_term)
+
+
+def test_random_advisor_proposals_valid():
+    adv = RandomAdvisor(CONFIG, seed=0)
+    seen = set()
+    for i in range(20):
+        p = adv.propose()
+        assert p.trial_no == i + 1
+        assert set(p.knobs) == set(CONFIG)
+        assert p.knobs["epochs"] == 3
+        adv.feedback(p, _quadratic_score(p.knobs))
+        seen.add((p.knobs["units"], p.knobs["act"]))
+    assert len(seen) > 5, "random search should produce diverse proposals"
+    assert adv.best() is not None
+
+
+def test_bayes_advisor_beats_random_on_smooth_landscape():
+    def run(adv, n=30):
+        best = -np.inf
+        for _ in range(n):
+            p = adv.propose()
+            s = _quadratic_score(p.knobs)
+            adv.feedback(p, s)
+            best = max(best, s)
+        return best
+
+    bayes_best = run(BayesOptAdvisor(CONFIG, seed=1, n_initial=6))
+    # The optimum is 0.0; GP should get close.
+    assert bayes_best > -0.5, f"GP failed to climb: best={bayes_best}"
+
+
+def test_bayes_advisor_proposals_validate():
+    adv = BayesOptAdvisor(CONFIG, seed=2, n_initial=3)
+    for _ in range(10):
+        p = adv.propose()
+        # validate_knobs raises if anything is off-spec
+        from rafiki_tpu.model.knobs import validate_knobs
+        validate_knobs(CONFIG, p.knobs)
+        adv.feedback(p, _quadratic_score(p.knobs))
+
+
+ENAS_CONFIG = {
+    "arch": ArchKnob([[0, 1, 2], [0, 1], [0, 1, 2, 3]]),
+    "lr": FixedKnob(1e-3),
+    "share": PolicyKnob("SHARE_PARAMS"),
+    "quick": PolicyKnob("QUICK_TRAIN"),
+}
+
+
+def test_enas_advisor_learns_good_arch():
+    adv = EnasAdvisor(ENAS_CONFIG, seed=0, total_trials=None, lr=5e-2)
+    target = [2, 1, 3]
+
+    def score(arch):
+        return float(sum(a == t for a, t in zip(arch, target)) / 3.0)
+
+    for _ in range(60):
+        p = adv.propose()
+        assert p.params_type == ParamsType.GLOBAL_RECENT
+        assert p.knobs["share"] is True and p.knobs["quick"] is True
+        adv.feedback(p, score(p.knobs["arch"]))
+
+    probs = adv.arch_probs()
+    # Policy should have shifted meaningfully toward the target choices.
+    assert probs[0, 2] > 0.4 and probs[2, 3] > 0.35, f"probs: {probs}"
+
+
+def test_enas_final_phase_full_train():
+    adv = EnasAdvisor(ENAS_CONFIG, seed=0, total_trials=10,
+                      final_train_frac=0.2)
+    for i in range(8):
+        p = adv.propose()
+        adv.feedback(p, float(i) / 10)
+    best_arch = adv.best()[0]["arch"]
+    p9 = adv.propose()
+    assert p9.params_type == ParamsType.NONE
+    assert p9.knobs["share"] is False and p9.knobs["quick"] is False
+    assert p9.knobs["arch"] == best_arch
+
+
+def test_make_advisor_selection():
+    assert isinstance(make_advisor(ENAS_CONFIG), EnasAdvisor)
+    assert isinstance(make_advisor(CONFIG), BayesOptAdvisor)
+    fixed_only = {"epochs": FixedKnob(3)}
+    assert isinstance(make_advisor(fixed_only), RandomAdvisor)
+    assert isinstance(make_advisor(CONFIG, advisor_type="random"), RandomAdvisor)
+    with pytest.raises(ValueError):
+        make_advisor(CONFIG, advisor_type="nope")

@@ -52,10 +52,10 @@ def test_param_spec_rules():
     big = np.zeros((128, 512))
     small = np.zeros((16, 8))
     bias = np.zeros((512,))
-    assert param_spec("k", big, tp=2) == jax.sharding.PartitionSpec(None, "tp")
-    assert param_spec("k", small, tp=2) == jax.sharding.PartitionSpec()
-    assert param_spec("k", bias, tp=2) == jax.sharding.PartitionSpec()
-    assert param_spec("k", big, tp=1) == jax.sharding.PartitionSpec()
+    assert param_spec(big, tp=2) == jax.sharding.PartitionSpec(None, "tp")
+    assert param_spec(small, tp=2) == jax.sharding.PartitionSpec()
+    assert param_spec(bias, tp=2) == jax.sharding.PartitionSpec()
+    assert param_spec(big, tp=1) == jax.sharding.PartitionSpec()
 
 
 def test_shard_variables_places_on_mesh():
