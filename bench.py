@@ -1,0 +1,76 @@
+"""Benchmark: AutoML trials/hour on the PR1 reference config.
+
+Runs K full trials (propose -> train -> evaluate) of JaxFeedForward on a
+synthetic fashion-MNIST-shaped dataset on the available accelerator and
+prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md): the first recorded run
+of this script on TPU establishes the baseline. BASELINE_TRIALS_PER_HOUR
+below is that recorded figure; update it when re-baselining.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Recorded from the first v5e-1 run of this script (see BASELINE.md).
+# None => this run establishes the baseline (vs_baseline = 1.0).
+BASELINE_TRIALS_PER_HOUR = None
+
+N_TRIALS = 3
+N_TRAIN, N_VAL = 4096, 512
+IMAGE_SHAPE = (28, 28, 1)
+N_CLASSES = 10
+
+
+def main() -> None:
+    import tempfile
+
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.datasets import make_synthetic_image_dataset
+    from rafiki_tpu.models.feedforward import JaxFeedForward
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset(
+            tmp, n_train=N_TRAIN, n_val=N_VAL, image_shape=IMAGE_SHAPE,
+            n_classes=N_CLASSES)
+
+        advisor = make_advisor(JaxFeedForward.get_knob_config(), seed=0)
+
+        # Warm-up trial (outside the timed window): first XLA compile is
+        # ~20-40s and would otherwise dominate the measurement.
+        _run_trial(JaxFeedForward, advisor, train_path, val_path)
+
+        t0 = time.time()
+        scores = []
+        for _ in range(N_TRIALS):
+            scores.append(
+                _run_trial(JaxFeedForward, advisor, train_path, val_path))
+        elapsed = time.time() - t0
+
+    trials_per_hour = N_TRIALS / (elapsed / 3600.0)
+    vs = (1.0 if BASELINE_TRIALS_PER_HOUR is None
+          else trials_per_hour / BASELINE_TRIALS_PER_HOUR)
+    print(json.dumps({
+        "metric": "automl_trials_per_hour",
+        "value": round(trials_per_hour, 2),
+        "unit": "trials/hour",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+def _run_trial(model_class, advisor, train_path: str, val_path: str) -> float:
+    proposal = advisor.propose()
+    model = model_class(**model_class.validate_knobs(proposal.knobs))
+    model.train(train_path)
+    score = float(model.evaluate(val_path))
+    model.destroy()
+    advisor.feedback(proposal, score)
+    return score
+
+
+if __name__ == "__main__":
+    main()

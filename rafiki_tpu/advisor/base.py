@@ -75,6 +75,12 @@ class BaseAdvisor:
                 self._best = (dict(proposal.knobs), float(score))
             self._observe(proposal, float(score))
 
+    def forget(self, proposal: Proposal) -> None:
+        """Discard a proposal whose trial will never report a score
+        (errored/abandoned), releasing any per-proposal state."""
+        with self._lock:
+            self._forget(proposal)
+
     def best(self) -> Optional[Tuple[Knobs, float]]:
         with self._lock:
             return self._best
@@ -91,6 +97,9 @@ class BaseAdvisor:
 
     def _observe(self, proposal: Proposal, score: float) -> None:
         """Incorporate one result; called under the lock."""
+
+    def _forget(self, proposal: Proposal) -> None:
+        """Release per-proposal state; called under the lock."""
 
     def _params_type(self, trial_no: int) -> str:
         return ParamsType.NONE

@@ -86,7 +86,9 @@ class EnasAdvisor(BaseAdvisor):
         self.baseline_decay = baseline_decay
         self._policies = {n for n, k in knob_config.items()
                           if isinstance(k, PolicyKnob)}
-        self._pending_meta: Dict[int, np.ndarray] = {}
+        # trial_no -> sampled action indices (None for final-phase trials);
+        # entries are popped by _observe, or _forget for errored trials.
+        self._pending_meta: Dict[int, Optional[np.ndarray]] = {}
 
         n_choices = tuple(len(p) for p in self.positions)
         self._choice_values = [list(p) for p in self.positions]
@@ -189,6 +191,9 @@ class EnasAdvisor(BaseAdvisor):
         self._params, self._opt_state = self._update_fn(
             self._params, self._opt_state,
             jnp.asarray(idx, jnp.int32), jnp.float32(adv))
+
+    def _forget(self, proposal: Proposal) -> None:
+        self._pending_meta.pop(proposal.trial_no, None)
 
     def arch_probs(self) -> np.ndarray:
         """Per-position choice probabilities under the current policy

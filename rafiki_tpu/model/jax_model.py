@@ -183,10 +183,9 @@ class JaxModel(BaseModel):
             for s in range(steps_per_epoch):
                 sel = order[s * batch_size:(s + 1) * batch_size]
                 if len(sel) < batch_size:
-                    if s > 0:
-                        break
-                    # Dataset smaller than one dp-divisible batch: wrap so
-                    # the epoch still takes a real optimizer step.
+                    # Only possible at s == 0 with a dataset smaller than
+                    # one dp-divisible batch: wrap so the epoch still takes
+                    # a real optimizer step.
                     sel = np.resize(order, batch_size)
                 xb = self.augment_batch(imgs_f[sel], rng)
                 yb = ds.labels[sel]
@@ -237,7 +236,9 @@ class JaxModel(BaseModel):
         ds = load_image_dataset(dataset_path)
         self._ensure_module(ds.n_classes, ds.image_shape)
         mesh = self.mesh
-        variables = shard_variables(self._variables, mesh)
+        if self._sharded_vars is None:
+            self._sharded_vars = shard_variables(self._variables, mesh)
+        variables = self._sharded_vars
         module = self._module
 
         if self._eval_step is None:
@@ -355,7 +356,8 @@ class JaxModel(BaseModel):
         meta_shape = params.get("_meta/image_shape")
         assert meta_n is not None and meta_shape is not None, \
             "params missing _meta entries"
-        self._meta = {"n_classes": int(meta_n),
+        # safetensors round-trips 0-d arrays as shape (1,); accept both.
+        self._meta = {"n_classes": int(np.asarray(meta_n).reshape(-1)[0]),
                       "image_shape": [int(x) for x in np.asarray(meta_shape)]}
         flat = {k: np.asarray(v) for k, v in params.items()
                 if not k.startswith("_meta/")}

@@ -1,0 +1,14 @@
+"""State layer: durable metadata (MetaStore) and trial parameters (ParamStore).
+
+Parity: SURVEY.md §2 "Meta store (DB)" + "Param store". The reference uses
+SQLAlchemy→PostgreSQL and a Redis+filesystem param store; neither
+SQLAlchemy nor a Postgres server exists in this environment, so the
+MetaStore is built directly on stdlib ``sqlite3`` (same durable-rows
+contract, cross-process safe via sqlite's file locking) and the ParamStore
+on ``safetensors`` files with a sqlite index.
+"""
+
+from .meta import MetaStore
+from .params import ParamStore
+
+__all__ = ["MetaStore", "ParamStore"]

@@ -1,0 +1,128 @@
+"""ParamStore: trial parameters on safetensors files + a sqlite index.
+
+Parity: SURVEY.md §2 "Param store" — persists/retrieves serialized trial
+parameters with sharing policies between trials (``ParamsType``:
+LOCAL/GLOBAL x RECENT/BEST), the mechanism behind warm-starting and ENAS
+weight sharing. The reference stores blobs in Redis + filesystem; here
+each params dict is one ``.safetensors`` file (zero-copy mmap on load, no
+pickle) and the policy index is sqlite (cross-process safe), so TrainWorkers
+on different hosts can share a network volume.
+
+Scoping: LOCAL policies resolve within one worker's saves; GLOBAL within
+the whole session (a sub-train-job). Matches upstream's worker-local vs
+cross-worker sharing semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+from safetensors.numpy import load_file, save_file
+
+from ..constants import ParamsType
+from ..model.base import Params
+
+
+class ParamStore:
+    def __init__(self, params_dir: str):
+        self.params_dir = params_dir
+        os.makedirs(params_dir, exist_ok=True)
+        self._db = sqlite3.connect(os.path.join(params_dir, "index.db"),
+                                   check_same_thread=False, timeout=30.0)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.execute("PRAGMA busy_timeout=30000")
+            self._db.execute("""
+                CREATE TABLE IF NOT EXISTS params (
+                    id TEXT PRIMARY KEY,
+                    session_id TEXT NOT NULL,
+                    worker_id TEXT NOT NULL,
+                    score REAL NOT NULL,
+                    created_at REAL NOT NULL
+                )""")
+            self._db.execute(
+                "CREATE INDEX IF NOT EXISTS idx_params_session "
+                "ON params (session_id)")
+            self._db.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+    def _path(self, params_id: str) -> str:
+        return os.path.join(self.params_dir, f"{params_id}.safetensors")
+
+    # --- Save / load by id ---
+
+    def save(self, params: Params, *, session_id: str = "",
+             worker_id: str = "", score: float = 0.0) -> str:
+        """Persist one trial's parameters; returns the params_id."""
+        params_id = uuid.uuid4().hex
+        # safetensors requires contiguous arrays; normalise here so models
+        # can dump views/transposes freely.
+        flat = {k: np.ascontiguousarray(np.asarray(v))
+                for k, v in params.items()}
+        save_file(flat, self._path(params_id))
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO params (id, session_id, worker_id, score, "
+                "created_at) VALUES (?, ?, ?, ?, ?)",
+                (params_id, session_id, worker_id, float(score), time.time()))
+            self._db.commit()
+        return params_id
+
+    def load(self, params_id: str) -> Params:
+        return dict(load_file(self._path(params_id)))
+
+    def exists(self, params_id: str) -> bool:
+        return os.path.exists(self._path(params_id))
+
+    def delete(self, params_id: str) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM params WHERE id = ?", (params_id,))
+            self._db.commit()
+        try:
+            os.remove(self._path(params_id))
+        except FileNotFoundError:
+            pass
+
+    # --- Sharing policies (ParamsType) ---
+
+    def retrieve(self, params_type: str, *, session_id: str,
+                 worker_id: str = "") -> Optional[Params]:
+        """Fetch shared params per the proposal's sharing policy.
+
+        Returns None when the policy is NONE or nothing is saved yet (the
+        trial then cold-starts — matches upstream's fall-through).
+        """
+        if params_type == ParamsType.NONE:
+            return None
+        local = params_type in (ParamsType.LOCAL_RECENT, ParamsType.LOCAL_BEST)
+        best = params_type in (ParamsType.LOCAL_BEST, ParamsType.GLOBAL_BEST)
+        sql = "SELECT id FROM params WHERE session_id = ?"
+        args = [session_id]
+        if local:
+            sql += " AND worker_id = ?"
+            args.append(worker_id)
+        sql += " ORDER BY " + ("score DESC, created_at DESC"
+                               if best else "created_at DESC")
+        sql += " LIMIT 1"
+        with self._lock:
+            row = self._db.execute(sql, tuple(args)).fetchone()
+        if row is None:
+            return None
+        return self.load(row[0])
+
+    def session_params_ids(self, session_id: str) -> list:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT id FROM params WHERE session_id = ? "
+                "ORDER BY created_at", (session_id,)).fetchall()
+        return [r[0] for r in rows]

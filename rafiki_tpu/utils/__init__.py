@@ -1,0 +1,8 @@
+"""Shared utilities: auth, model-class loading, service plumbing.
+
+Parity: SURVEY.md §2 "Utils" (upstream ``rafiki/utils/``).
+"""
+
+from .model_loader import load_model_class, model_class_path
+
+__all__ = ["load_model_class", "model_class_path"]

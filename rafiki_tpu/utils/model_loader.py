@@ -1,0 +1,46 @@
+"""Resolve a stored model row back into a BaseModel subclass.
+
+Parity note: upstream ships model classes as pickled bytes in the DB and
+unpickles them in workers. Pickle-of-code is both brittle across versions
+and an arbitrary-code vector with no visibility, so here a model is stored
+as either:
+
+- ``model_class`` = ``"package.module:ClassName"`` — imported (the path for
+  bundled zoo models), or
+- ``model_source`` = the class's Python source + ``model_class`` =
+  ``"ClassName"`` — exec'd in a fresh module namespace (the path for
+  user-uploaded models, equivalent in trust model to upstream's unpickle:
+  only authenticated model developers can upload).
+"""
+
+from __future__ import annotations
+
+import importlib
+import types
+from typing import Optional, Type
+
+from ..model.base import BaseModel
+
+
+def model_class_path(cls: Type[BaseModel]) -> str:
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def load_model_class(model_class: str,
+                     model_source: Optional[str] = None) -> Type[BaseModel]:
+    if model_source:
+        mod = types.ModuleType(f"_rafiki_user_model_{abs(hash(model_source))}")
+        exec(compile(model_source, "<model_source>", "exec"), mod.__dict__)
+        cls = getattr(mod, model_class.split(":")[-1], None)
+    else:
+        module_name, _, qualname = model_class.partition(":")
+        mod = importlib.import_module(module_name)
+        cls = mod
+        for part in qualname.split("."):
+            cls = getattr(cls, part, None)
+            if cls is None:
+                break
+    if cls is None or not (isinstance(cls, type) and issubclass(cls, BaseModel)):
+        raise ValueError(
+            f"{model_class!r} does not resolve to a BaseModel subclass")
+    return cls

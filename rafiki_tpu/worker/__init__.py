@@ -1,0 +1,9 @@
+"""Workers: trial execution and serving data plane.
+
+Parity: SURVEY.md §2 "TrainWorker" / "InferenceWorker" (upstream
+``rafiki/worker/``).
+"""
+
+from .runner import TrialRunner
+
+__all__ = ["TrialRunner"]

@@ -1,0 +1,148 @@
+"""TrialRunner: the propose → train → evaluate → persist hot loop.
+
+Parity: SURVEY.md §3.1 — the system's primary hot loop, factored out of the
+TrainWorker so the same code runs in-process (tests, ``bench.py``, local
+dev — upstream's ``test_model_class`` writ large) and inside a distributed
+TrainWorker bound to a chip group. The runner is advisor-transport-agnostic:
+it accepts anything with ``propose()/feedback()`` (an in-process advisor or
+a bus-backed remote proxy).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Type
+
+from ..advisor.base import Proposal
+from ..constants import BudgetOption, TrialStatus
+from ..model.base import BaseModel
+from ..model.logger import logger
+from ..store import MetaStore, ParamStore
+
+_log = logging.getLogger(__name__)
+
+
+class BudgetTracker:
+    """Budget enforcement for one sub-train-job.
+
+    Parity: upstream budgets ``MODEL_TRIAL_COUNT`` and ``TIME_HOURS``
+    (SURVEY.md §2 "Constants"). ``GPU_COUNT``/``CHIP_COUNT`` govern service
+    sizing in the ServicesManager, not the trial loop.
+    """
+
+    def __init__(self, budget: Optional[Dict[str, Any]] = None):
+        budget = dict(budget or {})
+        self.max_trials = int(budget.get(BudgetOption.MODEL_TRIAL_COUNT, 5))
+        self.max_hours = float(budget.get(BudgetOption.TIME_HOURS, 0) or 0)
+        self._t0 = time.time()
+
+    def exhausted(self, n_trials_done: int) -> bool:
+        if n_trials_done >= self.max_trials:
+            return True
+        if self.max_hours > 0 and \
+                (time.time() - self._t0) >= self.max_hours * 3600:
+            return True
+        return False
+
+
+class TrialRunner:
+    """Runs trials for one (sub_train_job, model_class) against the stores."""
+
+    def __init__(self, model_class: Type[BaseModel], advisor: Any,
+                 train_dataset_path: str, val_dataset_path: str,
+                 meta_store: MetaStore, param_store: ParamStore,
+                 sub_train_job_id: str, model_id: str = "",
+                 worker_id: str = "local",
+                 budget: Optional[Dict[str, Any]] = None,
+                 stop_flag: Optional[Any] = None):
+        self.model_class = model_class
+        self.advisor = advisor
+        self.train_dataset_path = train_dataset_path
+        self.val_dataset_path = val_dataset_path
+        self.meta = meta_store
+        self.params = param_store
+        self.sub_train_job_id = sub_train_job_id
+        self.model_id = model_id
+        self.worker_id = worker_id
+        self.budget = BudgetTracker(budget)
+        # threading.Event-like; lets a supervisor stop the loop mid-job.
+        self.stop_flag = stop_flag
+
+    # --- Loop ---
+
+    def run(self) -> List[Dict[str, Any]]:
+        """Run trials until the budget is exhausted; returns trial rows."""
+        done: List[Dict[str, Any]] = []
+        while not self._should_stop():
+            row = self.run_one()
+            if row is None:
+                break
+            done.append(row)
+        return done
+
+    def _should_stop(self) -> bool:
+        if self.stop_flag is not None and self.stop_flag.is_set():
+            return True
+        n_done = len(self.meta.get_trials(self.sub_train_job_id,
+                                          status=TrialStatus.COMPLETED))
+        return self.budget.exhausted(n_done)
+
+    # --- One trial ---
+
+    def run_one(self, proposal: Optional[Proposal] = None,
+                ) -> Optional[Dict[str, Any]]:
+        if proposal is None:
+            proposal = self.advisor.propose()
+        if proposal is None:  # advisor side says: search is over
+            return None
+        knobs = self.model_class.validate_knobs(proposal.knobs)
+        trial = self.meta.create_trial(
+            self.sub_train_job_id, self.model_id, no=proposal.trial_no,
+            status=TrialStatus.RUNNING, worker_id=self.worker_id,
+            knobs=_jsonable_knobs(knobs), proposal=proposal.to_json())
+        trial_id = trial["id"]
+        logger.set_sink(lambda rec, _tid=trial_id:
+                        self.meta.add_trial_log(_tid, rec))
+        t0 = time.time()
+        try:
+            shared = self.params.retrieve(
+                proposal.params_type, session_id=self.sub_train_job_id,
+                worker_id=self.worker_id)
+            model = self.model_class(**knobs)
+            try:
+                model.train(self.train_dataset_path, shared_params=shared)
+                score = float(model.evaluate(self.val_dataset_path))
+                params_id = self.params.save(
+                    model.dump_parameters(),
+                    session_id=self.sub_train_job_id,
+                    worker_id=self.worker_id, score=score)
+            finally:
+                model.destroy()
+            self.meta.mark_trial_completed(trial_id, score, params_id)
+            self.advisor.feedback(proposal, score)
+            _log.info("trial %s #%d done: score=%.4f (%.1fs)", trial_id[:8],
+                      proposal.trial_no, score, time.time() - t0)
+        except Exception:
+            err = traceback.format_exc()
+            self.meta.mark_trial_errored(trial_id, err)
+            # The advisor will never get feedback for this proposal; let it
+            # release per-proposal state (e.g. ENAS pending REINFORCE meta).
+            forget = getattr(self.advisor, "forget", None)
+            if forget is not None:
+                forget(proposal)
+            _log.warning("trial %s #%d errored:\n%s", trial_id[:8],
+                         proposal.trial_no, err)
+        finally:
+            logger.set_sink(None)
+        return self.meta.get_trial(trial_id)
+
+
+def _jsonable_knobs(knobs: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in knobs.items():
+        if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+            v = v.item()
+        out[k] = v
+    return out

@@ -1,0 +1,136 @@
+"""TrialRunner integration tests: the §3.1 hot loop, in-process.
+
+Uses JaxFeedForward on the synthetic dataset (8 virtual CPU devices via
+conftest), the real advisor, and real stores — the single-process
+miniature of a TrainWorker.
+"""
+
+import threading
+
+import pytest
+
+from rafiki_tpu.advisor import make_advisor
+from rafiki_tpu.constants import BudgetOption, TrialStatus
+from rafiki_tpu.models.feedforward import JaxFeedForward
+from rafiki_tpu.store import MetaStore, ParamStore
+from rafiki_tpu.worker import TrialRunner
+
+
+@pytest.fixture()
+def stores(tmp_path):
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "params"))
+    yield meta, params
+    meta.close()
+    params.close()
+
+
+def _mk_sub_job(meta, budget):
+    user = meta.create_user("d@x.c", "h", "MODEL_DEVELOPER")
+    model = meta.create_model(user["id"], "ff", "IMAGE_CLASSIFICATION",
+                              "rafiki_tpu.models.feedforward:JaxFeedForward",
+                              {})
+    job = meta.create_train_job(user["id"], "app", "IMAGE_CLASSIFICATION",
+                                budget, "/t", "/v", "RUNNING")
+    sub = meta.create_sub_train_job(job["id"], model["id"], "RUNNING")
+    return job, sub, model
+
+
+FAST_KNOBS = {"hidden_layer_count": 1, "hidden_layer_units": 16,
+              "learning_rate": 3e-3, "batch_size": 64, "max_epochs": 5}
+
+
+class _FixedAdvisor:
+    """Advisor stub proposing fixed fast knobs (keeps the test quick)."""
+
+    def __init__(self):
+        self.n = 0
+        self.feedbacks = []
+
+    def propose(self):
+        from rafiki_tpu.advisor.base import Proposal
+        self.n += 1
+        return Proposal(trial_no=self.n, knobs=dict(FAST_KNOBS))
+
+    def feedback(self, proposal, score):
+        self.feedbacks.append((proposal.trial_no, score))
+
+
+def test_runner_end_to_end(stores, synth_image_data):
+    meta, params = stores
+    train_path, val_path = synth_image_data
+    budget = {BudgetOption.MODEL_TRIAL_COUNT: 2}
+    job, sub, model = _mk_sub_job(meta, budget)
+    advisor = _FixedAdvisor()
+    runner = TrialRunner(JaxFeedForward, advisor, train_path, val_path,
+                         meta, params, sub["id"], model_id=model["id"],
+                         budget=budget)
+    done = runner.run()
+
+    assert len(done) == 2
+    completed = meta.get_trials(sub["id"], TrialStatus.COMPLETED)
+    assert len(completed) == 2
+    for t in completed:
+        assert t["score"] is not None and t["score"] > 0.3  # learnable synth
+        assert params.exists(t["params_id"])
+        assert t["knobs"]["hidden_layer_units"] == 16
+    assert [n for n, _ in advisor.feedbacks] == [1, 2]
+    # trial logs flowed through the model logger into the meta store
+    logs = meta.get_trial_logs(completed[0]["id"])
+    assert any(r["record"].get("type") == "plot" for r in logs)
+
+
+def test_runner_real_advisor_budget_and_best(stores, synth_image_data):
+    meta, params = stores
+    train_path, val_path = synth_image_data
+    budget = {BudgetOption.MODEL_TRIAL_COUNT: 2}
+    job, sub, model = _mk_sub_job(meta, budget)
+    knob_config = dict(JaxFeedForward.get_knob_config())
+    advisor = make_advisor(knob_config, seed=1)
+    runner = TrialRunner(JaxFeedForward, advisor, train_path, val_path,
+                         meta, params, sub["id"], model_id=model["id"],
+                         budget=budget)
+    runner.run()
+    best = meta.get_best_trials_of_train_job(job["id"], max_count=1)
+    assert best and best[0]["score"] == advisor.best()[1]
+
+
+def test_runner_records_error_and_continues(stores, synth_image_data):
+    meta, params = stores
+    train_path, val_path = synth_image_data
+
+    class Exploding(JaxFeedForward):
+        calls = [0]
+
+        def train(self, *a, **kw):
+            self.calls[0] += 1
+            if self.calls[0] == 1:
+                raise RuntimeError("injected failure")
+            super().train(*a, **kw)
+
+    budget = {BudgetOption.MODEL_TRIAL_COUNT: 1}
+    job, sub, model = _mk_sub_job(meta, budget)
+    advisor = _FixedAdvisor()
+    runner = TrialRunner(Exploding, advisor, train_path, val_path,
+                         meta, params, sub["id"], budget=budget)
+    runner.run()
+    trials = meta.get_trials(sub["id"])
+    statuses = [t["status"] for t in trials]
+    # first trial errored (recorded, loop continued), second completed
+    assert statuses.count(TrialStatus.ERRORED) == 1
+    assert statuses.count(TrialStatus.COMPLETED) == 1
+    errored = [t for t in trials if t["status"] == TrialStatus.ERRORED][0]
+    assert "injected failure" in errored["error"]
+
+
+def test_runner_stop_flag(stores, synth_image_data):
+    meta, params = stores
+    train_path, val_path = synth_image_data
+    job, sub, model = _mk_sub_job(meta, {BudgetOption.MODEL_TRIAL_COUNT: 50})
+    flag = threading.Event()
+    flag.set()  # stop before the first trial
+    runner = TrialRunner(JaxFeedForward, _FixedAdvisor(), train_path,
+                         val_path, meta, params, sub["id"],
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 50},
+                         stop_flag=flag)
+    assert runner.run() == []

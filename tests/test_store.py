@@ -1,0 +1,153 @@
+"""MetaStore + ParamStore unit tests (SURVEY.md §4: sqlite-backed)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.constants import (ParamsType, TrainJobStatus, TrialStatus)
+from rafiki_tpu.store import MetaStore, ParamStore
+
+
+@pytest.fixture()
+def meta():
+    m = MetaStore(":memory:")
+    yield m
+    m.close()
+
+
+@pytest.fixture()
+def pstore(tmp_path):
+    p = ParamStore(str(tmp_path / "params"))
+    yield p
+    p.close()
+
+
+def _mk_job(meta):
+    user = meta.create_user("dev@x.com", "hash", "MODEL_DEVELOPER")
+    model = meta.create_model(user["id"], "m1", "IMAGE_CLASSIFICATION",
+                              "pkg.mod:Cls", {"lr": {"kind": "float"}})
+    job = meta.create_train_job(user["id"], "app1", "IMAGE_CLASSIFICATION",
+                                {"MODEL_TRIAL_COUNT": 3}, "/t", "/v",
+                                TrainJobStatus.STARTED)
+    sub = meta.create_sub_train_job(job["id"], model["id"], "STARTED")
+    return user, model, job, sub
+
+
+class TestMetaStore:
+    def test_users(self, meta):
+        u = meta.create_user("a@b.c", "h", "ADMIN")
+        assert meta.get_user_by_email("a@b.c")["id"] == u["id"]
+        assert meta.get_user_by_email("missing@x.y") is None
+
+    def test_app_versioning(self, meta):
+        u = meta.create_user("a@b.c", "h", "ADMIN")
+        j1 = meta.create_train_job(u["id"], "app", "T", {}, "/t", "/v", "S")
+        j2 = meta.create_train_job(u["id"], "app", "T", {}, "/t", "/v", "S")
+        assert (j1["app_version"], j2["app_version"]) == (1, 2)
+        latest = meta.get_train_job_by_app(u["id"], "app")
+        assert latest["id"] == j2["id"]
+        assert meta.get_train_job_by_app(u["id"], "app", 1)["id"] == j1["id"]
+
+    def test_trial_lifecycle_and_best(self, meta):
+        _, model, job, sub = _mk_job(meta)
+        ids = []
+        for i, score in enumerate([0.5, 0.9, 0.7]):
+            t = meta.create_trial(sub["id"], model["id"], no=i + 1,
+                                  status=TrialStatus.RUNNING,
+                                  knobs={"lr": 0.1 * (i + 1)})
+            meta.mark_trial_completed(t["id"], score, params_id=f"p{i}")
+            ids.append(t["id"])
+        bad = meta.create_trial(sub["id"], model["id"], no=4,
+                                status=TrialStatus.RUNNING)
+        meta.mark_trial_errored(bad["id"], "boom")
+
+        trials = meta.get_trials(sub["id"])
+        assert len(trials) == 4
+        assert meta.get_trials(sub["id"], TrialStatus.COMPLETED)[0]["knobs"] \
+            == {"lr": 0.1}
+        best = meta.get_best_trials_of_train_job(job["id"], max_count=2)
+        assert [t["score"] for t in best] == [0.9, 0.7]
+        assert best[0]["params_id"] == "p1"
+
+    def test_trial_logs(self, meta):
+        _, model, _, sub = _mk_job(meta)
+        t = meta.create_trial(sub["id"], model["id"], no=1, status="RUNNING")
+        meta.add_trial_log(t["id"], {"type": "values", "values": {"loss": 1.0}})
+        meta.add_trial_log(t["id"], {"type": "values", "values": {"loss": 0.5}})
+        logs = meta.get_trial_logs(t["id"])
+        assert [r["record"]["values"]["loss"] for r in logs] == [1.0, 0.5]
+
+    def test_services_and_workers(self, meta):
+        _, _, job, sub = _mk_job(meta)
+        svc = meta.create_service("TRAIN", "RUNNING", chips=[0, 1, 2, 3])
+        meta.add_train_job_worker(svc["id"], sub["id"])
+        assert meta.get_service(svc["id"])["chips"] == [0, 1, 2, 3]
+        workers = meta.get_train_job_workers(sub["id"])
+        assert workers[0]["service_id"] == svc["id"]
+
+    def test_file_backed_cross_instance(self, tmp_path):
+        path = str(tmp_path / "meta.db")
+        m1 = MetaStore(path)
+        u = m1.create_user("x@y.z", "h", "ADMIN")
+        m2 = MetaStore(path)  # second process in real deployments
+        assert m2.get_user(u["id"])["email"] == "x@y.z"
+        m1.close()
+        m2.close()
+
+    def test_concurrent_trial_writes(self, meta):
+        _, model, _, sub = _mk_job(meta)
+
+        def writer(k):
+            for i in range(20):
+                t = meta.create_trial(sub["id"], model["id"],
+                                      no=k * 100 + i, status="RUNNING")
+                meta.mark_trial_completed(t["id"], 0.1, None)
+
+        threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert len(meta.get_trials(sub["id"], TrialStatus.COMPLETED)) == 80
+
+
+class TestParamStore:
+    def test_roundtrip(self, pstore):
+        params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                  "_meta/n_classes": np.asarray(10)}
+        pid = pstore.save(params, session_id="s", worker_id="w0", score=0.5)
+        out = pstore.load(pid)
+        np.testing.assert_array_equal(out["w"], params["w"])
+        # safetensors flattens 0-d arrays to shape (1,)
+        assert int(out["_meta/n_classes"].reshape(-1)[0]) == 10
+
+    def test_noncontiguous_ok(self, pstore):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4).T  # not C-contig
+        pid = pstore.save({"w": arr}, session_id="s")
+        np.testing.assert_array_equal(pstore.load(pid)["w"], arr)
+
+    def test_sharing_policies(self, pstore):
+        mk = lambda v: {"w": np.asarray([v], np.float32)}
+        pstore.save(mk(1.0), session_id="s", worker_id="w0", score=0.3)
+        pstore.save(mk(2.0), session_id="s", worker_id="w1", score=0.9)
+        pstore.save(mk(3.0), session_id="s", worker_id="w0", score=0.6)
+
+        assert pstore.retrieve(ParamsType.NONE, session_id="s") is None
+        got = pstore.retrieve(ParamsType.GLOBAL_RECENT, session_id="s")
+        assert float(got["w"][0]) == 3.0
+        got = pstore.retrieve(ParamsType.GLOBAL_BEST, session_id="s")
+        assert float(got["w"][0]) == 2.0
+        got = pstore.retrieve(ParamsType.LOCAL_BEST, session_id="s",
+                              worker_id="w0")
+        assert float(got["w"][0]) == 3.0
+        got = pstore.retrieve(ParamsType.LOCAL_RECENT, session_id="s",
+                              worker_id="w1")
+        assert float(got["w"][0]) == 2.0
+        # unseen session → cold start
+        assert pstore.retrieve(ParamsType.GLOBAL_BEST, session_id="zz") is None
+
+    def test_delete(self, pstore):
+        pid = pstore.save({"w": np.zeros(2, np.float32)}, session_id="s")
+        assert pstore.exists(pid)
+        pstore.delete(pid)
+        assert not pstore.exists(pid)
+        assert pstore.retrieve(ParamsType.GLOBAL_RECENT, session_id="s") is None
