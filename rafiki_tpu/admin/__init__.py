@@ -1,0 +1,12 @@
+"""Admin: the control plane.
+
+Parity: SURVEY.md §2 "Admin" + "ServicesManager / GPU scheduler"
+(upstream ``rafiki/admin/``). The REST frontend lives in
+``rafiki_tpu.admin.app``; orchestration in ``Admin``; service sizing and
+chip allocation in ``ServicesManager``.
+"""
+
+from .admin import Admin
+from .services_manager import ServicesManager
+
+__all__ = ["Admin", "ServicesManager"]

@@ -1,0 +1,289 @@
+"""Admin orchestration: users, models, train jobs, inference jobs.
+
+Parity: SURVEY.md §2 "Admin" + §3.1/§3.2 call stacks (upstream
+``rafiki/admin/admin.py``). The REST frontend (``rafiki_tpu.admin.app``)
+is a thin shell over this class; everything here is also directly usable
+in-process (the resident-runner deployment and the test seam).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from ..constants import (BudgetOption, InferenceJobStatus, ModelAccessRight,
+                         TrainJobStatus, TrialStatus, UserType)
+from ..model.knobs import knob_config_to_json
+from ..store import MetaStore, ParamStore
+from ..utils import auth
+from ..utils.model_loader import load_model_class
+from .services_manager import ServicesManager, normalize_budget
+
+_log = logging.getLogger(__name__)
+
+
+class Admin:
+    def __init__(self, meta: MetaStore, params: ParamStore,
+                 services: ServicesManager, jwt_secret: str = "rafiki-tpu",
+                 superadmin_email: str = "superadmin@rafiki",
+                 superadmin_password: str = "rafiki"):
+        self.meta = meta
+        self.params = params
+        self.services = services
+        self.jwt_secret = jwt_secret
+        if self.meta.get_user_by_email(superadmin_email) is None:
+            self.meta.create_user(
+                superadmin_email, auth.hash_password(superadmin_password),
+                UserType.SUPERADMIN)
+
+    # --- Auth / users ---
+
+    def authenticate(self, email: str, password: str) -> Dict[str, Any]:
+        user = self.meta.get_user_by_email(email)
+        if user is None or not auth.verify_password(password,
+                                                   user["password_hash"]):
+            raise PermissionError("invalid email or password")
+        if user["banned_at"] is not None:
+            raise PermissionError("user is banned")
+        token = auth.encode_token(
+            {"user_id": user["id"], "user_type": user["user_type"]},
+            self.jwt_secret)
+        return {"user_id": user["id"], "user_type": user["user_type"],
+                "token": token}
+
+    def authorize(self, token: str) -> Dict[str, Any]:
+        try:
+            return auth.decode_token(token, self.jwt_secret)
+        except ValueError as e:
+            raise PermissionError(f"invalid token: {e}")
+
+    def create_user(self, email: str, password: str,
+                    user_type: str) -> Dict[str, Any]:
+        user = self.meta.create_user(email, auth.hash_password(password),
+                                     user_type)
+        return {"id": user["id"], "email": email, "user_type": user_type}
+
+    # --- Access control ---
+
+    @staticmethod
+    def check_access(claims: Optional[Dict[str, Any]],
+                     owner_user_id: str) -> None:
+        """Resource-level authorization: the owner, or a platform admin.
+
+        ``claims=None`` means an in-process trusted caller (resident
+        runner / tests); the REST layer always passes the token claims.
+        """
+        if claims is None:
+            return
+        if claims.get("user_id") == owner_user_id:
+            return
+        if claims.get("user_type") in (UserType.SUPERADMIN, UserType.ADMIN):
+            return
+        err = PermissionError("not the owner of this resource")
+        err.status = 403  # the REST layer maps this to Forbidden, not 401
+        raise err
+
+    def _owned_train_job(self, train_job_id: str,
+                         claims: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        job = self.meta.get_train_job(train_job_id)
+        if job is None:
+            raise ValueError(f"unknown train job {train_job_id}")
+        self.check_access(claims, job["user_id"])
+        return job
+
+    def _owned_inference_job(self, job_id: str,
+                             claims: Optional[Dict[str, Any]],
+                             ) -> Dict[str, Any]:
+        job = self.meta.get_inference_job(job_id)
+        if job is None:
+            raise ValueError(f"unknown inference job {job_id}")
+        self.check_access(claims, job["user_id"])
+        return job
+
+    # --- Models ---
+
+    def create_model(self, user_id: str, name: str, task: str,
+                     model_class: str, model_source: Optional[str] = None,
+                     dependencies: Optional[Dict[str, str]] = None,
+                     access_right: str = ModelAccessRight.PRIVATE,
+                     ) -> Dict[str, Any]:
+        # Resolve now: a model that doesn't import/declare knobs must be
+        # rejected at upload, not at trial time.
+        cls = load_model_class(model_class, model_source)
+        knob_config = knob_config_to_json(cls.get_knob_config())
+        row = self.meta.create_model(
+            user_id, name, task, model_class, knob_config,
+            model_source=model_source, dependencies=dependencies,
+            access_right=access_right)
+        return {"id": row["id"], "name": name, "task": task}
+
+    def get_models(self, user_id: str,
+                   task: Optional[str] = None) -> List[Dict[str, Any]]:
+        return [_public_model(m) for m in self.meta.get_models(user_id, task)]
+
+    # --- Train jobs (§3.1) ---
+
+    def create_train_job(self, user_id: str, app: str, task: str,
+                         model_ids: List[str], budget: Dict[str, Any],
+                         train_dataset_path: str, val_dataset_path: str,
+                         ) -> Dict[str, Any]:
+        budget = normalize_budget(budget)
+        budget.setdefault(BudgetOption.MODEL_TRIAL_COUNT, 5)
+        if not model_ids:
+            raise ValueError("model_ids must be non-empty")
+        # Validate everything BEFORE inserting rows: a failed validation
+        # must not leave an orphaned STARTED job burning the app-version.
+        for model_id in model_ids:
+            model = self.meta.get_model(model_id)
+            if model is None:
+                raise ValueError(f"unknown model {model_id}")
+            if model["task"] != task:
+                raise ValueError(
+                    f"model {model['name']} is for task {model['task']}, "
+                    f"not {task}")
+        job = self.meta.create_train_job(
+            user_id, app, task, budget, train_dataset_path,
+            val_dataset_path, TrainJobStatus.STARTED)
+        for model_id in model_ids:
+            self.meta.create_sub_train_job(job["id"], model_id, "STARTED")
+        self.services.create_train_services(job["id"])
+        self.meta.update_train_job(job["id"], status=TrainJobStatus.RUNNING)
+        return {"id": job["id"], "app": job["app"],
+                "app_version": job["app_version"]}
+
+    def get_train_job(self, train_job_id: str,
+                      claims: Optional[Dict[str, Any]] = None,
+                      ) -> Dict[str, Any]:
+        job = self._owned_train_job(train_job_id, claims)
+        self._refresh_train_job_status(job)
+        job = self.meta.get_train_job(train_job_id)
+        subs = []
+        for sub in self.meta.get_sub_train_jobs(train_job_id):
+            trials = self.meta.get_trials(sub["id"])
+            subs.append({
+                "id": sub["id"], "model_id": sub["model_id"],
+                "n_trials": len(trials),
+                "n_completed": sum(t["status"] == TrialStatus.COMPLETED
+                                   for t in trials),
+                "n_errored": sum(t["status"] == TrialStatus.ERRORED
+                                 for t in trials),
+            })
+        return {"id": job["id"], "app": job["app"],
+                "app_version": job["app_version"], "task": job["task"],
+                "status": job["status"], "budget": job["budget"],
+                "sub_train_jobs": subs}
+
+    def _refresh_train_job_status(self, job: Dict[str, Any]) -> None:
+        if job["status"] != TrainJobStatus.RUNNING:
+            return
+        if not self.services.train_services_active(job["id"]):
+            # Budget exhausted and every worker wound down on its own:
+            # tear the services down (releases their chip ranges).
+            self.services.stop_train_services(job["id"])
+            self.meta.update_train_job(job["id"],
+                                       status=TrainJobStatus.STOPPED,
+                                       stopped_at=time.time())
+
+    def get_train_jobs(self, user_id: str) -> List[Dict[str, Any]]:
+        return [{"id": j["id"], "app": j["app"],
+                 "app_version": j["app_version"], "status": j["status"]}
+                for j in self.meta.get_train_jobs(user_id)]
+
+    def stop_train_job(self, train_job_id: str,
+                       claims: Optional[Dict[str, Any]] = None) -> None:
+        self._owned_train_job(train_job_id, claims)
+        self.services.stop_train_services(train_job_id)
+        self.meta.update_train_job(train_job_id,
+                                   status=TrainJobStatus.STOPPED,
+                                   stopped_at=time.time())
+
+    def get_best_trials(self, train_job_id: str, max_count: int = 2,
+                        claims: Optional[Dict[str, Any]] = None,
+                        ) -> List[Dict[str, Any]]:
+        self._owned_train_job(train_job_id, claims)
+        return [_public_trial(t) for t in
+                self.meta.get_best_trials_of_train_job(train_job_id,
+                                                       max_count)]
+
+    def get_trials(self, train_job_id: str,
+                   claims: Optional[Dict[str, Any]] = None,
+                   ) -> List[Dict[str, Any]]:
+        self._owned_train_job(train_job_id, claims)
+        return [_public_trial(t) for t in
+                self.meta.get_trials_of_train_job(train_job_id)]
+
+    def get_trial_logs(self, trial_id: str,
+                       claims: Optional[Dict[str, Any]] = None,
+                       ) -> List[Dict[str, Any]]:
+        trial = self.meta.get_trial(trial_id)
+        if trial is None:
+            raise ValueError(f"unknown trial {trial_id}")
+        if claims is not None:
+            sub = self.meta.get_sub_train_job(trial["sub_train_job_id"])
+            self._owned_train_job(sub["train_job_id"], claims)
+        return self.meta.get_trial_logs(trial_id)
+
+    def wait_until_train_job_done(self, train_job_id: str,
+                                  timeout: float = 3600.0,
+                                  poll: float = 1.0) -> bool:
+        """Block until every train worker stops; False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self.services.train_services_active(train_job_id):
+                job = self.meta.get_train_job(train_job_id)
+                self._refresh_train_job_status(job)
+                return True
+            time.sleep(poll)
+        return False
+
+    # --- Inference jobs (§3.2) ---
+
+    def create_inference_job(self, user_id: str, train_job_id: str,
+                             max_models: int = 2,
+                             claims: Optional[Dict[str, Any]] = None,
+                             ) -> Dict[str, Any]:
+        self._owned_train_job(train_job_id, claims)
+        best = self.meta.get_best_trials_of_train_job(train_job_id,
+                                                      max_models)
+        if not best:
+            raise ValueError(
+                f"train job {train_job_id} has no completed trials")
+        inf = self.meta.create_inference_job(user_id, train_job_id,
+                                             InferenceJobStatus.STARTED)
+        try:
+            self.services.create_inference_services(
+                inf["id"], [t["id"] for t in best])
+        except Exception:
+            self.meta.update_inference_job(inf["id"],
+                                           status=InferenceJobStatus.ERRORED)
+            raise
+        self.meta.update_inference_job(inf["id"],
+                                       status=InferenceJobStatus.RUNNING)
+        return {"id": inf["id"], "train_job_id": train_job_id,
+                "trial_ids": [t["id"] for t in best]}
+
+    def get_inference_job(self, inference_job_id: str,
+                          claims: Optional[Dict[str, Any]] = None,
+                          ) -> Dict[str, Any]:
+        return dict(self._owned_inference_job(inference_job_id, claims))
+
+    def stop_inference_job(self, inference_job_id: str,
+                           claims: Optional[Dict[str, Any]] = None) -> None:
+        self._owned_inference_job(inference_job_id, claims)
+        self.services.stop_inference_services(inference_job_id)
+        self.meta.update_inference_job(inference_job_id,
+                                       status=InferenceJobStatus.STOPPED,
+                                       stopped_at=time.time())
+
+
+def _public_model(m: Dict[str, Any]) -> Dict[str, Any]:
+    return {"id": m["id"], "name": m["name"], "task": m["task"],
+            "model_class": m["model_class"],
+            "access_right": m["access_right"]}
+
+
+def _public_trial(t: Dict[str, Any]) -> Dict[str, Any]:
+    return {"id": t["id"], "no": t["no"], "score": t["score"],
+            "knobs": t["knobs"], "status": t["status"],
+            "params_id": t["params_id"]}

@@ -1,0 +1,155 @@
+"""Admin REST API: the upstream-shaped HTTP surface over ``Admin``.
+
+Parity: SURVEY.md §2 "Admin" (upstream Flask ``app.py`` routes). Kept
+route-compatible so reference quickstart scripts port 1:1:
+
+- ``POST /tokens``                   login → ``{user_id, user_type, token}``
+- ``POST /users``                    (admin) create user
+- ``POST /models``                   register model (source or class path)
+- ``GET  /models``                   list visible models
+- ``POST /train_jobs``               create train job
+- ``GET  /train_jobs/<id>``          job detail + per-model progress
+- ``POST /train_jobs/<id>/stop``     stop workers
+- ``GET  /train_jobs/<id>/trials``   ``?type=best&max_count=k`` or all
+- ``GET  /trials/<id>/logs``         TrialLog rows
+- ``POST /inference_jobs``           deploy best trials behind a predictor
+- ``GET  /inference_jobs/<id>``      incl. ``predictor_host``
+- ``POST /inference_jobs/<id>/stop``
+
+Auth: ``Authorization: Bearer <jwt>`` on everything but ``POST /tokens``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..constants import UserType
+from ..utils.service import HttpError, JsonHttpServer
+from .admin import Admin
+
+_WRITE_TYPES = {UserType.SUPERADMIN, UserType.ADMIN,
+                UserType.MODEL_DEVELOPER, UserType.APP_DEVELOPER}
+
+
+class AdminApp:
+    def __init__(self, admin: Admin, host: str = "0.0.0.0", port: int = 0):
+        self.admin = admin
+        self._http = JsonHttpServer([
+            ("POST", "/tokens", self._login),
+            ("POST", "/users", self._create_user),
+            ("POST", "/models", self._create_model),
+            ("GET", "/models", self._list_models),
+            ("POST", "/train_jobs", self._create_train_job),
+            ("GET", "/train_jobs/<job_id>", self._get_train_job),
+            ("POST", "/train_jobs/<job_id>/stop", self._stop_train_job),
+            ("GET", "/train_jobs/<job_id>/trials", self._get_trials),
+            ("GET", "/trials/<trial_id>/logs", self._get_trial_logs),
+            ("POST", "/inference_jobs", self._create_inference_job),
+            ("GET", "/inference_jobs/<job_id>", self._get_inference_job),
+            ("POST", "/inference_jobs/<job_id>/stop",
+             self._stop_inference_job),
+        ], host=host, port=port, name="admin")
+        self.port = self._http.port
+
+    def start(self) -> "AdminApp":
+        self._http.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.stop()
+
+    # --- Auth helpers ---
+
+    def _auth(self, ctx, *allowed: str) -> Dict[str, Any]:
+        token = ctx.bearer_token
+        if token is None:
+            raise HttpError(401, "missing bearer token")
+        claims = self.admin.authorize(token)
+        if allowed and claims["user_type"] not in allowed:
+            raise HttpError(403,
+                            f"requires one of {sorted(allowed)}")
+        return claims
+
+    @staticmethod
+    def _need(body: Optional[Dict[str, Any]], *keys: str) -> Dict[str, Any]:
+        if body is None:
+            raise HttpError(400, "missing JSON body")
+        missing = [k for k in keys if k not in body]
+        if missing:
+            raise HttpError(400, f"missing fields: {missing}")
+        return body
+
+    # --- Routes ---
+
+    def _login(self, params, body, ctx):
+        body = self._need(body, "email", "password")
+        return 200, self.admin.authenticate(body["email"], body["password"])
+
+    def _create_user(self, params, body, ctx):
+        self._auth(ctx, UserType.SUPERADMIN, UserType.ADMIN)
+        body = self._need(body, "email", "password", "user_type")
+        return 201, self.admin.create_user(body["email"], body["password"],
+                                           body["user_type"])
+
+    def _create_model(self, params, body, ctx):
+        claims = self._auth(ctx, UserType.SUPERADMIN, UserType.ADMIN,
+                            UserType.MODEL_DEVELOPER)
+        body = self._need(body, "name", "task", "model_class")
+        return 201, self.admin.create_model(
+            claims["user_id"], body["name"], body["task"],
+            body["model_class"], model_source=body.get("model_source"),
+            dependencies=body.get("dependencies"),
+            access_right=body.get("access_right", "PRIVATE"))
+
+    def _list_models(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_models(claims["user_id"],
+                                          task=ctx.query_one("task"))
+
+    def _create_train_job(self, params, body, ctx):
+        claims = self._auth(ctx)
+        body = self._need(body, "app", "task", "model_ids",
+                          "train_dataset_path", "val_dataset_path")
+        return 201, self.admin.create_train_job(
+            claims["user_id"], body["app"], body["task"], body["model_ids"],
+            body.get("budget", {}), body["train_dataset_path"],
+            body["val_dataset_path"])
+
+    def _get_train_job(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_train_job(params["job_id"], claims=claims)
+
+    def _stop_train_job(self, params, body, ctx):
+        claims = self._auth(ctx)
+        self.admin.stop_train_job(params["job_id"], claims=claims)
+        return 200, {"stopped": params["job_id"]}
+
+    def _get_trials(self, params, body, ctx):
+        claims = self._auth(ctx)
+        if ctx.query_one("type") == "best":
+            max_count = int(ctx.query_one("max_count", "2"))
+            return 200, self.admin.get_best_trials(params["job_id"],
+                                                   max_count, claims=claims)
+        return 200, self.admin.get_trials(params["job_id"], claims=claims)
+
+    def _get_trial_logs(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_trial_logs(params["trial_id"],
+                                              claims=claims)
+
+    def _create_inference_job(self, params, body, ctx):
+        claims = self._auth(ctx)
+        body = self._need(body, "train_job_id")
+        return 201, self.admin.create_inference_job(
+            claims["user_id"], body["train_job_id"],
+            max_models=int(body.get("max_models", 2)), claims=claims)
+
+    def _get_inference_job(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_inference_job(params["job_id"],
+                                                 claims=claims)
+
+    def _stop_inference_job(self, params, body, ctx):
+        claims = self._auth(ctx)
+        self.admin.stop_inference_job(params["job_id"], claims=claims)
+        return 200, {"stopped": params["job_id"]}

@@ -1,0 +1,285 @@
+"""ServicesManager: service sizing + TPU chip-range scheduling.
+
+Parity: SURVEY.md §2 "ServicesManager / GPU scheduler" + §3.1/§3.2 — the
+upstream manager decides how many worker services each job gets and which
+GPUs each sees (``CUDA_VISIBLE_DEVICES``). Here the resource is **chip
+ranges**: ``ChipAllocator`` carves ``jax.devices()`` into contiguous
+groups, each service env carries ``RAFIKI_TPU_CHIPS``, and workers build
+their Mesh from exactly that range (BASELINE north star: "Admin's GPU
+scheduler retargeted to allocate TPU chip ranges").
+
+Budget semantics (upstream keys, TPU vocabulary):
+- ``MODEL_TRIAL_COUNT``: total trials per model (enforced by TrialRunner).
+- ``CHIP_COUNT``: chips to dedicate per model's search. Workers =
+  ``ceil(CHIP_COUNT / CHIPS_PER_TRIAL)``; 0 → one worker on one chip.
+- ``CHIPS_PER_TRIAL``: chip-group size per worker (intra-trial dp/tp
+  parallelism; default 1).
+- ``GPU_COUNT`` is accepted as an alias of ``CHIP_COUNT`` so reference
+  client scripts run unchanged.
+
+Bookkeeping: every service a job owns — train workers AND the advisor,
+inference workers AND the predictor — is recorded in the job's worker
+mapping table, so stop/supervise walk one list instead of guessing.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Dict, List, Optional
+
+from ..constants import (BudgetOption, EnvVars, ServiceStatus, ServiceType)
+from ..container.manager import ContainerManager
+from ..parallel.chips import ChipAllocator
+from ..store import MetaStore
+
+_log = logging.getLogger(__name__)
+
+CHIPS_PER_TRIAL = "CHIPS_PER_TRIAL"
+
+# trial_id recorded for an inference job's predictor service row
+PREDICTOR_TRIAL = "__predictor__"
+
+_ACTIVE = (ServiceStatus.STARTED, ServiceStatus.DEPLOYING,
+           ServiceStatus.RUNNING)
+
+
+def normalize_budget(budget: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    b = dict(budget or {})
+    if BudgetOption.GPU_COUNT in b and BudgetOption.CHIP_COUNT not in b:
+        b[BudgetOption.CHIP_COUNT] = b.pop(BudgetOption.GPU_COUNT)
+    b.pop(BudgetOption.GPU_COUNT, None)
+    return b
+
+
+class ServicesManager:
+    def __init__(self, meta: MetaStore, container: ContainerManager,
+                 allocator: Optional[ChipAllocator] = None,
+                 meta_uri: str = ":memory:", params_dir: str = "",
+                 bus_uri: str = ""):
+        self.meta = meta
+        self.container = container
+        self.allocator = allocator or ChipAllocator()
+        # URIs injected into service envs (subprocess mode needs them;
+        # thread mode ignores them and uses the shared context).
+        self.meta_uri = meta_uri
+        self.params_dir = params_dir
+        self.bus_uri = bus_uri
+
+    # --- Launch plumbing ---
+
+    def _launch(self, service_type: str, extra_env: Dict[str, str],
+                chips: Optional[List[int]] = None) -> Dict[str, Any]:
+        svc = self.meta.create_service(service_type,
+                                       ServiceStatus.DEPLOYING, chips=chips)
+        env = {
+            EnvVars.META_URI: self.meta_uri,
+            EnvVars.PARAMS_DIR: self.params_dir,
+            EnvVars.BUS_URI: self.bus_uri,
+            EnvVars.SERVICE_ID: svc["id"],
+            EnvVars.SERVICE_TYPE: service_type,
+        }
+        if chips is not None:
+            env[EnvVars.CHIPS] = ",".join(str(c) for c in chips)
+        env.update(extra_env)
+        try:
+            container_id = self.container.create_service(svc["id"], env)
+        except Exception:
+            self.meta.update_service(svc["id"], status=ServiceStatus.ERRORED)
+            raise
+        self.meta.update_service(svc["id"], container_id=container_id)
+        return self.meta.get_service(svc["id"])
+
+    def _stop_service(self, service_id: str) -> None:
+        svc = self.meta.get_service(service_id)
+        if svc is None:
+            return
+        self.container.destroy_service(svc["container_id"] or service_id)
+        if svc["status"] in _ACTIVE:
+            self.meta.update_service(service_id, status=ServiceStatus.STOPPED)
+        self._release_chips_of(svc)
+
+    def _alloc_name(self, service_id: str) -> str:
+        return f"svc:{service_id}"
+
+    def _release_chips_of(self, svc: Dict[str, Any]) -> None:
+        self.allocator.release(self._alloc_name(svc["id"]))
+
+    # --- Train services (§3.1) ---
+
+    def create_train_services(self, train_job_id: str) -> List[Dict[str, Any]]:
+        job = self.meta.get_train_job(train_job_id)
+        budget = normalize_budget(job["budget"])
+        chips_per_trial = max(1, int(budget.get(CHIPS_PER_TRIAL, 1)))
+        chip_count = int(budget.get(BudgetOption.CHIP_COUNT, 0) or 0)
+        n_workers = max(1, math.ceil(chip_count / chips_per_trial))
+
+        services = []
+        for sub in self.meta.get_sub_train_jobs(train_job_id):
+            advisor_svc = self._launch(
+                ServiceType.ADVISOR, {EnvVars.SUB_TRAIN_JOB_ID: sub["id"]})
+            self.meta.add_train_job_worker(advisor_svc["id"], sub["id"])
+            services.append(advisor_svc)
+            launched = 0
+            for _ in range(n_workers):
+                svc = self._launch_train_worker(sub["id"], chips_per_trial)
+                if svc is None:
+                    # Slice is full: run with what we got (≥1); trials
+                    # queue behind fewer workers rather than failing.
+                    _log.warning(
+                        "chip allocation exhausted for %s after %d workers",
+                        sub["id"], launched)
+                    break
+                services.append(svc)
+                launched += 1
+            if launched == 0:
+                self._stop_service(advisor_svc["id"])
+                raise RuntimeError(
+                    f"no chips available for train job {train_job_id}")
+        return services
+
+    def _launch_train_worker(self, sub_id: str, chips_per_trial: int,
+                             ) -> Optional[Dict[str, Any]]:
+        svc_row = self.meta.create_service(ServiceType.TRAIN,
+                                           ServiceStatus.DEPLOYING)
+        group = self.allocator.allocate(chips_per_trial,
+                                        name=self._alloc_name(svc_row["id"]))
+        if group is None:
+            self.meta.update_service(svc_row["id"],
+                                     status=ServiceStatus.STOPPED)
+            return None
+        chips = list(group.indices)
+        env = {
+            EnvVars.META_URI: self.meta_uri,
+            EnvVars.PARAMS_DIR: self.params_dir,
+            EnvVars.BUS_URI: self.bus_uri,
+            EnvVars.SERVICE_ID: svc_row["id"],
+            EnvVars.SERVICE_TYPE: ServiceType.TRAIN,
+            EnvVars.SUB_TRAIN_JOB_ID: sub_id,
+            EnvVars.CHIPS: ",".join(str(c) for c in chips),
+        }
+        try:
+            container_id = self.container.create_service(svc_row["id"], env)
+        except Exception:
+            self.allocator.release(self._alloc_name(svc_row["id"]))
+            self.meta.update_service(svc_row["id"],
+                                     status=ServiceStatus.ERRORED)
+            raise
+        self.meta.update_service(svc_row["id"], container_id=container_id,
+                                 chips=chips)
+        self.meta.add_train_job_worker(svc_row["id"], sub_id)
+        return self.meta.get_service(svc_row["id"])
+
+    def stop_train_services(self, train_job_id: str) -> None:
+        for sub in self.meta.get_sub_train_jobs(train_job_id):
+            for w in self.meta.get_train_job_workers(sub["id"]):
+                self._stop_service(w["service_id"])
+
+    def train_services_active(self, train_job_id: str) -> bool:
+        """True while any TRAIN worker of the job is alive."""
+        for sub in self.meta.get_sub_train_jobs(train_job_id):
+            for w in self.meta.get_train_job_workers(sub["id"]):
+                svc = self.meta.get_service(w["service_id"])
+                if svc["service_type"] != ServiceType.TRAIN:
+                    continue
+                if svc["status"] in _ACTIVE and self.container.service_alive(
+                        svc["container_id"] or svc["id"]):
+                    return True
+        return False
+
+    # --- Inference services (§3.2) ---
+
+    def create_inference_services(self, inference_job_id: str,
+                                  trial_ids: List[str],
+                                  chips_per_worker: int = 1,
+                                  ) -> List[Dict[str, Any]]:
+        services = []
+        for trial_id in trial_ids:
+            svc_row = self.meta.create_service(ServiceType.INFERENCE,
+                                               ServiceStatus.DEPLOYING)
+            group = self.allocator.allocate(
+                chips_per_worker, name=self._alloc_name(svc_row["id"]))
+            if group is None:
+                # A worker without an allocation would fall back to ALL
+                # devices and trample running jobs' chip groups; fail the
+                # deploy and release what we launched so far instead.
+                self.meta.update_service(svc_row["id"],
+                                         status=ServiceStatus.ERRORED)
+                for launched in services:
+                    self._stop_service(launched["id"])
+                raise RuntimeError(
+                    f"no chips available for inference job "
+                    f"{inference_job_id} (need {chips_per_worker}/worker; "
+                    f"{self.allocator.free_chips} free)")
+            chips = list(group.indices)
+            env = {
+                EnvVars.META_URI: self.meta_uri,
+                EnvVars.PARAMS_DIR: self.params_dir,
+                EnvVars.BUS_URI: self.bus_uri,
+                EnvVars.SERVICE_ID: svc_row["id"],
+                EnvVars.SERVICE_TYPE: ServiceType.INFERENCE,
+                EnvVars.INFERENCE_JOB_ID: inference_job_id,
+                EnvVars.TRIAL_ID: trial_id,
+            }
+            if chips is not None:
+                env[EnvVars.CHIPS] = ",".join(str(c) for c in chips)
+            try:
+                container_id = self.container.create_service(svc_row["id"],
+                                                             env)
+            except Exception:
+                self.allocator.release(self._alloc_name(svc_row["id"]))
+                self.meta.update_service(svc_row["id"],
+                                         status=ServiceStatus.ERRORED)
+                raise
+            self.meta.update_service(svc_row["id"],
+                                     container_id=container_id, chips=chips)
+            self.meta.add_inference_job_worker(svc_row["id"],
+                                               inference_job_id, trial_id)
+            services.append(self.meta.get_service(svc_row["id"]))
+        predictor = self._launch(
+            ServiceType.PREDICT,
+            {EnvVars.INFERENCE_JOB_ID: inference_job_id})
+        self.meta.add_inference_job_worker(predictor["id"], inference_job_id,
+                                           PREDICTOR_TRIAL)
+        services.append(predictor)
+        return services
+
+    def stop_inference_services(self, inference_job_id: str) -> None:
+        for w in self.meta.get_inference_job_workers(inference_job_id):
+            self._stop_service(w["service_id"])
+
+    # --- Supervision (SURVEY.md §5: failure detection / recovery) ---
+
+    def supervise(self) -> List[str]:
+        """One sweep: mark dead services ERRORED, restart train workers.
+
+        Trial rows are idempotent (a crashed trial stays ERRORED; the
+        advisor re-proposes), so recovery is a fresh worker on the same
+        chip range. Returns the ids of restarted services.
+        """
+        restarted = []
+        for svc in self.meta.get_services(status=ServiceStatus.RUNNING):
+            if self.container.service_alive(svc["container_id"] or svc["id"]):
+                continue
+            self.meta.update_service(svc["id"], status=ServiceStatus.ERRORED)
+            self._release_chips_of(svc)
+            if svc["service_type"] != ServiceType.TRAIN:
+                continue
+            rows = self.meta._select(
+                "SELECT * FROM train_job_workers WHERE service_id = ?",
+                (svc["id"],))
+            if not rows:
+                continue
+            sub_id = rows[0]["sub_train_job_id"]
+            new_svc = self._launch_train_worker(
+                sub_id, chips_per_trial=len(svc.get("chips") or [1]))
+            if new_svc is not None:
+                restarted.append(new_svc["id"])
+                _log.warning("restarted dead train worker %s as %s",
+                             svc["id"][:8], new_svc["id"][:8])
+        return restarted
+
+    # --- Utilization (BASELINE north star: ≥90% chip utilization) ---
+
+    def chip_utilization(self) -> float:
+        return self.allocator.utilization()

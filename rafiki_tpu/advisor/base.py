@@ -50,9 +50,16 @@ class Proposal:
 class BaseAdvisor:
     """Base search strategy. Thread-safe: one advisor serves many workers."""
 
-    def __init__(self, knob_config: KnobConfig, seed: int = 0):
+    def __init__(self, knob_config: KnobConfig, seed: int = 0,
+                 total_trials: Optional[int] = None):
         self.knob_config = knob_config
         self.rng = np.random.default_rng(seed)
+        # Proposal-issuance cap: the advisor is the single coordinator for
+        # many workers, so enforcing MODEL_TRIAL_COUNT here (not in each
+        # worker's loop) is what keeps N parallel workers from racing past
+        # the budget. forget() refunds a slot so errored trials re-propose.
+        self.total_trials = total_trials
+        self._forgotten = 0
         self._lock = threading.RLock()
         self._trial_no = 0
         self._history: List[Tuple[Knobs, float]] = []
@@ -60,8 +67,11 @@ class BaseAdvisor:
 
     # --- Public API (TrainWorker-facing) ---
 
-    def propose(self) -> Proposal:
+    def propose(self) -> Optional[Proposal]:
         with self._lock:
+            if self.total_trials is not None and \
+                    self._trial_no - self._forgotten >= self.total_trials:
+                return None
             self._trial_no += 1
             knobs = self._propose_knobs(self._trial_no)
             knobs = self._fill_policies(knobs, self._trial_no)
@@ -77,8 +87,10 @@ class BaseAdvisor:
 
     def forget(self, proposal: Proposal) -> None:
         """Discard a proposal whose trial will never report a score
-        (errored/abandoned), releasing any per-proposal state."""
+        (errored/abandoned): refunds its budget slot and releases any
+        per-proposal state."""
         with self._lock:
+            self._forgotten += 1
             self._forget(proposal)
 
     def best(self) -> Optional[Tuple[Knobs, float]]:

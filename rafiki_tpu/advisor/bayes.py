@@ -14,7 +14,7 @@ gradient ascent, and its cost is trivial next to a trial's train time.
 from __future__ import annotations
 
 import warnings
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -28,8 +28,9 @@ class BayesOptAdvisor(BaseAdvisor):
 
     def __init__(self, knob_config: KnobConfig, seed: int = 0,
                  n_initial: int = 5, n_candidates: int = 1024,
-                 exploration: float = 0.01):
-        super().__init__(knob_config, seed)
+                 exploration: float = 0.01,
+                 total_trials: Optional[int] = None):
+        super().__init__(knob_config, seed, total_trials=total_trials)
         self.dims = searchable_dims(knob_config)
         self.n_initial = max(2, n_initial)
         self.n_candidates = n_candidates

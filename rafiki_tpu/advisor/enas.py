@@ -72,7 +72,7 @@ class EnasAdvisor(BaseAdvisor):
                  final_train_frac: float = 0.15,
                  lr: float = 3e-3, entropy_weight: float = 1e-3,
                  baseline_decay: float = 0.7):
-        super().__init__(knob_config, seed)
+        super().__init__(knob_config, seed, total_trials=total_trials)
         arch_items = [(n, k) for n, k in knob_config.items()
                       if isinstance(k, ArchKnob)]
         if len(arch_items) != 1:
@@ -146,7 +146,11 @@ class EnasAdvisor(BaseAdvisor):
         if not self.total_trials:
             return False
         n_final = max(1, int(self.total_trials * self.final_train_frac))
-        return trial_no > self.total_trials - n_final
+        # Effective position, not raw trial_no: forget() refunds errored
+        # trials' budget slots, and a refunded slot must resume the
+        # exploration phase rather than landing in the final-retrain tail.
+        effective = trial_no - self._forgotten
+        return effective > self.total_trials - n_final
 
     # --- BaseAdvisor hooks ---
 

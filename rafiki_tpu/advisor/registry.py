@@ -30,11 +30,9 @@ def make_advisor(knob_config: KnobConfig, seed: int = 0,
         if cls is None:
             raise ValueError(f"Unknown advisor type: {advisor_type!r}; "
                              f"one of {sorted(ADVISOR_TYPES)}")
-        if cls is EnasAdvisor:
-            return EnasAdvisor(knob_config, seed, total_trials=total_trials)
-        return cls(knob_config, seed)
+        return cls(knob_config, seed, total_trials=total_trials)
     if any(isinstance(k, ArchKnob) for k in knob_config.values()):
         return EnasAdvisor(knob_config, seed, total_trials=total_trials)
     if searchable_dims(knob_config) > 0:
-        return BayesOptAdvisor(knob_config, seed)
-    return RandomAdvisor(knob_config, seed)
+        return BayesOptAdvisor(knob_config, seed, total_trials=total_trials)
+    return RandomAdvisor(knob_config, seed, total_trials=total_trials)

@@ -1,0 +1,32 @@
+"""Message bus: queues + key-value registry for cross-service traffic.
+
+Parity: SURVEY.md §2 "Cache / queues" + §2.10 — the reference moves
+queries, predictions, and advisor↔worker traffic through Redis over the
+docker overlay network. No Redis server exists in this environment, so the
+bus is first-party: one wire-compatible interface with two backends —
+
+- ``MemoryBus``: in-process (threads share one object); tests, the
+  resident-runner deployment mode, and single-host jobs.
+- ``BusClient`` → ``BusServer``: a small stdlib TCP broker
+  (length-prefixed JSON frames, blocking pops via condition variables) for
+  multi-process / multi-host deployments over DCN. Device-side collectives
+  never touch this path — XLA moves tensors over ICI; the bus carries
+  control-plane JSON and (base64) query payloads only.
+"""
+
+from .base import BaseBus
+from .memory import MemoryBus
+from .tcp import BusClient, BusServer
+
+__all__ = ["BaseBus", "MemoryBus", "BusClient", "BusServer", "connect"]
+
+
+def connect(uri: str = "") -> BaseBus:
+    """Open a bus from a URI: ``""``/``"memory://"`` → process-local
+    singleton MemoryBus; ``"tcp://host:port"`` → broker client."""
+    if not uri or uri.startswith("memory://"):
+        return MemoryBus.shared()
+    if uri.startswith("tcp://"):
+        host, _, port = uri[len("tcp://"):].partition(":")
+        return BusClient(host or "127.0.0.1", int(port or 6380))
+    raise ValueError(f"unsupported bus uri: {uri!r}")

@@ -1,0 +1,219 @@
+"""TCP broker backend: a first-party Redis-shaped queue server.
+
+Wire format: 4-byte big-endian length + UTF-8 JSON frame, both directions.
+Request: ``{"op": ..., ...}``; response: ``{"ok": true, "value": ...}`` or
+``{"ok": false, "error": ...}``. The server wraps a ``MemoryBus``, so both
+backends share queue semantics exactly; blocking pops hold only the
+handler's thread (ThreadingTCPServer, one thread per connection).
+
+The client keeps one socket per calling thread (``threading.local``) so a
+blocked ``pop`` in one thread never serialises another thread's traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, List, Optional
+
+from .base import BaseBus
+from .memory import MemoryBus
+
+_HDR = struct.Struct(">I")
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("bus peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if length > _MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return json.loads(_recv_exact(sock, length))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        bus: MemoryBus = self.server.bus  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                req = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                return
+            try:
+                resp = {"ok": True, "value": self._dispatch(bus, req)}
+            except Exception as e:  # report, keep the connection alive
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            try:
+                _send_frame(sock, resp)
+            except (ConnectionError, OSError):
+                return
+
+    @staticmethod
+    def _dispatch(bus: MemoryBus, req: dict) -> Any:
+        op = req.get("op")
+        if op == "push":
+            bus.push(req["queue"], req["value"])
+            return None
+        if op == "pop":
+            return bus.pop(req["queue"], float(req.get("timeout", 0.0)))
+        if op == "pop_all":
+            return bus.pop_all(req["queue"], int(req.get("max_items", 0)),
+                               float(req.get("timeout", 0.0)))
+        if op == "qlen":
+            return bus.queue_len(req["queue"])
+        if op == "qdel":
+            bus.delete_queue(req["queue"])
+            return None
+        if op == "set":
+            bus.set(req["key"], req["value"])
+            return None
+        if op == "get":
+            return bus.get(req["key"])
+        if op == "del":
+            bus.delete(req["key"])
+            return None
+        if op == "keys":
+            return bus.keys(req.get("prefix", ""))
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown op: {op!r}")
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class BusServer:
+    """The broker process side. ``port=0`` picks a free port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = _Server((host, port), _Handler)
+        self._server.bus = MemoryBus()  # type: ignore[attr-defined]
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="bus-server", daemon=True)
+
+    @property
+    def uri(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    def start(self) -> "BusServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def serve_forever(self) -> None:
+        """Run in the foreground (broker-process entrypoint)."""
+        self._server.serve_forever()
+
+
+class BusClient(BaseBus):
+    def __init__(self, host: str, port: int, timeout: float = 300.0):
+        self.host, self.port = host, port
+        # Socket-level timeout; must exceed any blocking-pop timeout so the
+        # server, not the transport, decides when a pop gives up.
+        self._sock_timeout = timeout
+        self._local = threading.local()
+
+    def _sock(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=self._sock_timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = sock
+        return sock
+
+    def _call(self, req: dict) -> Any:
+        # Retry ONLY when the send itself failed (a stale cached socket —
+        # the broker never saw a complete frame, so resending is safe).
+        # Once the frame is fully sent, the op may have executed: retrying
+        # would duplicate non-idempotent ops (double feedback) or lose
+        # popped items, so a response-side failure propagates instead.
+        try:
+            sock = self._sock()
+            _send_frame(sock, req)
+        except (ConnectionError, OSError):
+            self._drop()
+            sock = self._sock()
+            _send_frame(sock, req)
+        try:
+            resp = _recv_frame(sock)
+        except (ConnectionError, OSError):
+            self._drop()
+            raise
+        if not resp.get("ok"):
+            raise RuntimeError(f"bus error: {resp.get('error')}")
+        return resp.get("value")
+
+    def _drop(self) -> None:
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._local.sock = None
+
+    # --- BaseBus ---
+
+    def push(self, queue: str, value: Any) -> None:
+        self._call({"op": "push", "queue": queue, "value": value})
+
+    def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:
+        return self._call({"op": "pop", "queue": queue, "timeout": timeout})
+
+    def pop_all(self, queue: str, max_items: int = 0,
+                timeout: float = 0.0) -> List[Any]:
+        return self._call({"op": "pop_all", "queue": queue,
+                           "max_items": max_items, "timeout": timeout})
+
+    def queue_len(self, queue: str) -> int:
+        return int(self._call({"op": "qlen", "queue": queue}))
+
+    def delete_queue(self, queue: str) -> None:
+        self._call({"op": "qdel", "queue": queue})
+
+    def set(self, key: str, value: Any) -> None:
+        self._call({"op": "set", "key": key, "value": value})
+
+    def get(self, key: str) -> Optional[Any]:
+        return self._call({"op": "get", "key": key})
+
+    def delete(self, key: str) -> None:
+        self._call({"op": "del", "key": key})
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return list(self._call({"op": "keys", "prefix": prefix}))
+
+    def ping(self) -> bool:
+        try:
+            return self._call({"op": "ping"}) == "pong"
+        except (RuntimeError, ConnectionError, OSError):
+            return False
+
+    def close(self) -> None:
+        self._drop()

@@ -1,0 +1,116 @@
+"""Cache: the serving data plane's queue conventions over the bus.
+
+Parity: SURVEY.md §2 "Cache / queues" + §3.3 — upstream's Redis wrapper
+gives the Predictor per-worker query queues, prediction return queues, and
+a running-worker registry. Same contract here over ``rafiki_tpu.bus``:
+
+- queries:   ``q:{worker_id}``          (Predictor → one InferenceWorker)
+- replies:   ``r:{query_id}``           (workers → the waiting Predictor)
+- registry:  ``w:{inference_job_id}:{worker_id}`` → worker info (kv)
+
+Numpy query payloads (images) are framed as base64 so the bus stays
+JSON-only; tensors at scale never ride the bus — InferenceWorkers decode
+once and batch onto the chip themselves.
+"""
+
+from __future__ import annotations
+
+import base64
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .bus import BaseBus
+
+
+def encode_payload(value: Any) -> Any:
+    """JSON-safe encoding; numpy arrays → base64 frames."""
+    if isinstance(value, np.ndarray):
+        return {"__nd__": base64.b64encode(
+                    np.ascontiguousarray(value).tobytes()).decode(),
+                "dtype": str(value.dtype), "shape": list(value.shape)}
+    if isinstance(value, (list, tuple)):
+        return [encode_payload(v) for v in value]
+    if isinstance(value, dict):
+        return {k: encode_payload(v) for k, v in value.items()}
+    if isinstance(value, (np.integer, np.floating)):
+        return value.item()
+    return value
+
+
+def decode_payload(value: Any) -> Any:
+    if isinstance(value, dict):
+        if "__nd__" in value:
+            arr = np.frombuffer(base64.b64decode(value["__nd__"]),
+                                dtype=np.dtype(value["dtype"]))
+            return arr.reshape(value["shape"]).copy()
+        return {k: decode_payload(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode_payload(v) for v in value]
+    return value
+
+
+class Cache:
+    def __init__(self, bus: BaseBus):
+        self.bus = bus
+
+    # --- Worker registry ---
+
+    def register_worker(self, inference_job_id: str, worker_id: str,
+                        info: Optional[Dict[str, Any]] = None) -> None:
+        self.bus.set(f"w:{inference_job_id}:{worker_id}", info or {})
+
+    def unregister_worker(self, inference_job_id: str,
+                          worker_id: str) -> None:
+        self.bus.delete(f"w:{inference_job_id}:{worker_id}")
+
+    def running_workers(self, inference_job_id: str) -> List[str]:
+        prefix = f"w:{inference_job_id}:"
+        return [k[len(prefix):] for k in self.bus.keys(prefix)]
+
+    # --- Queries (Predictor side) ---
+
+    def send_query(self, worker_id: str, query: Any,
+                   query_id: Optional[str] = None) -> str:
+        query_id = query_id or uuid.uuid4().hex
+        self.bus.push(f"q:{worker_id}", {
+            "query_id": query_id, "query": encode_payload(query)})
+        return query_id
+
+    def gather_predictions(self, query_id: str, n_workers: int,
+                           timeout: float = 5.0) -> List[Dict[str, Any]]:
+        """Collect up to ``n_workers`` worker replies for one query."""
+        out: List[Dict[str, Any]] = []
+        import time
+        deadline = time.monotonic() + timeout
+        while len(out) < n_workers:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            item = self.bus.pop(f"r:{query_id}", timeout=remaining)
+            if item is None:
+                break
+            item["prediction"] = decode_payload(item["prediction"])
+            out.append(item)
+        # One-shot queue: reap it (and any reply landing after timeout).
+        self.bus.delete_queue(f"r:{query_id}")
+        return out
+
+    # --- Queries (InferenceWorker side) ---
+
+    def pop_queries(self, worker_id: str, max_items: int = 0,
+                    timeout: float = 1.0) -> List[Dict[str, Any]]:
+        """Blocking batched pop: waits for the first query, drains the
+        burst (the batched-TPU-inference pattern)."""
+        items = self.bus.pop_all(f"q:{worker_id}", max_items=max_items,
+                                 timeout=timeout)
+        for it in items:
+            it["query"] = decode_payload(it["query"])
+        return items
+
+    def send_prediction(self, query_id: str, worker_id: str,
+                        prediction: Any) -> None:
+        self.bus.push(f"r:{query_id}", {
+            "worker_id": worker_id,
+            "prediction": encode_payload(prediction)})

@@ -1,0 +1,8 @@
+"""Client SDK.
+
+Parity: SURVEY.md §2 "Client SDK" (upstream ``rafiki/client/client.py``).
+"""
+
+from .client import Client, ClientError
+
+__all__ = ["Client", "ClientError"]

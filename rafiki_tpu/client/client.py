@@ -1,0 +1,156 @@
+"""Client: requests wrapper over the Admin + Predictor REST APIs.
+
+Parity: SURVEY.md §2 "Client SDK" — same method surface as upstream's
+``Client`` (``login``, ``create_model``, ``create_train_job``,
+``create_inference_job``, ``predict``, …) so the reference quickstart
+scripts port 1:1 (SURVEY.md §4: those scripts are the de-facto
+integration tests).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import requests
+
+from ..cache import encode_payload
+
+
+class ClientError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"[{status}] {message}")
+        self.status = status
+
+
+class Client:
+    def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000,
+                 timeout: float = 60.0):
+        self._base = f"http://{admin_host}:{admin_port}"
+        self._timeout = timeout
+        self._token: Optional[str] = None
+        self._session = requests.Session()
+
+    # --- Plumbing ---
+
+    def _call(self, method: str, path: str, base: Optional[str] = None,
+              **body: Any) -> Any:
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        url = (base or self._base) + path
+        resp = self._session.request(method, url, json=body or None,
+                                     headers=headers, timeout=self._timeout)
+        try:
+            data = resp.json()
+        except ValueError:
+            data = {"error": resp.text}
+        if resp.status_code >= 400:
+            raise ClientError(resp.status_code,
+                              data.get("error", "unknown error"))
+        return data
+
+    # --- Auth ---
+
+    def login(self, email: str, password: str) -> Dict[str, Any]:
+        out = self._call("POST", "/tokens", email=email, password=password)
+        self._token = out["token"]
+        return out
+
+    def create_user(self, email: str, password: str,
+                    user_type: str) -> Dict[str, Any]:
+        return self._call("POST", "/users", email=email, password=password,
+                          user_type=user_type)
+
+    # --- Models ---
+
+    def create_model(self, name: str, task: str, model_class: str,
+                     model_source: Optional[str] = None,
+                     model_file_path: Optional[str] = None,
+                     dependencies: Optional[Dict[str, str]] = None,
+                     access_right: str = "PRIVATE") -> Dict[str, Any]:
+        """Register a model: ``model_class`` is ``"module:Class"`` for
+        bundled models, or a bare class name with ``model_source`` /
+        ``model_file_path`` carrying the Python source (the upstream
+        upload-a-model-file flow)."""
+        if model_file_path is not None:
+            with open(model_file_path) as f:
+                model_source = f.read()
+        return self._call("POST", "/models", name=name, task=task,
+                          model_class=model_class, model_source=model_source,
+                          dependencies=dependencies,
+                          access_right=access_right)
+
+    def get_models(self, task: Optional[str] = None) -> List[Dict[str, Any]]:
+        path = "/models" + (f"?task={task}" if task else "")
+        return self._call("GET", path)
+
+    # --- Train jobs ---
+
+    def create_train_job(self, app: str, task: str, model_ids: List[str],
+                         budget: Dict[str, Any], train_dataset_path: str,
+                         val_dataset_path: str) -> Dict[str, Any]:
+        return self._call("POST", "/train_jobs", app=app, task=task,
+                          model_ids=model_ids, budget=budget,
+                          train_dataset_path=train_dataset_path,
+                          val_dataset_path=val_dataset_path)
+
+    def get_train_job(self, train_job_id: str) -> Dict[str, Any]:
+        return self._call("GET", f"/train_jobs/{train_job_id}")
+
+    def stop_train_job(self, train_job_id: str) -> Dict[str, Any]:
+        return self._call("POST", f"/train_jobs/{train_job_id}/stop")
+
+    def get_best_trials_of_train_job(self, train_job_id: str,
+                                     max_count: int = 2,
+                                     ) -> List[Dict[str, Any]]:
+        return self._call(
+            "GET",
+            f"/train_jobs/{train_job_id}/trials?type=best"
+            f"&max_count={max_count}")
+
+    def get_trials_of_train_job(self, train_job_id: str,
+                                ) -> List[Dict[str, Any]]:
+        return self._call("GET", f"/train_jobs/{train_job_id}/trials")
+
+    def get_trial_logs(self, trial_id: str) -> List[Dict[str, Any]]:
+        return self._call("GET", f"/trials/{trial_id}/logs")
+
+    def wait_until_train_job_done(self, train_job_id: str,
+                                  timeout: float = 3600.0,
+                                  poll: float = 2.0) -> Dict[str, Any]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.get_train_job(train_job_id)
+            if job["status"] in ("STOPPED", "ERRORED"):
+                return job
+            time.sleep(poll)
+        raise TimeoutError(f"train job {train_job_id} still running "
+                           f"after {timeout}s")
+
+    # --- Inference jobs + prediction ---
+
+    def create_inference_job(self, train_job_id: str,
+                             max_models: int = 2) -> Dict[str, Any]:
+        return self._call("POST", "/inference_jobs",
+                          train_job_id=train_job_id, max_models=max_models)
+
+    def get_inference_job(self, inference_job_id: str) -> Dict[str, Any]:
+        return self._call("GET", f"/inference_jobs/{inference_job_id}")
+
+    def stop_inference_job(self, inference_job_id: str) -> Dict[str, Any]:
+        return self._call("POST", f"/inference_jobs/{inference_job_id}/stop")
+
+    def predict(self, predictor_host: str, query: Any = None,
+                queries: Optional[List[Any]] = None) -> Any:
+        """Query a running predictor (``predictor_host`` as returned by
+        ``get_inference_job``). Numpy queries are frame-encoded."""
+        base = f"http://{predictor_host}"
+        if queries is not None:
+            return self._call("POST", "/predict", base=base,
+                              queries=[encode_payload(q) for q in queries])
+        return self._call("POST", "/predict", base=base,
+                          query=encode_payload(np.asarray(query)
+                                               if isinstance(query, np.ndarray)
+                                               else query))

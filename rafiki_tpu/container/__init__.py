@@ -1,0 +1,20 @@
+"""Container runtime abstraction: how services actually run.
+
+Parity: SURVEY.md §2 "Container manager" — upstream abstracts docker swarm
+behind ``ContainerManager.create_service(image, env, replicas, gpus)``.
+Here the contract is the same but the default runtime is the
+**resident runner** (SURVEY.md §7 hard-parts): services are threads inside
+one process that owns all TPU chips, each bound to its chip group via a
+thread-local — the idiomatic TPU replacement for per-container
+``CUDA_VISIBLE_DEVICES`` isolation. A subprocess runtime
+(``ProcessContainerManager``) gives OS-level isolation for multi-host
+deployments; a docker/K8s manager can implement the same interface
+unchanged.
+"""
+
+from .manager import (ContainerManager, ProcessContainerManager,
+                      ThreadContainerManager)
+from .services import SystemContext, build_service
+
+__all__ = ["ContainerManager", "ThreadContainerManager",
+           "ProcessContainerManager", "SystemContext", "build_service"]

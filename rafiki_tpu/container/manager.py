@@ -1,0 +1,115 @@
+"""Container managers: thread (resident runner) and subprocess runtimes.
+
+Parity: SURVEY.md §2 "Container manager". The interface mirrors upstream's
+``create_service/destroy_service`` contract so the Admin/ServicesManager
+is runtime-agnostic; a DockerSwarm/K8s implementation slots in behind the
+same three methods.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from .services import SystemContext, build_service
+
+_log = logging.getLogger(__name__)
+
+
+class ContainerManager(abc.ABC):
+    @abc.abstractmethod
+    def create_service(self, service_id: str, environ: Dict[str, str]) -> str:
+        """Launch a service; returns a runtime container id."""
+
+    @abc.abstractmethod
+    def destroy_service(self, container_id: str) -> None:
+        pass
+
+    @abc.abstractmethod
+    def service_alive(self, container_id: str) -> bool:
+        pass
+
+
+class ThreadContainerManager(ContainerManager):
+    """Resident-runner mode: every service is a thread in this process.
+
+    One process owns all TPU chips; per-service chip isolation is the
+    thread-local ``ChipGroup`` binding. This is the default deployment on
+    a single host/slice and the substrate for integration tests
+    (SURVEY.md §4: real multi-worker tests on one host, no mocks).
+    """
+
+    def __init__(self, ctx: SystemContext):
+        self.ctx = ctx
+        self._services: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def create_service(self, service_id: str, environ: Dict[str, str]) -> str:
+        service = build_service(environ, self.ctx)
+        service.start()
+        with self._lock:
+            self._services[service_id] = service
+        return service_id
+
+    def destroy_service(self, container_id: str) -> None:
+        with self._lock:
+            service = self._services.pop(container_id, None)
+        if service is not None:
+            service.stop()
+
+    def service_alive(self, container_id: str) -> bool:
+        with self._lock:
+            service = self._services.get(container_id)
+        if service is None:
+            return False
+        running = getattr(service, "running", None)
+        if running is None:  # services without a thread handle (e.g. HTTP)
+            return True
+        return bool(running)
+
+    def get(self, container_id: str) -> Optional[Any]:
+        with self._lock:
+            return self._services.get(container_id)
+
+
+class ProcessContainerManager(ContainerManager):
+    """Subprocess mode: one OS process per service.
+
+    Requires file/tcp-backed stores (the env URIs must be reachable from
+    a fresh process). On TPU, use one process per chip group only when the
+    runtime supports subslicing; otherwise prefer the resident runner.
+    """
+
+    def __init__(self, python: str = sys.executable):
+        self.python = python
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def create_service(self, service_id: str, environ: Dict[str, str]) -> str:
+        env = dict(os.environ)
+        env.update(environ)
+        proc = subprocess.Popen(
+            [self.python, "-m", "rafiki_tpu.container.services"], env=env)
+        with self._lock:
+            self._procs[service_id] = proc
+        return service_id
+
+    def destroy_service(self, container_id: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(container_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def service_alive(self, container_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(container_id)
+        return proc is not None and proc.poll() is None

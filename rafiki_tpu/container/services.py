@@ -1,0 +1,106 @@
+"""Service entrypoints: env contract → running worker object.
+
+Parity: SURVEY.md §3.1 — upstream's worker image has one entrypoint that
+reads ``SERVICE_TYPE`` and friends from the container env and starts the
+right loop. ``build_service`` is that entrypoint as a function; the
+``ProcessContainerManager`` wraps it in ``python -m
+rafiki_tpu.container.services`` with the env vars set, while the
+``ThreadContainerManager`` calls it in-process against shared stores.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..bus import BaseBus, connect
+from ..constants import EnvVars, ServiceType
+from ..parallel.chips import ChipGroup
+from ..store import MetaStore, ParamStore
+
+
+@dataclass
+class SystemContext:
+    """The shared substrate every service programs against."""
+
+    meta: MetaStore
+    params: ParamStore
+    bus: BaseBus
+
+    @staticmethod
+    def from_env(env: Dict[str, str]) -> "SystemContext":
+        return SystemContext(
+            meta=MetaStore(env[EnvVars.META_URI]),
+            params=ParamStore(env[EnvVars.PARAMS_DIR]),
+            bus=connect(env.get(EnvVars.BUS_URI, "")))
+
+
+def build_service(env: Dict[str, str], ctx: Optional[SystemContext] = None,
+                  ) -> Any:
+    """Construct (not start) the worker object for a service env."""
+    ctx = ctx or SystemContext.from_env(env)
+    service_type = env[EnvVars.SERVICE_TYPE]
+    service_id = env[EnvVars.SERVICE_ID]
+    chips = (ChipGroup.from_env(env[EnvVars.CHIPS])
+             if env.get(EnvVars.CHIPS) else None)
+
+    if service_type == ServiceType.TRAIN:
+        from ..worker.train import TrainWorker
+
+        return TrainWorker(service_id, env[EnvVars.SUB_TRAIN_JOB_ID],
+                           ctx.meta, ctx.params, ctx.bus, chips=chips)
+    if service_type == ServiceType.ADVISOR:
+        return _build_advisor_service(service_id,
+                                      env[EnvVars.SUB_TRAIN_JOB_ID], ctx)
+    if service_type == ServiceType.INFERENCE:
+        from ..worker.inference import InferenceWorker
+
+        return InferenceWorker(service_id, env[EnvVars.INFERENCE_JOB_ID],
+                               env[EnvVars.TRIAL_ID], ctx.meta, ctx.params,
+                               ctx.bus, chips=chips)
+    if service_type == ServiceType.PREDICT:
+        from ..predictor.app import PredictorService
+
+        return PredictorService(service_id, env[EnvVars.INFERENCE_JOB_ID],
+                                ctx.meta, ctx.bus,
+                                port=int(env.get("RAFIKI_TPU_PORT", "0")))
+    raise ValueError(f"unknown service type: {service_type!r}")
+
+
+def _build_advisor_service(service_id: str, sub_id: str,
+                           ctx: SystemContext) -> Any:
+    """AdvisorWorker wired to the sub-train-job's model + budget."""
+    from ..advisor import make_advisor
+    from ..advisor.worker import AdvisorWorker
+    from ..constants import BudgetOption
+    from ..utils.model_loader import load_model_class
+
+    sub = ctx.meta.get_sub_train_job(sub_id)
+    job = ctx.meta.get_train_job(sub["train_job_id"])
+    model_row = ctx.meta.get_model(sub["model_id"])
+    model_class = load_model_class(model_row["model_class"],
+                                   model_row.get("model_source"))
+    total = job["budget"].get(BudgetOption.MODEL_TRIAL_COUNT)
+    advisor = make_advisor(model_class.get_knob_config(),
+                           advisor_type=sub.get("advisor_type"),
+                           total_trials=total)
+    worker = AdvisorWorker(advisor, ctx.bus, sub_id)
+    worker.service_id = service_id
+    return worker
+
+
+def main() -> None:
+    """Subprocess entrypoint: build from os.environ, run in the
+    foreground until the process is signalled."""
+    import os
+    import signal
+
+    service = build_service(dict(os.environ))
+    stop = getattr(service, "stop", None)
+    if stop is not None:
+        signal.signal(signal.SIGTERM, lambda *_: stop())
+    service.run()
+
+
+if __name__ == "__main__":
+    main()

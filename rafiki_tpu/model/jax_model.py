@@ -92,7 +92,7 @@ class JaxModel(BaseModel):
     @property
     def mesh(self):
         if self._mesh is None:
-            group = ChipGroup.from_env()
+            group = ChipGroup.current()
             tp = int(self.knobs.get("tensor_parallel", 1))
             self._mesh = build_mesh(group.devices(), tp=tp)
         return self._mesh
@@ -329,6 +329,14 @@ class JaxModel(BaseModel):
         x = jax.device_put(chunk.astype(np.float32), batch_sharding(mesh))
         probs = np.asarray(compiled(variables, x))
         return probs[:n]
+
+    def warmup(self) -> None:
+        """Pre-compile the smallest predict bucket so a serving worker
+        pays the XLA compile before registering for traffic."""
+        shape = self._meta.get("image_shape")
+        if self._variables is None or not shape:
+            return
+        self.predict_proba(np.zeros((1, *shape), np.float32))
 
     def _query_to_image(self, q: Any) -> np.ndarray:
         arr = np.asarray(q)

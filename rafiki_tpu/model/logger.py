@@ -12,6 +12,7 @@ stdout). Models never talk to storage directly.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -20,16 +21,21 @@ LogSink = Callable[[LogRecord], None]
 
 
 class ModelLogger:
+    """The sink binding is THREAD-LOCAL: in resident-runner mode many
+    TrainWorker threads share this module-level logger, and each must
+    route its model's records to its own trial row."""
+
     def __init__(self):
-        self._sink: Optional[LogSink] = None
+        self._tls = threading.local()
 
     def set_sink(self, sink: Optional[LogSink]) -> None:
-        self._sink = sink
+        self._tls.sink = sink
 
     def _emit(self, record: LogRecord) -> None:
         record.setdefault("time", time.time())
-        if self._sink is not None:
-            self._sink(record)
+        sink = getattr(self._tls, "sink", None)
+        if sink is not None:
+            sink(record)
 
     def log(self, msg: str = "", **metrics: Any) -> None:
         """Log a message and/or named metric values at the current instant."""

@@ -63,6 +63,27 @@ class ChipGroup:
             idx = tuple(range(len(jax.devices())))
         return ChipGroup(indices=idx)
 
+    # --- Thread-scoped binding (resident-runner mode) ---
+    #
+    # Worker threads sharing one process cannot partition devices via the
+    # process-wide env var; each service thread binds its group here and
+    # models resolve it via ``ChipGroup.current()`` (thread-local → env →
+    # all devices).
+
+    _tls = threading.local()
+
+    def bind_to_thread(self) -> None:
+        ChipGroup._tls.group = self
+
+    @staticmethod
+    def unbind_thread() -> None:
+        ChipGroup._tls.group = None
+
+    @staticmethod
+    def current() -> "ChipGroup":
+        group = getattr(ChipGroup._tls, "group", None)
+        return group if group is not None else ChipGroup.from_env()
+
 
 class ChipAllocator:
     """Carves a device list into non-overlapping chip groups.

@@ -1,0 +1,78 @@
+"""LocalPlatform: the whole stack wired for one host / one TPU slice.
+
+The resident-runner deployment (SURVEY.md §7 hard-parts): a single process
+owns every chip, services run as threads via ``ThreadContainerManager``,
+state lives in sqlite + safetensors files, traffic rides the in-process
+bus. The same components re-wire onto subprocess/docker managers and
+tcp/postgres backends without code changes — this module is just the
+composition root, and the integration-test seam (SURVEY.md §4: real
+multi-worker tests on one host, no mocks).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from .admin import Admin, ServicesManager
+from .admin.app import AdminApp
+from .bus import BusServer, MemoryBus, connect
+from .container import SystemContext, ThreadContainerManager
+from .parallel.chips import ChipAllocator
+from .store import MetaStore, ParamStore
+
+
+class LocalPlatform:
+    """Everything needed to run jobs on this host.
+
+    ``workdir=None`` → a temp dir (tests); meta/params live under it.
+    ``n_chips=None`` → all of ``jax.devices()``.
+    ``http=True`` also starts the Admin REST frontend (port 0 = ephemeral).
+    """
+
+    def __init__(self, workdir: Optional[str] = None,
+                 n_chips: Optional[int] = None, http: bool = False,
+                 admin_port: int = 0, bus_uri: str = ""):
+        self._tmp = None
+        if workdir is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="rafiki_tpu_")
+            workdir = self._tmp.name
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+
+        meta_uri = os.path.join(workdir, "meta.db")
+        params_dir = os.path.join(workdir, "params")
+        self.meta = MetaStore(meta_uri)
+        self.params = ParamStore(params_dir)
+        self.bus = connect(bus_uri)
+        self.ctx = SystemContext(meta=self.meta, params=self.params,
+                                 bus=self.bus)
+        self.container = ThreadContainerManager(self.ctx)
+        self.allocator = ChipAllocator(n_chips)
+        self.services = ServicesManager(
+            self.meta, self.container, self.allocator,
+            meta_uri=meta_uri, params_dir=params_dir, bus_uri=bus_uri)
+        self.admin = Admin(self.meta, self.params, self.services)
+        self.app: Optional[AdminApp] = None
+        if http:
+            self.app = AdminApp(self.admin, port=admin_port).start()
+
+    @property
+    def admin_port(self) -> int:
+        assert self.app is not None, "platform started without http=True"
+        return self.app.port
+
+    def shutdown(self) -> None:
+        if self.app is not None:
+            self.app.stop()
+        for job in self.meta.get_train_jobs(status="RUNNING"):
+            self.services.stop_train_services(job["id"])
+        for job in self.meta.get_inference_jobs(status="RUNNING"):
+            self.services.stop_inference_services(job["id"])
+        self.meta.close()
+        self.params.close()
+        if isinstance(self.bus, MemoryBus):
+            MemoryBus.reset_shared()
+        if self._tmp is not None:
+            self._tmp.cleanup()

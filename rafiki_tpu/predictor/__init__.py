@@ -1,0 +1,8 @@
+"""Predictor: the serving frontend that ensembles InferenceWorkers.
+
+Parity: SURVEY.md §2 "Predictor" + §3.3.
+"""
+
+from .predictor import Predictor, ensemble_predictions
+
+__all__ = ["Predictor", "ensemble_predictions"]

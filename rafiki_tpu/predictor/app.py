@@ -1,0 +1,84 @@
+"""PredictorService: the HTTP frontend of one inference job.
+
+Parity: SURVEY.md §3.3 — upstream's predictor is a Flask app with
+``POST /predict``; app consumers send queries and receive the ensembled
+result. Routes:
+
+- ``GET  /``          → health + running worker count
+- ``POST /predict``   → ``{"query": ...}`` or ``{"queries": [...]}``;
+  numpy-array queries use the cache's base64 frame encoding
+  (``{"__nd__": ..., "dtype": ..., "shape": ...}``) or plain nested lists.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ..bus import BaseBus
+from ..cache import decode_payload
+from ..constants import ServiceStatus
+from ..store import MetaStore
+from ..utils.service import JsonHttpServer
+from .predictor import Predictor
+
+
+class PredictorService:
+    def __init__(self, service_id: str, inference_job_id: str,
+                 meta: MetaStore, bus: BaseBus, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.service_id = service_id
+        self.inference_job_id = inference_job_id
+        self.meta = meta
+        self.predictor = Predictor(inference_job_id, bus)
+        self._http = JsonHttpServer([
+            ("GET", "/", self._health),
+            ("POST", "/predict", self._predict),
+        ], host=host, port=port, name=f"predictor-{service_id[:8]}")
+        self.port = self._http.port
+
+    # --- Service lifecycle (ContainerManager contract) ---
+
+    def start(self) -> "PredictorService":
+        self._http.start()
+        host = f"127.0.0.1:{self.port}"
+        self.meta.update_service(self.service_id,
+                                 status=ServiceStatus.RUNNING,
+                                 host="127.0.0.1", port=self.port)
+        self.meta.update_inference_job(self.inference_job_id,
+                                       predictor_host=host)
+        return self
+
+    def stop(self) -> None:
+        self._http.stop()
+        self.meta.update_service(self.service_id,
+                                 status=ServiceStatus.STOPPED)
+
+    def run(self) -> None:
+        """Foreground entrypoint (subprocess mode)."""
+        self.start()
+        threading.Event().wait()
+
+    @property
+    def running(self) -> bool:
+        return self._http._thread is not None and \
+            self._http._thread.is_alive()
+
+    # --- Routes ---
+
+    def _health(self, params, body, ctx):
+        return 200, {"status": "ok",
+                     "inference_job_id": self.inference_job_id,
+                     "n_workers": len(self.predictor.workers())}
+
+    def _predict(self, params, body, ctx):
+        if not body:
+            return 400, {"error": "missing JSON body"}
+        if "queries" in body:
+            queries = [decode_payload(q) for q in body["queries"]]
+            preds = self.predictor.predict(queries)
+            return 200, {"predictions": preds}
+        if "query" in body:
+            preds = self.predictor.predict([decode_payload(body["query"])])
+            return 200, {"prediction": preds[0]}
+        return 400, {"error": "body needs 'query' or 'queries'"}

@@ -1,0 +1,97 @@
+"""Predictor core: scatter queries to workers, gather, ensemble.
+
+Parity: SURVEY.md §3.3 — upstream's Predictor broadcasts each query to
+every live InferenceWorker via Redis queues, polls for the per-worker
+predictions with a timeout, and combines them (mean class probabilities →
+label for image classification). Same shape here over the bus/cache; the
+HTTP frontend lives in ``rafiki_tpu.predictor.app``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..bus import BaseBus
+from ..cache import Cache
+
+_log = logging.getLogger(__name__)
+
+
+def ensemble_predictions(worker_predictions: List[Any]) -> Any:
+    """Combine one query's per-worker predictions.
+
+    Numeric vectors (class probabilities) → elementwise mean, the
+    reference's image-classification combiner. Non-numeric predictions →
+    majority vote, falling back to the first (upstream serves the first
+    worker's output for tasks without a combiner).
+    """
+    preds = [p for p in worker_predictions
+             if not (isinstance(p, dict) and "error" in p)]
+    if not preds:
+        return None
+    try:
+        arr = np.asarray(preds, dtype=np.float64)
+        if not np.isnan(arr).any():
+            return np.mean(arr, axis=0).tolist()
+    except (ValueError, TypeError):
+        pass
+    # Non-numeric: majority vote by value (repr as the equality key),
+    # ties broken by worker order.
+    from collections import Counter
+
+    reprs = [repr(p) for p in preds]
+    winner = Counter(reprs).most_common(1)[0][0]
+    return preds[reprs.index(winner)]
+
+
+class Predictor:
+    def __init__(self, inference_job_id: str, bus: BaseBus,
+                 gather_timeout: float = 30.0,
+                 worker_wait_timeout: float = 120.0):
+        self.inference_job_id = inference_job_id
+        self.cache = Cache(bus)
+        self.gather_timeout = gather_timeout
+        self.worker_wait_timeout = worker_wait_timeout
+
+    def workers(self) -> List[str]:
+        return self.cache.running_workers(self.inference_job_id)
+
+    def _wait_workers(self) -> List[str]:
+        """Workers register only after their (slow) first XLA compile;
+        queries arriving during deploy wait instead of erroring."""
+        import time
+        deadline = time.monotonic() + self.worker_wait_timeout
+        while True:
+            workers = self.workers()
+            if workers:
+                return workers
+            if time.monotonic() >= deadline:
+                return []
+            time.sleep(0.2)
+
+    def predict(self, queries: List[Any]) -> List[Optional[Any]]:
+        """Scatter-gather-ensemble a batch of queries."""
+        workers = self._wait_workers()
+        if not workers:
+            raise RuntimeError(
+                f"no running inference workers for job "
+                f"{self.inference_job_id}")
+        query_ids = []
+        for q in queries:
+            qid = None
+            for w in workers:
+                qid = self.cache.send_query(w, q, query_id=qid)
+            query_ids.append(qid)
+        results: List[Optional[Any]] = []
+        for qid in query_ids:
+            replies = self.cache.gather_predictions(
+                qid, n_workers=len(workers), timeout=self.gather_timeout)
+            if len(replies) < len(workers):
+                _log.warning("query %s: %d/%d workers replied", qid,
+                             len(replies), len(workers))
+            results.append(ensemble_predictions(
+                [r["prediction"] for r in replies]))
+        return results

@@ -1,0 +1,70 @@
+"""Auth: password hashing + HS256 JWT, stdlib-only.
+
+Parity: SURVEY.md §2 "Utils" (upstream ``rafiki/utils/auth.py`` issues JWTs
+for the Admin REST API). No PyJWT in this environment, so the token is a
+standard RFC 7519 HS256 JWT built on ``hmac``/``hashlib``/``base64`` —
+interoperable with any JWT consumer.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+_ALG_HEADER = {"alg": "HS256", "typ": "JWT"}
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64url(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def encode_token(payload: Dict[str, Any], secret: str,
+                 expires_in: float = 24 * 3600) -> str:
+    body = dict(payload)
+    body["exp"] = time.time() + expires_in
+    h = _b64url(json.dumps(_ALG_HEADER, separators=(",", ":")).encode())
+    p = _b64url(json.dumps(body, separators=(",", ":")).encode())
+    sig = hmac.new(secret.encode(), f"{h}.{p}".encode(),
+                   hashlib.sha256).digest()
+    return f"{h}.{p}.{_b64url(sig)}"
+
+
+def decode_token(token: str, secret: str) -> Dict[str, Any]:
+    """Verify signature + expiry; raises ``ValueError`` on any failure."""
+    try:
+        h, p, s = token.split(".")
+    except ValueError:
+        raise ValueError("malformed token")
+    expected = hmac.new(secret.encode(), f"{h}.{p}".encode(),
+                        hashlib.sha256).digest()
+    if not hmac.compare_digest(expected, _unb64url(s)):
+        raise ValueError("bad signature")
+    payload = json.loads(_unb64url(p))
+    if payload.get("exp", 0) < time.time():
+        raise ValueError("token expired")
+    return payload
+
+
+def hash_password(password: str, salt: Optional[bytes] = None) -> str:
+    salt = salt if salt is not None else os.urandom(16)
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 100_000)
+    return f"{salt.hex()}${digest.hex()}"
+
+
+def verify_password(password: str, hashed: str) -> bool:
+    try:
+        salt_hex, digest_hex = hashed.split("$")
+    except ValueError:
+        return False
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                 bytes.fromhex(salt_hex), 100_000)
+    return hmac.compare_digest(digest.hex(), digest_hex)

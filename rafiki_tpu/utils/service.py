@@ -1,0 +1,142 @@
+"""JSON-over-HTTP service plumbing on the stdlib http.server.
+
+Parity: SURVEY.md §2 "Utils" (upstream ``rafiki/utils/service.py`` wraps
+Flask service boilerplate). Flask isn't in this environment; this module
+gives the Admin and Predictor frontends the same thing on
+``ThreadingHTTPServer``: route tables with ``<param>`` captures, JSON
+bodies in/out, bearer-token extraction, graceful start/stop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+_log = logging.getLogger(__name__)
+
+# handler(params: dict, body: dict|None, ctx: RequestContext) -> (status, obj)
+Handler = Callable[[Dict[str, str], Optional[Dict[str, Any]],
+                    "RequestContext"], Tuple[int, Any]]
+
+
+class RequestContext:
+    def __init__(self, headers, query: Dict[str, List[str]]):
+        self.headers = headers
+        self.query = query
+
+    @property
+    def bearer_token(self) -> Optional[str]:
+        h = self.headers.get("Authorization", "")
+        if h.startswith("Bearer "):
+            return h[len("Bearer "):]
+        return None
+
+    def query_one(self, key: str, default: Optional[str] = None,
+                  ) -> Optional[str]:
+        vals = self.query.get(key)
+        return vals[0] if vals else default
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _compile(path: str) -> re.Pattern:
+    # "/train_jobs/<id>/stop" -> ^/train_jobs/(?P<id>[^/]+)/stop$
+    pattern = re.sub(r"<(\w+)>", r"(?P<\1>[^/]+)", path)
+    return re.compile(f"^{pattern}$")
+
+
+class JsonHttpServer:
+    """A route-table HTTP server. ``port=0`` picks a free port."""
+
+    def __init__(self, routes: List[Tuple[str, str, Handler]],
+                 host: str = "0.0.0.0", port: int = 0,
+                 name: str = "http"):
+        self._routes = [(method.upper(), _compile(path), handler)
+                        for method, path, handler in routes]
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                _log.debug("%s " + fmt, name, *args)
+
+            def _dispatch(self, method: str):
+                parsed = urlparse(self.path)
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    raw = self.rfile.read(length)
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        self._reply(400, {"error": "invalid JSON body"})
+                        return
+                ctx = RequestContext(self.headers, parse_qs(parsed.query))
+                for m, pattern, handler in outer._routes:
+                    if m != method:
+                        continue
+                    match = pattern.match(parsed.path)
+                    if match is None:
+                        continue
+                    try:
+                        status, obj = handler(match.groupdict(), body, ctx)
+                    except HttpError as e:
+                        status, obj = e.status, {"error": e.message}
+                    except PermissionError as e:
+                        status = getattr(e, "status", 401)
+                        obj = {"error": str(e)}
+                    except ValueError as e:
+                        status, obj = 400, {"error": str(e)}
+                    except Exception as e:
+                        _log.exception("%s %s failed", method, parsed.path)
+                        status, obj = 500, {
+                            "error": f"{type(e).__name__}: {e}"}
+                    self._reply(status, obj)
+                    return
+                self._reply(404, {"error": f"no route {method} {parsed.path}"})
+
+            def _reply(self, status: int, obj: Any):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "JsonHttpServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()

@@ -1,0 +1,121 @@
+"""InferenceWorker: serves one trained trial's model from its chip group.
+
+Parity: SURVEY.md §2 "InferenceWorker" + §3.3 — loads a trial's params,
+registers itself with the cache, then loops: pop a burst of queries from
+its queue, run ``predict`` (batched on the chip; ``JaxModel`` AOT-compiles
+per batch bucket so variable load never retraces), push each prediction to
+the query's reply queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+from ..bus import BaseBus
+from ..cache import Cache
+from ..constants import ServiceStatus
+from ..parallel.chips import ChipGroup
+from ..store import MetaStore, ParamStore
+from ..utils.model_loader import load_model_class
+
+_log = logging.getLogger(__name__)
+
+
+class InferenceWorker:
+    def __init__(self, service_id: str, inference_job_id: str, trial_id: str,
+                 meta: MetaStore, params: ParamStore, bus: BaseBus,
+                 chips: Optional[ChipGroup] = None,
+                 batch_timeout: float = 0.5, max_batch: int = 512):
+        self.service_id = service_id
+        self.inference_job_id = inference_job_id
+        self.trial_id = trial_id
+        self.meta = meta
+        self.params = params
+        self.cache = Cache(bus)
+        self.chips = chips
+        self.batch_timeout = batch_timeout
+        self.max_batch = max_batch
+        self.stop_flag = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._model: Optional[Any] = None
+
+    # --- Lifecycle ---
+
+    def start(self) -> "InferenceWorker":
+        self._thread = threading.Thread(
+            target=self.run, name=f"infer-{self.service_id[:8]}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        self.stop_flag.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # --- Setup + loop ---
+
+    def _load_model(self) -> Any:
+        trial = self.meta.get_trial(self.trial_id)
+        if trial is None:
+            raise ValueError(f"unknown trial {self.trial_id}")
+        model_row = self.meta.get_model(trial["model_id"])
+        model_class = load_model_class(model_row["model_class"],
+                                       model_row.get("model_source"))
+        model = model_class(**model_class.validate_knobs(trial["knobs"]))
+        model.load_parameters(self.params.load(trial["params_id"]))
+        return model
+
+    def run(self) -> None:
+        if self.chips is not None:
+            self.chips.bind_to_thread()
+        try:
+            self._model = self._load_model()
+            # Warm the compile cache before taking traffic so the first
+            # query isn't a 20-40s TPU compile.
+            warm = getattr(self._model, "warmup", None)
+            if warm is not None:
+                warm()
+            self.meta.update_service(self.service_id,
+                                     status=ServiceStatus.RUNNING)
+            self.cache.register_worker(self.inference_job_id,
+                                       self.service_id)
+        except Exception:
+            _log.exception("inference worker %s failed to start",
+                           self.service_id)
+            self.meta.update_service(self.service_id,
+                                     status=ServiceStatus.ERRORED)
+            raise
+        try:
+            while not self.stop_flag.is_set():
+                items = self.cache.pop_queries(
+                    self.service_id, max_items=self.max_batch,
+                    timeout=self.batch_timeout)
+                if not items:
+                    continue
+                self._serve_batch(items)
+            self.meta.update_service(self.service_id,
+                                     status=ServiceStatus.STOPPED)
+        except Exception:
+            _log.exception("inference worker %s crashed", self.service_id)
+            self.meta.update_service(self.service_id,
+                                     status=ServiceStatus.ERRORED)
+            raise
+        finally:
+            self.cache.unregister_worker(self.inference_job_id,
+                                         self.service_id)
+
+    def _serve_batch(self, items: list) -> None:
+        queries = [it["query"] for it in items]
+        try:
+            predictions = self._model.predict(queries)
+        except Exception as e:
+            _log.exception("predict failed on batch of %d", len(queries))
+            predictions = [{"error": f"{type(e).__name__}: {e}"}] * len(queries)
+        for it, pred in zip(items, predictions):
+            self.cache.send_prediction(it["query_id"], self.service_id, pred)

@@ -56,7 +56,8 @@ class TrialRunner:
                  sub_train_job_id: str, model_id: str = "",
                  worker_id: str = "local",
                  budget: Optional[Dict[str, Any]] = None,
-                 stop_flag: Optional[Any] = None):
+                 stop_flag: Optional[Any] = None,
+                 max_consecutive_errors: int = 3):
         self.model_class = model_class
         self.advisor = advisor
         self.train_dataset_path = train_dataset_path
@@ -69,17 +70,32 @@ class TrialRunner:
         self.budget = BudgetTracker(budget)
         # threading.Event-like; lets a supervisor stop the loop mid-job.
         self.stop_flag = stop_flag
+        # Circuit breaker: a model that fails deterministically would
+        # otherwise loop forever, since errored trials refund their budget
+        # slot (advisor.forget) and never count as completed.
+        self.max_consecutive_errors = max_consecutive_errors
 
     # --- Loop ---
 
     def run(self) -> List[Dict[str, Any]]:
         """Run trials until the budget is exhausted; returns trial rows."""
         done: List[Dict[str, Any]] = []
+        consecutive_errors = 0
         while not self._should_stop():
             row = self.run_one()
             if row is None:
                 break
             done.append(row)
+            if row["status"] == TrialStatus.ERRORED:
+                consecutive_errors += 1
+                if consecutive_errors >= self.max_consecutive_errors:
+                    _log.error(
+                        "worker %s: %d consecutive trial failures; "
+                        "giving up on %s", self.worker_id,
+                        consecutive_errors, self.sub_train_job_id)
+                    break
+            else:
+                consecutive_errors = 0
         return done
 
     def _should_stop(self) -> bool:

@@ -1,0 +1,80 @@
+"""AdvisorWorker RPC: one shared search state over the bus."""
+
+import threading
+
+from rafiki_tpu.advisor import make_advisor
+from rafiki_tpu.advisor.worker import AdvisorWorker, RemoteAdvisor
+from rafiki_tpu.bus import MemoryBus
+from rafiki_tpu.model.knobs import FloatKnob, IntegerKnob
+
+
+def _knob_config():
+    return {"lr": FloatKnob(1e-4, 1e-1, is_exp=True), "units": IntegerKnob(8, 64)}
+
+
+def test_remote_propose_feedback_best():
+    bus = MemoryBus()
+    advisor = make_advisor(_knob_config(), seed=0)
+    worker = AdvisorWorker(advisor, bus, "sub1").start()
+    try:
+        remote = RemoteAdvisor(bus, "sub1", timeout=10.0)
+        p1 = remote.propose()
+        p2 = remote.propose()
+        assert p1.trial_no == 1 and p2.trial_no == 2
+        assert 1e-4 <= p1.knobs["lr"] <= 1e-1
+        remote.feedback(p1, 0.7)
+        remote.feedback(p2, 0.9)
+        # feedback is async; poll briefly for it to land
+        import time
+        for _ in range(50):
+            if advisor.n_trials == 2:
+                break
+            time.sleep(0.05)
+        assert advisor.n_trials == 2
+        best = remote.best()
+        assert best is not None and best[1] == 0.9
+        assert best[0] == p2.knobs
+    finally:
+        worker.stop()
+
+
+def test_remote_many_workers_share_search():
+    bus = MemoryBus()
+    advisor = make_advisor(_knob_config(), seed=0)
+    worker = AdvisorWorker(advisor, bus, "sub2").start()
+    try:
+        seen = []
+        lock = threading.Lock()
+
+        def client():
+            remote = RemoteAdvisor(bus, "sub2", timeout=10.0)
+            for _ in range(5):
+                p = remote.propose()
+                with lock:
+                    seen.append(p.trial_no)
+                remote.feedback(p, 0.5)
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        # trial numbers are globally unique across workers
+        assert sorted(seen) == list(range(1, 16))
+    finally:
+        worker.stop()
+
+
+def test_remote_error_propagates():
+    bus = MemoryBus()
+
+    class Boom:
+        def propose(self):
+            raise RuntimeError("nope")
+
+    worker = AdvisorWorker(Boom(), bus, "sub3").start()
+    try:
+        remote = RemoteAdvisor(bus, "sub3", timeout=10.0)
+        import pytest
+        with pytest.raises(RuntimeError, match="nope"):
+            remote.propose()
+    finally:
+        worker.stop()

@@ -1,0 +1,184 @@
+"""Bus + Cache tests: queue semantics identical across both backends."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.bus import BusClient, BusServer, MemoryBus, connect
+from rafiki_tpu.cache import Cache, decode_payload, encode_payload
+
+
+@pytest.fixture(params=["memory", "tcp"])
+def bus(request):
+    if request.param == "memory":
+        yield MemoryBus()
+    else:
+        server = BusServer().start()
+        client = BusClient(server.host, server.port)
+        yield client
+        client.close()
+        server.stop()
+
+
+class TestBus:
+    def test_fifo(self, bus):
+        bus.push("q", 1)
+        bus.push("q", {"a": [2]})
+        assert bus.queue_len("q") == 2
+        assert bus.pop("q") == 1
+        assert bus.pop("q") == {"a": [2]}
+        assert bus.pop("q") is None
+
+    def test_pop_timeout_blocks(self, bus):
+        t0 = time.monotonic()
+        assert bus.pop("empty", timeout=0.2) is None
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_pop_wakes_on_push(self, bus):
+        got = []
+
+        def consumer():
+            got.append(bus.pop("q2", timeout=5.0))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.1)
+        bus.push("q2", "x")
+        t.join(timeout=5)
+        assert got == ["x"]
+
+    def test_pop_all_drains_burst(self, bus):
+        for i in range(5):
+            bus.push("q3", i)
+        assert bus.pop_all("q3", timeout=1.0) == [0, 1, 2, 3, 4]
+        assert bus.pop_all("q3", timeout=0.05) == []
+
+    def test_pop_all_max_items(self, bus):
+        for i in range(5):
+            bus.push("q4", i)
+        assert bus.pop_all("q4", max_items=3, timeout=1.0) == [0, 1, 2]
+        assert bus.queue_len("q4") == 2
+
+    def test_kv_and_keys(self, bus):
+        bus.set("w:job1:a", {"s": 1})
+        bus.set("w:job1:b", {"s": 2})
+        bus.set("w:job2:c", {})
+        assert bus.get("w:job1:a") == {"s": 1}
+        assert bus.keys("w:job1:") == ["w:job1:a", "w:job1:b"]
+        bus.delete("w:job1:a")
+        assert bus.get("w:job1:a") is None
+        assert bus.keys("w:job1:") == ["w:job1:b"]
+
+    def test_ping(self, bus):
+        assert bus.ping()
+
+    def test_delete_queue(self, bus):
+        bus.push("dq", 1)
+        bus.delete_queue("dq")
+        assert bus.queue_len("dq") == 0
+        assert bus.pop("dq", timeout=0.05) is None
+
+
+def test_memory_bus_reaps_empty_queues():
+    """uuid-keyed one-shot queues must not accumulate (leak) after use."""
+    bus = MemoryBus()
+    for i in range(100):
+        q = f"r:{i}"
+        bus.push(q, {"x": i})
+        bus.pop(q)
+    assert len(bus._queues) == 0
+    # timeout-path pops also reap
+    for i in range(50):
+        bus.pop(f"ghost:{i}", timeout=0.0)
+    assert len(bus._queues) == 0
+
+
+class TestTcpSpecifics:
+    def test_concurrent_clients(self):
+        server = BusServer().start()
+        clients = [BusClient(server.host, server.port) for _ in range(4)]
+
+        def producer(c, k):
+            for i in range(25):
+                c.push("load", k * 100 + i)
+
+        threads = [threading.Thread(target=producer, args=(c, k))
+                   for k, c in enumerate(clients)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        drained = clients[0].pop_all("load", timeout=1.0)
+        assert len(drained) == 100
+        [c.close() for c in clients]
+        server.stop()
+
+    def test_connect_uri(self):
+        server = BusServer().start()
+        c = connect(server.uri)
+        c.push("u", 1)
+        assert c.pop("u") == 1
+        c.close()
+        server.stop()
+        assert isinstance(connect(""), MemoryBus)
+        # memory:// is a process-local singleton
+        assert connect("memory://") is connect("memory://")
+        MemoryBus.reset_shared()
+
+    def test_error_response_keeps_connection(self):
+        server = BusServer().start()
+        c = BusClient(server.host, server.port)
+        with pytest.raises(RuntimeError, match="unknown op"):
+            c._call({"op": "nope"})
+        assert c.ping()  # connection still usable
+        c.close()
+        server.stop()
+
+
+class TestCache:
+    def test_payload_roundtrip(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        enc = encode_payload({"img": arr, "k": [1, arr]})
+        dec = decode_payload(enc)
+        np.testing.assert_array_equal(dec["img"], arr)
+        np.testing.assert_array_equal(dec["k"][1], arr)
+        assert dec["k"][0] == 1
+
+    def test_scatter_gather(self):
+        cache = Cache(MemoryBus())
+        cache.register_worker("job", "w0")
+        cache.register_worker("job", "w1")
+        assert cache.running_workers("job") == ["w0", "w1"]
+
+        img = np.ones((4, 4, 1), np.uint8)
+        qid = None
+        for w in cache.running_workers("job"):
+            qid = cache.send_query(w, img, query_id=qid)
+
+        # each worker pops, predicts, replies
+        for w in ["w0", "w1"]:
+            items = cache.pop_queries(w, timeout=1.0)
+            assert len(items) == 1
+            np.testing.assert_array_equal(items[0]["query"], img)
+            cache.send_prediction(items[0]["query_id"], w, [0.25, 0.75])
+
+        preds = cache.gather_predictions(qid, n_workers=2, timeout=2.0)
+        assert sorted(p["worker_id"] for p in preds) == ["w0", "w1"]
+        assert preds[0]["prediction"] == [0.25, 0.75]
+
+    def test_gather_timeout_partial(self):
+        cache = Cache(MemoryBus())
+        qid = cache.send_query("w0", [1, 2, 3])
+        items = cache.pop_queries("w0", timeout=1.0)
+        cache.send_prediction(qid, "w0", "ok")
+        # asks for 3 workers but only 1 replies; returns the partial set
+        t0 = time.monotonic()
+        preds = cache.gather_predictions(qid, n_workers=3, timeout=0.3)
+        assert len(preds) == 1
+        assert time.monotonic() - t0 < 2.0
+
+    def test_unregister(self):
+        cache = Cache(MemoryBus())
+        cache.register_worker("j", "w0")
+        cache.unregister_worker("j", "w0")
+        assert cache.running_workers("j") == []
