@@ -1,5 +1,6 @@
 """Bundled model zoo (SURVEY.md §2 "Example models")."""
 
+from .densenet import JaxDenseNet
 from .feedforward import JaxFeedForward
 
-__all__ = ["JaxFeedForward"]
+__all__ = ["JaxFeedForward", "JaxDenseNet"]

@@ -1,0 +1,158 @@
+"""JaxDenseNet: parity model for the reference's ``PyDenseNet``.
+
+Parity: SURVEY.md §2 "Example models" — DenseNet-121-style CIFAR-10
+classifier (reference: PyTorch DenseNet-121, BASELINE.json configs[1]).
+Torch in this image is CPU-only, so parity is a native flax DenseNet-BC
+rather than torch-on-TPU (SURVEY.md §7 target stack note).
+
+TPU-first design choices:
+- bfloat16 convs/matmuls (MXU path), float32 BatchNorm statistics.
+- NHWC layout throughout — XLA's native conv layout on TPU.
+- Depth is expressed as (blocks, layers-per-block) Python constants at
+  trace time, so the whole network is one static XLA graph; the dense
+  connectivity is plain ``jnp.concatenate`` on the channel axis, which XLA
+  fuses into the conv input windows.
+- Host-side augmentation (pad-crop + horizontal flip) mirrors the
+  reference recipe for CIFAR-scale training.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob, PolicyKnob
+from ..model.jax_model import JaxModel
+
+
+class _DenseLayer(nn.Module):
+    """BN-ReLU-Conv1x1 (bottleneck) -> BN-ReLU-Conv3x3, emits growth_rate."""
+    growth_rate: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(x)
+        h = nn.relu(h)
+        h = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                    dtype=self.dtype)(h)
+        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.growth_rate, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(h)
+        return jnp.concatenate([x, h.astype(x.dtype)], axis=-1)
+
+
+class _Transition(nn.Module):
+    """BN-ReLU-Conv1x1 (compression) + 2x2 average pool."""
+    out_channels: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(x)
+        h = nn.relu(h)
+        h = nn.Conv(self.out_channels, (1, 1), use_bias=False,
+                    dtype=self.dtype)(h)
+        return nn.avg_pool(h, (2, 2), strides=(2, 2))
+
+
+class _DenseNet(nn.Module):
+    """DenseNet-BC. block_config=(6,12,24,16) & growth=32 ≈ DenseNet-121."""
+    block_config: Tuple[int, ...]
+    growth_rate: int
+    n_classes: int
+    compression: float = 0.5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        ch = 2 * self.growth_rate
+        # CIFAR-scale stem: single 3x3 conv, no maxpool (inputs are 32x32,
+        # not 224x224 — the ImageNet stem would destroy resolution).
+        x = nn.Conv(ch, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        for i, n_layers in enumerate(self.block_config):
+            for _ in range(n_layers):
+                x = _DenseLayer(self.growth_rate, dtype=self.dtype)(x, train)
+                ch += self.growth_rate
+            if i != len(self.block_config) - 1:
+                ch = int(ch * self.compression)
+                x = _Transition(ch, dtype=self.dtype)(x, train)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.n_classes, dtype=self.dtype)(x)
+
+
+# Named depth presets: DenseNet-121 is the reference's architecture; the
+# smaller presets keep trials cheap during search and tests fast.
+_BLOCK_CONFIGS = {
+    "densenet_tiny": (2, 2, 2),
+    "densenet_small": (4, 4, 4),
+    "densenet_121": (6, 12, 24, 16),
+}
+
+
+class JaxDenseNet(JaxModel):
+    """DenseNet-BC image classifier (CIFAR-10 parity model)."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "arch": CategoricalKnob(
+                ["densenet_tiny", "densenet_small", "densenet_121"]),
+            "growth_rate": IntegerKnob(8, 32),
+            "learning_rate": FloatKnob(1e-3, 3e-1, is_exp=True),
+            "batch_size": CategoricalKnob([64, 128, 256]),
+            "weight_decay": FloatKnob(1e-5, 1e-3, is_exp=True),
+            "max_epochs": IntegerKnob(6, 60),
+            "early_stop_epochs": FixedKnob(5),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+        }
+
+    def create_module(self, n_classes: int, image_shape: Sequence[int]):
+        return _DenseNet(
+            block_config=_BLOCK_CONFIGS[str(self.knobs.get(
+                "arch", "densenet_121"))],
+            growth_rate=int(self.knobs.get("growth_rate", 32)),
+            n_classes=n_classes,
+        )
+
+    def create_optimizer(self, steps_per_epoch: int,
+                         max_epochs: int) -> optax.GradientTransformation:
+        # SGD + momentum + cosine decay: the reference DenseNet recipe.
+        lr = float(self.knobs.get("learning_rate", 0.1))
+        total = max(1, steps_per_epoch * max_epochs)
+        warmup = min(total // 20, 5 * steps_per_epoch)
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=lr * 0.1, peak_value=lr, warmup_steps=max(1, warmup),
+            decay_steps=total, end_value=lr * 1e-3)
+        wd = float(self.knobs.get("weight_decay", 1e-4))
+        return optax.chain(
+            optax.add_decayed_weights(wd),
+            optax.sgd(sched, momentum=0.9, nesterov=True),
+        )
+
+    def augment_batch(self, images: np.ndarray,
+                      rng: np.random.Generator) -> np.ndarray:
+        """Pad-4 random crop + horizontal flip (CIFAR recipe), host-side."""
+        n, h, w, _ = images.shape
+        pad = 4
+        padded = np.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                        mode="reflect")
+        ys = rng.integers(0, 2 * pad + 1, size=n)
+        xs = rng.integers(0, 2 * pad + 1, size=n)
+        # Vectorized gather: this hook runs host-side every optimizer step,
+        # so it must not serialize a Python loop against the device.
+        rows = ys[:, None] + np.arange(h)            # (n, h)
+        cols = xs[:, None] + np.arange(w)            # (n, w)
+        out = padded[np.arange(n)[:, None, None],
+                     rows[:, :, None], cols[:, None, :]]
+        flips = rng.random(n) < 0.5
+        out[flips] = out[flips, :, ::-1]
+        return out
