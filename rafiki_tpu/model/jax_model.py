@@ -27,6 +27,7 @@ Knob conventions the scaffolding understands (all optional):
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, Optional
 
@@ -37,7 +38,7 @@ import optax
 from flax import traverse_util
 from flax.training import train_state
 
-from ..parallel import batch_sharding, build_mesh, shard_variables
+from ..parallel import batch_sharding, build_mesh, replicated, shard_variables
 from ..parallel.chips import ChipGroup
 from .base import BaseModel, Params
 from .dataset import ImageDataset, load_image_dataset
@@ -46,6 +47,66 @@ from .logger import logger
 
 class TrainState(train_state.TrainState):
     batch_stats: Any = None
+
+
+# Process-level compiled-step cache. Repeat trials with the same static
+# config (module, optimizer schedule, mesh) reuse the SAME jitted train /
+# eval step objects — and, crucially, the same optax transformation object
+# (TrainState carries ``tx`` as a static field, so a fresh tx per trial
+# would defeat jit's cache even with identical graphs). This is what makes
+# ENAS-style searches one-compile-total: the architecture encoding is a
+# *traced input* (see ``extra_apply_inputs``), so hundreds of proposed
+# architectures hit one XLA executable.
+#
+# Bounded LRU: searches over continuous knobs (e.g. a FloatKnob learning
+# rate) produce a distinct key per trial; without eviction every trial
+# would pin a compiled executable for the life of the worker.
+_STEP_CACHE: "OrderedDict[Any, Dict[str, Any]]" = OrderedDict()
+_STEP_CACHE_MAX = 16
+
+
+def _step_cache_get(key: Any) -> Optional[Dict[str, Any]]:
+    entry = _STEP_CACHE.get(key)
+    if entry is not None:
+        _STEP_CACHE.move_to_end(key)
+    return entry
+
+
+def _step_cache_put(key: Any, entry: Dict[str, Any]) -> None:
+    _STEP_CACHE[key] = entry
+    _STEP_CACHE.move_to_end(key)
+    while len(_STEP_CACHE) > _STEP_CACHE_MAX:
+        _STEP_CACHE.popitem(last=False)
+
+
+def clear_step_cache() -> None:
+    _STEP_CACHE.clear()
+
+
+def _canonicalize_state(state: Any, mesh) -> Any:
+    """Pin every train-state leaf to a mesh NamedSharding and a strong
+    dtype. ``TrainState.create`` leaves the step counter as a weak Python
+    int and eagerly-initialised optimizer scalars with default (GSPMD)
+    shardings; without this, the first train step of every trial traces a
+    one-off variant before settling on the steady-state signature —
+    i.e. one wasted XLA compile per trial."""
+    from jax.sharding import NamedSharding
+
+    def canon(a):
+        if isinstance(a, jax.Array):
+            sh = a.sharding
+            if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+                return a
+            return jax.device_put(a, replicated(mesh))
+        if isinstance(a, (int, np.integer)):
+            return jax.device_put(jnp.asarray(a, jnp.int32),
+                                  replicated(mesh))
+        if isinstance(a, (float, np.floating)):
+            return jax.device_put(jnp.asarray(a, jnp.float32),
+                                  replicated(mesh))
+        return a
+
+    return jax.tree.map(canon, state)
 
 
 class JaxModel(BaseModel):
@@ -87,6 +148,27 @@ class JaxModel(BaseModel):
         """Host-side augmentation hook; default identity."""
         return images
 
+    def extra_apply_inputs(self) -> Dict[str, np.ndarray]:
+        """Extra *traced* inputs forwarded to every ``module.apply`` call
+        as keyword arguments (train, evaluate, and predict).
+
+        Values are passed as jit arguments, never baked into the graph —
+        so a knob routed through here (e.g. the ENAS architecture
+        encoding) can change per trial without a recompile. Knobs whose
+        names appear in the returned dict are excluded from the
+        compiled-step cache key for the same reason.
+        """
+        return {}
+
+    def _step_cache_key(self, kind: str, mesh, *parts: Any) -> Any:
+        # ``mesh`` is interned by build_mesh, so the object itself is a
+        # stable identity for (devices, axis shape).
+        extra_names = frozenset(self.extra_apply_inputs())
+        knob_items = tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in self.knobs.items() if k not in extra_names))
+        return (type(self), kind, self._module, knob_items, mesh, parts)
+
     # --- Mesh / module plumbing ---
 
     @property
@@ -121,51 +203,67 @@ class JaxModel(BaseModel):
             max_epochs = min(max_epochs, 1)
         steps_per_epoch = max(1, ds.size // batch_size)
 
-        tx = self.create_optimizer(steps_per_epoch, max_epochs)
+        extra_np = self.extra_apply_inputs()
+        extra = {k: jnp.asarray(v) for k, v in extra_np.items()}
 
         init_rng = jax.random.key(int(self.knobs.get("seed", 0)))
         dummy = jnp.zeros((1, *ds.image_shape), jnp.float32)
-        variables = self._module.init(init_rng, dummy, train=False)
+        variables = self._module.init(init_rng, dummy, train=False,
+                                      **extra_np)
         if shared_params is not None:
             variables = self._merge_shared(variables, shared_params)
+        has_bs = "batch_stats" in variables
+
+        cache_key = self._step_cache_key(
+            "train", mesh, steps_per_epoch, max_epochs, has_bs)
+        cached = _step_cache_get(cache_key)
+        if cached is not None:
+            tx, train_step = cached["tx"], cached["step"]
+        else:
+            tx = self.create_optimizer(steps_per_epoch, max_epochs)
+            module = self._module
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def train_step(state: TrainState, x, y, step_rng, extra):
+                def loss_fn(params):
+                    vs = {"params": params}
+                    if has_bs:
+                        vs["batch_stats"] = state.batch_stats
+                        logits, upd = module.apply(
+                            vs, x, train=True, mutable=["batch_stats"],
+                            rngs={"dropout": step_rng}, **extra)
+                        new_bs = upd["batch_stats"]
+                    else:
+                        logits = module.apply(vs, x, train=True,
+                                              rngs={"dropout": step_rng},
+                                              **extra)
+                        new_bs = None
+                    logits = logits.astype(jnp.float32)
+                    loss = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, y).mean()
+                    acc = (logits.argmax(-1) == y).mean()
+                    return loss, (new_bs, acc)
+
+                (loss, (new_bs, acc)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(state.params)
+                state = state.apply_gradients(grads=grads)
+                if has_bs:
+                    state = state.replace(batch_stats=new_bs)
+                return state, loss, acc
+
+            _step_cache_put(cache_key, {"tx": tx, "step": train_step})
 
         variables = shard_variables(variables, mesh)
+        # apply_fn=None: the step closes over the module directly, and a
+        # bound method in the TrainState's static metadata would break
+        # pytree equality across trials (a retrace per trial).
         state = TrainState.create(
-            apply_fn=self._module.apply,
+            apply_fn=None,
             params=variables["params"],
             batch_stats=variables.get("batch_stats"),
             tx=tx,
         )
-
-        has_bs = state.batch_stats is not None
-        module = self._module
-
-        @partial(jax.jit, donate_argnums=(0,))
-        def train_step(state: TrainState, x, y, step_rng):
-            def loss_fn(params):
-                vs = {"params": params}
-                if has_bs:
-                    vs["batch_stats"] = state.batch_stats
-                    logits, upd = module.apply(
-                        vs, x, train=True, mutable=["batch_stats"],
-                        rngs={"dropout": step_rng})
-                    new_bs = upd["batch_stats"]
-                else:
-                    logits = module.apply(vs, x, train=True,
-                                          rngs={"dropout": step_rng})
-                    new_bs = None
-                logits = logits.astype(jnp.float32)
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, y).mean()
-                acc = (logits.argmax(-1) == y).mean()
-                return loss, (new_bs, acc)
-
-            (loss, (new_bs, acc)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
-            state = state.apply_gradients(grads=grads)
-            if has_bs:
-                state = state.replace(batch_stats=new_bs)
-            return state, loss, acc
+        state = _canonicalize_state(state, mesh)
 
         logger.define_plot("Training", ["loss", "train_acc"], x_axis="epoch")
         x_shard = batch_sharding(mesh)
@@ -192,7 +290,7 @@ class JaxModel(BaseModel):
                 xb = jax.device_put(xb, x_shard)
                 yb = jax.device_put(yb, x_shard)
                 key, sub = jax.random.split(key)
-                state, loss, acc = train_step(state, xb, yb, sub)
+                state, loss, acc = train_step(state, xb, yb, sub, extra)
                 step += 1
                 if s == steps_per_epoch - 1 or s % 50 == 49:
                     ep_loss += float(loss)
@@ -239,16 +337,25 @@ class JaxModel(BaseModel):
         if self._sharded_vars is None:
             self._sharded_vars = shard_variables(self._variables, mesh)
         variables = self._sharded_vars
-        module = self._module
+        extra = {k: jnp.asarray(v)
+                 for k, v in self.extra_apply_inputs().items()}
 
         if self._eval_step is None:
-            @jax.jit
-            def eval_step(variables, x, y, w):
-                logits = module.apply(variables, x, train=False)
-                correct = (logits.argmax(-1) == y).astype(jnp.float32) * w
-                return correct.sum()
+            cache_key = self._step_cache_key("eval", mesh)
+            cached = _step_cache_get(cache_key)
+            if cached is not None:
+                self._eval_step = cached["step"]
+            else:
+                module = self._module
 
-            self._eval_step = eval_step
+                @jax.jit
+                def eval_step(variables, x, y, w, extra):
+                    logits = module.apply(variables, x, train=False, **extra)
+                    correct = (logits.argmax(-1) == y).astype(jnp.float32) * w
+                    return correct.sum()
+
+                _step_cache_put(cache_key, {"step": eval_step})
+                self._eval_step = eval_step
 
         dp = mesh.shape["dp"]
         bs = max(dp, (min(1024, ds.size) // dp) * dp)
@@ -269,7 +376,7 @@ class JaxModel(BaseModel):
                 variables,
                 jax.device_put(xb, x_shard),
                 jax.device_put(yb, x_shard),
-                jax.device_put(w, x_shard)))
+                jax.device_put(w, x_shard), extra))
         return float(correct / ds.size)
 
     # --- BaseModel: predict ---
@@ -305,29 +412,32 @@ class JaxModel(BaseModel):
         if self._sharded_vars is None:
             self._sharded_vars = shard_variables(self._variables, mesh)
         variables = self._sharded_vars
+        extra = {k: jax.device_put(jnp.asarray(v), replicated(mesh))
+                 for k, v in self.extra_apply_inputs().items()}
         compiled = self._predict_cache.get(bucket)
         if compiled is None:
             module = self._module
 
             @jax.jit
-            def predict_fn(variables, x):
-                logits = module.apply(variables, x, train=False)
+            def predict_fn(variables, x, extra):
+                logits = module.apply(variables, x, train=False, **extra)
                 return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
             # AOT-compile for this bucket shape so serving never retraces.
             x_shape = jax.ShapeDtypeStruct(
                 (bucket, *chunk.shape[1:]), jnp.float32,
                 sharding=batch_sharding(mesh))
-            v_shapes = jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
-                variables)
-            compiled = predict_fn.lower(v_shapes, x_shape).compile()
+            struct = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+                a.shape, a.dtype, sharding=a.sharding)
+            compiled = predict_fn.lower(
+                jax.tree.map(struct, variables), x_shape,
+                jax.tree.map(struct, extra)).compile()
             self._predict_cache[bucket] = compiled
         if n < bucket:
             chunk = np.concatenate(
                 [chunk, np.zeros((bucket - n, *chunk.shape[1:]), chunk.dtype)])
         x = jax.device_put(chunk.astype(np.float32), batch_sharding(mesh))
-        probs = np.asarray(compiled(variables, x))
+        probs = np.asarray(compiled(variables, x, extra))
         return probs[:n]
 
     def warmup(self) -> None:

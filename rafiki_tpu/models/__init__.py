@@ -1,6 +1,7 @@
 """Bundled model zoo (SURVEY.md §2 "Example models")."""
 
 from .densenet import JaxDenseNet
+from .enas import JaxEnas
 from .feedforward import JaxFeedForward
 
-__all__ = ["JaxFeedForward", "JaxDenseNet"]
+__all__ = ["JaxFeedForward", "JaxDenseNet", "JaxEnas"]

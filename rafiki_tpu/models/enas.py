@@ -1,0 +1,291 @@
+"""JaxEnas: parity model for the reference's ``TfEnas`` — TPU-first.
+
+Parity: SURVEY.md §2 "Example models" / §3.5 — upstream ``TfEnas`` is a
+cell-based ENAS architecture search over CIFAR-10 (the reference's largest
+model file): an RNN controller proposes a cell wiring, child models train
+briefly on *shared* weights, and the controller is updated with REINFORCE
+(the controller itself lives in ``rafiki_tpu.advisor.enas``).
+
+TPU-first redesign (SURVEY.md §7 "Hard parts: ENAS on XLA"): upstream
+rebuilds a fresh TF graph per proposed architecture — on XLA that would
+mean a full recompile per trial. Here the search phase runs a **masked
+supernet**: every candidate op's weights exist in one static graph, and
+the architecture encoding enters as a *traced int32 input* (one-hot input
+selection + one-hot op mixing), so hundreds of proposed architectures
+execute against ONE XLA executable (see ``JaxModel.extra_apply_inputs``).
+Weight sharing falls out for free: the supernet parameter tree is
+architecture-independent, so ``ParamStore`` GLOBAL_RECENT warm-starts
+overlay every tensor. The final phase (advisor retrains the best
+architecture from scratch) builds a single-path network with the same
+parameter naming — compiled once, no masking overhead.
+
+Structural choices vs. upstream ENAS, for static shapes:
+- Cell output concatenates ALL block outputs (not just loose ends) through
+  a 1x1 projection — loose-end detection is data-dependent and would
+  force recompiles.
+- Reduction happens in the cell's input calibration (stride-2 1x1 convs),
+  so every in-cell candidate op is stride-1 and shape-uniform.
+- GroupNorm instead of BatchNorm: the supernet stays purely functional
+  (no mutable batch_stats), which keeps masked/single-path graphs and
+  multi-chip sharding simple.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..model import ArchKnob, FixedKnob, PolicyKnob
+from ..model.jax_model import JaxModel
+
+N_OPS = 5  # identity, sep-conv 3x3, sep-conv 5x5, avg-pool 3x3, max-pool 3x3
+
+
+def _gn_groups(c: int) -> int:
+    g = 8
+    while g > 1 and c % g:
+        g //= 2
+    return g
+
+
+class _SepConv(nn.Module):
+    """ReLU -> depthwise kxk -> pointwise 1x1 -> GroupNorm."""
+    features: int
+    kernel: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(x.shape[-1], (self.kernel, self.kernel),
+                    feature_group_count=x.shape[-1], use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=_gn_groups(self.features),
+                         dtype=jnp.float32)(x)
+        return x.astype(self.dtype)
+
+
+class _Calibrate(nn.Module):
+    """ReLU -> strided 1x1 conv -> GroupNorm: aligns a cell input to the
+    cell's channel count and spatial resolution."""
+    features: int
+    stride: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (1, 1),
+                    strides=(self.stride, self.stride), use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=_gn_groups(self.features),
+                         dtype=jnp.float32)(x)
+        return x.astype(self.dtype)
+
+
+class _EnasNet(nn.Module):
+    """Cell-based network; masked supernet when ``fixed_arch`` is None.
+
+    The architecture encoding has shape (2, n_blocks, 4): cell type
+    (normal / reduction) x block x (input1, op1, input2, op2). Input
+    indices address ``[s0, s1, block_0, ..., block_{b-1}]``; op indices
+    address the N_OPS candidate set.
+    """
+
+    n_blocks: int
+    n_cells: int
+    channels: int
+    n_classes: int
+    fixed_arch: Optional[Tuple[int, ...]] = None
+    dtype: Any = jnp.bfloat16
+
+    def _op(self, ci: int, b: int, slot: int, op, x, masked: bool):
+        """Apply (masked mix of) the candidate ops for one block slot."""
+        c = x.shape[-1]
+        name = f"c{ci}_b{b}_s{slot}"
+
+        def branch(i: int):
+            if i == 0:
+                return x
+            if i == 1:
+                return _SepConv(c, 3, dtype=self.dtype,
+                                name=f"{name}_sep3")(x)
+            if i == 2:
+                return _SepConv(c, 5, dtype=self.dtype,
+                                name=f"{name}_sep5")(x)
+            if i == 3:
+                return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            return nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+        if not masked:
+            return branch(int(op))
+        outs = jnp.stack([branch(i) for i in range(N_OPS)])
+        w = jax.nn.one_hot(op, N_OPS, dtype=outs.dtype)
+        return jnp.einsum("s,snhwc->nhwc", w, outs)
+
+    def _cell(self, ci: int, s0, s1, c: int, reduction: bool, spec,
+              masked: bool):
+        stride = 2 if reduction else 1
+        s1p = _Calibrate(c, stride, dtype=self.dtype,
+                         name=f"c{ci}_pre1")(s1)
+        s0_stride = s0.shape[1] // s1p.shape[1]
+        s0p = _Calibrate(c, max(1, s0_stride), dtype=self.dtype,
+                         name=f"c{ci}_pre0")(s0)
+
+        states = [s0p, s1p]
+        for b in range(self.n_blocks):
+            in1, op1, in2, op2 = (spec[b, 0], spec[b, 1],
+                                  spec[b, 2], spec[b, 3])
+            if masked:
+                stacked = jnp.stack(states)  # (b+2, N, H, W, C)
+                x1 = jnp.einsum("s,snhwc->nhwc",
+                                jax.nn.one_hot(in1, len(states),
+                                               dtype=stacked.dtype), stacked)
+                x2 = jnp.einsum("s,snhwc->nhwc",
+                                jax.nn.one_hot(in2, len(states),
+                                               dtype=stacked.dtype), stacked)
+            else:
+                x1, x2 = states[int(in1)], states[int(in2)]
+            y = (self._op(ci, b, 0, op1, x1, masked)
+                 + self._op(ci, b, 1, op2, x2, masked))
+            states.append(y)
+
+        out = jnp.concatenate(states[2:], axis=-1)
+        out = nn.Conv(c, (1, 1), use_bias=False, dtype=self.dtype,
+                      name=f"c{ci}_out")(out)
+        out = nn.GroupNorm(num_groups=_gn_groups(c), dtype=jnp.float32,
+                           name=f"c{ci}_out_gn")(out)
+        return out.astype(self.dtype)
+
+    @nn.compact
+    def __call__(self, x, arch=None, train: bool = False):
+        masked = self.fixed_arch is None
+        if masked:
+            assert arch is not None, "supernet mode needs the arch input"
+        else:
+            arch = np.asarray(self.fixed_arch,
+                              np.int32).reshape(2, self.n_blocks, 4)
+
+        x = x.astype(self.dtype)
+        c = self.channels
+        x = nn.Conv(c, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    name="stem_conv")(x)
+        x = nn.GroupNorm(num_groups=_gn_groups(c), dtype=jnp.float32,
+                         name="stem_gn")(x).astype(self.dtype)
+
+        reduce_at = ({self.n_cells // 3, (2 * self.n_cells) // 3}
+                     if self.n_cells >= 3 else set())
+        s0 = s1 = x
+        for ci in range(self.n_cells):
+            reduction = ci in reduce_at
+            if reduction:
+                c *= 2
+            spec = arch[1 if reduction else 0]
+            s0, s1 = s1, self._cell(ci, s0, s1, c, reduction, spec, masked)
+
+        h = nn.relu(s1)
+        h = h.mean(axis=(1, 2))
+        return nn.Dense(self.n_classes, dtype=self.dtype, name="head")(h)
+
+
+class JaxEnas(JaxModel):
+    """ENAS cell search over CIFAR-scale image classification.
+
+    Drive with ``rafiki_tpu.advisor.enas.EnasAdvisor``: search-phase trials
+    get SHARE_PARAMS / QUICK_TRAIN / DOWNSCALE policies (masked supernet,
+    shared weights, proxy size, 1 epoch); final-phase trials train the
+    controller's best architecture from scratch at full size.
+    """
+
+    # Class-level sizing so tests can subclass a tiny variant; the arch
+    # knob's position count derives from n_blocks.
+    n_blocks = 4
+    full_cells, full_channels = 6, 32
+    search_cells, search_channels = 3, 16
+
+    @classmethod
+    def get_knob_config(cls):
+        positions = []
+        for _ct in range(2):
+            for b in range(cls.n_blocks):
+                positions += [list(range(b + 2)), list(range(N_OPS)),
+                              list(range(b + 2)), list(range(N_OPS))]
+        return {
+            "arch": ArchKnob(positions),
+            "batch_size": FixedKnob(128),
+            "learning_rate": FixedKnob(0.05),
+            "max_epochs": FixedKnob(10),
+            "trial_epochs": FixedKnob(1),
+            "share_params": PolicyKnob("SHARE_PARAMS"),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+            "downscale": PolicyKnob("DOWNSCALE"),
+        }
+
+    # --- JaxModel hooks ---
+
+    def _searching(self) -> bool:
+        return bool(self.knobs.get("share_params", False))
+
+    def create_module(self, n_classes: int, image_shape: Sequence[int]):
+        cls = type(self)
+        down = bool(self.knobs.get("downscale", False))
+        return _EnasNet(
+            n_blocks=cls.n_blocks,
+            n_cells=cls.search_cells if down else cls.full_cells,
+            channels=cls.search_channels if down else cls.full_channels,
+            n_classes=n_classes,
+            fixed_arch=(None if self._searching()
+                        else tuple(int(v) for v in self.knobs["arch"])),
+        )
+
+    def extra_apply_inputs(self) -> Dict[str, np.ndarray]:
+        if not self._searching():
+            return {}
+        arch = np.asarray([int(v) for v in self.knobs["arch"]], np.int32)
+        return {"arch": arch.reshape(2, type(self).n_blocks, 4)}
+
+    def train(self, dataset_path: str, *, shared_params=None,
+              **kwargs: Any) -> None:
+        # QUICK_TRAIN caps epochs at trial_epochs (search trials take a
+        # short pass over shared weights; upstream TfEnas semantics).
+        if self.knobs.get("quick_train", False):
+            self.knobs = dict(self.knobs,
+                              max_epochs=int(self.knobs.get("trial_epochs", 1)))
+        super().train(dataset_path, shared_params=shared_params, **kwargs)
+
+    def create_optimizer(self, steps_per_epoch: int,
+                         max_epochs: int) -> optax.GradientTransformation:
+        # Child-model recipe: SGD momentum + cosine decay (ENAS paper).
+        lr = float(self.knobs.get("learning_rate", 0.05))
+        total = max(1, steps_per_epoch * max_epochs)
+        sched = optax.cosine_decay_schedule(lr, decay_steps=total,
+                                            alpha=1e-3)
+        return optax.chain(
+            optax.add_decayed_weights(1e-4),
+            optax.sgd(sched, momentum=0.9, nesterov=True),
+        )
+
+    def augment_batch(self, images: np.ndarray,
+                      rng: np.random.Generator) -> np.ndarray:
+        if images.shape[1] < 8:
+            return images
+        n, h, w, _ = images.shape
+        pad = 4
+        padded = np.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                        mode="reflect")
+        ys = rng.integers(0, 2 * pad + 1, size=n)
+        xs = rng.integers(0, 2 * pad + 1, size=n)
+        rows = ys[:, None] + np.arange(h)
+        cols = xs[:, None] + np.arange(w)
+        out = padded[np.arange(n)[:, None, None],
+                     rows[:, :, None], cols[:, None, :]]
+        flips = rng.random(n) < 0.5
+        out[flips] = out[flips, :, ::-1]
+        return out

@@ -23,6 +23,13 @@ TP_AXIS = "tp"
 _TP_MIN_FEATURES = 256
 
 
+# Interned meshes: jit/AOT caches key on NamedSharding equality, which
+# includes the Mesh object — handing out a fresh Mesh per trial would
+# defeat the compiled-step cache (a recompile per trial with identical
+# shapes). One process-wide Mesh per (devices, tp) keeps shardings equal.
+_MESH_CACHE: dict = {}
+
+
 def build_mesh(devices: Optional[Sequence[Any]] = None, tp: int = 1) -> Mesh:
     """Arrange ``devices`` into a (dp, tp) mesh; dp = n_devices / tp."""
     if devices is None:
@@ -31,8 +38,13 @@ def build_mesh(devices: Optional[Sequence[Any]] = None, tp: int = 1) -> Mesh:
     n = len(devices)
     if n % tp != 0:
         raise ValueError(f"{n} devices not divisible by tp={tp}")
-    arr = np.asarray(devices, dtype=object).reshape(n // tp, tp)
-    return Mesh(arr, (DP_AXIS, TP_AXIS))
+    key = (tuple(devices), tp)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        arr = np.asarray(devices, dtype=object).reshape(n // tp, tp)
+        mesh = Mesh(arr, (DP_AXIS, TP_AXIS))
+        _MESH_CACHE[key] = mesh
+    return mesh
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

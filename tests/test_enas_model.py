@@ -1,0 +1,173 @@
+"""JaxEnas (TfEnas parity, SURVEY.md §2/§3.5/§7 step 9) tests.
+
+The crux of the TPU redesign is the masked supernet: hundreds of proposed
+architectures must run against ONE compiled graph (SURVEY.md §7 "Hard
+parts: ENAS on XLA"), and the supernet parameter tree must be
+architecture-independent so ParamStore weight sharing overlays every
+tensor. A tiny subclass keeps CPU runtime small.
+"""
+
+import numpy as np
+
+from rafiki_tpu.advisor import EnasAdvisor
+from rafiki_tpu.constants import BudgetOption, ParamsType, TrialStatus
+from rafiki_tpu.model import FixedKnob, load_image_dataset, test_model_class
+from rafiki_tpu.model import jax_model
+from rafiki_tpu.models import JaxEnas
+from rafiki_tpu.store import MetaStore, ParamStore
+from rafiki_tpu.worker import TrialRunner
+
+
+class TinyEnas(JaxEnas):
+    """Test-scale preset: 2 blocks/cell, 3 cells (incl. reductions)."""
+
+    n_blocks = 2
+    full_cells, full_channels = 3, 8
+    search_cells, search_channels = 3, 8
+
+    @classmethod
+    def get_knob_config(cls):
+        cfg = super().get_knob_config()
+        cfg.update(batch_size=FixedKnob(32), learning_rate=FixedKnob(0.05),
+                   max_epochs=FixedKnob(3))
+        return cfg
+
+
+def _sample_arch(seed: int):
+    knob = TinyEnas.get_knob_config()["arch"]
+    return knob.sample(np.random.default_rng(seed))
+
+
+def _search_knobs(arch):
+    return TinyEnas.validate_knobs({
+        "arch": arch, "batch_size": 32, "learning_rate": 0.05,
+        "max_epochs": 3, "trial_epochs": 1, "share_params": True,
+        "quick_train": True, "downscale": True})
+
+
+def test_supernet_one_compile_many_archs(synth_image_data):
+    """Two different architectures must share one compiled train step."""
+    train_path, val_path = synth_image_data
+    jax_model.clear_step_cache()
+
+    scores = []
+    for seed in (0, 1):
+        m = TinyEnas(**_search_knobs(_sample_arch(seed)))
+        m.train(train_path)
+        scores.append(m.evaluate(val_path))
+        m.destroy()
+
+    train_entries = [v for k, v in jax_model._STEP_CACHE.items()
+                     if k[1] == "train"]
+    assert len(train_entries) == 1, \
+        "different archs created distinct train steps (recompile per trial)"
+    assert train_entries[0]["step"]._cache_size() == 1, \
+        "train step retraced for the second architecture"
+    eval_entries = [v for k, v in jax_model._STEP_CACHE.items()
+                    if k[1] == "eval"]
+    assert len(eval_entries) == 1
+    assert all(0.0 <= s <= 1.0 for s in scores)
+
+
+def test_supernet_param_tree_architecture_independent(synth_image_data):
+    """Weight-sharing invariant: same tree for every architecture, and a
+    dump from one arch warm-starts a trial of another."""
+    train_path, _ = synth_image_data
+    m1 = TinyEnas(**_search_knobs(_sample_arch(0)))
+    m1.train(train_path)
+    dump1 = m1.dump_parameters()
+    m1.destroy()
+
+    m2 = TinyEnas(**_search_knobs(_sample_arch(1)))
+    m2.train(train_path, shared_params=dump1)
+    dump2 = m2.dump_parameters()
+    m2.destroy()
+
+    assert set(dump1) == set(dump2), \
+        "supernet parameter tree depends on the architecture"
+    # Both cell types' op weights exist in the shared tree.
+    assert any("_sep3/" in k for k in dump1)
+    assert any("_sep5/" in k for k in dump1)
+
+
+def test_enas_fixed_arch_end_to_end(synth_image_data):
+    """Final-phase mode: single-path net via test_model_class, incl.
+    dump/load round-trip and predict."""
+    train_path, val_path = synth_image_data
+    ds = load_image_dataset(val_path)
+    queries = [ds.images[i] for i in range(3)]
+    knobs = {"arch": _sample_arch(2), "batch_size": 32,
+             "learning_rate": 0.05, "max_epochs": 3, "trial_epochs": 1,
+             "share_params": False, "quick_train": False,
+             "downscale": False}
+    result = test_model_class(
+        TinyEnas, "IMAGE_CLASSIFICATION", train_path, val_path,
+        test_queries=queries, knobs=knobs)
+    assert len(result.predictions) == 3
+    assert all(abs(sum(p) - 1.0) < 1e-3 for p in result.predictions)
+
+
+def test_enas_fixed_path_params_subset_of_supernet():
+    """Single-path parameter names must be a subset of the supernet's
+    (same naming scheme ties the two modes together)."""
+    import jax
+    import jax.numpy as jnp
+    from flax import traverse_util
+
+    arch = _sample_arch(3)
+    x = jnp.zeros((1, 12, 12, 1), jnp.float32)
+
+    sup = TinyEnas(**_search_knobs(arch))
+    sup_mod = sup.create_module(4, (12, 12, 1))
+    sup_vars = jax.eval_shape(
+        lambda: sup_mod.init(jax.random.key(0), x,
+                             arch=sup.extra_apply_inputs()["arch"]))
+
+    fixed = TinyEnas(**{**_search_knobs(arch), "share_params": False,
+                        "downscale": False})
+    fixed_mod = fixed.create_module(4, (12, 12, 1))
+    fixed_vars = jax.eval_shape(
+        lambda: fixed_mod.init(jax.random.key(0), x))
+
+    sup_keys = set(traverse_util.flatten_dict(sup_vars["params"], sep="/"))
+    fixed_keys = set(traverse_util.flatten_dict(fixed_vars["params"],
+                                                sep="/"))
+    assert fixed_keys <= sup_keys, fixed_keys - sup_keys
+
+
+def test_enas_search_loop_with_advisor_and_sharing(synth_image_data,
+                                                   tmp_path):
+    """End-to-end miniature of §3.5: EnasAdvisor proposes, TrialRunner
+    executes on shared params via the ParamStore, REINFORCE updates flow,
+    and the final-phase trial retrains the best arch from scratch."""
+    train_path, val_path = synth_image_data
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "params"))
+    try:
+        user = meta.create_user("e@x.c", "h", "MODEL_DEVELOPER")
+        model = meta.create_model(user["id"], "enas", "IMAGE_CLASSIFICATION",
+                                  "tests.test_enas_model:TinyEnas", {})
+        budget = {BudgetOption.MODEL_TRIAL_COUNT: 4}
+        job = meta.create_train_job(user["id"], "app", "IMAGE_CLASSIFICATION",
+                                    budget, train_path, val_path, "RUNNING")
+        sub = meta.create_sub_train_job(job["id"], model["id"], "RUNNING")
+
+        advisor = EnasAdvisor(TinyEnas.get_knob_config(), seed=0,
+                              total_trials=4, final_train_frac=0.25)
+        runner = TrialRunner(TinyEnas, advisor, train_path, val_path,
+                             meta, params, sub["id"], model_id=model["id"],
+                             budget=budget)
+        done = runner.run()
+
+        completed = meta.get_trials(sub["id"], TrialStatus.COMPLETED)
+        assert len(completed) == 4
+        # Search trials requested shared params; the last (final-phase)
+        # trial trained from scratch.
+        proposals = sorted(completed, key=lambda t: t["no"])
+        assert all(t["proposal"]["params_type"] == ParamsType.GLOBAL_RECENT
+                   for t in proposals[:-1])
+        assert proposals[-1]["proposal"]["params_type"] == ParamsType.NONE
+        assert proposals[-1]["knobs"]["share_params"] is False
+    finally:
+        meta.close()
+        params.close()
