@@ -27,7 +27,7 @@ import io
 import os
 import zipfile
 from dataclasses import dataclass
-from typing import Iterator, List, Tuple
+from typing import Any, Iterator, List, Sequence, Tuple
 
 import numpy as np
 
@@ -208,3 +208,41 @@ def write_corpus_dataset(sentences: List[List[str]], tags: List[List[str]],
             lines.append("")
         zf.writestr("corpus.tsv", "\n".join(lines) + "\n")
     return out_path
+
+
+def normalize_query(q: Any, expected_shape: Sequence[int]) -> np.ndarray:
+    """Normalise one prediction query to a float32 image of
+    ``expected_shape`` — the single validation contract every
+    implementation path (JAX, sklearn) applies, so ensemble members
+    behind one Predictor agree on what a legal query is."""
+    arr = np.asarray(q)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    if tuple(arr.shape) != tuple(expected_shape):
+        raise ValueError(
+            f"query shape {arr.shape} != {tuple(expected_shape)}")
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    return arr.astype(np.float32)
+
+
+def pad_crop_flip(images: np.ndarray, rng: np.random.Generator,
+                  pad: int = 4, min_size: int = 8) -> np.ndarray:
+    """Reflect-pad random crop + horizontal flip (the CIFAR recipe),
+    vectorised host-side — this runs every optimizer step and must not
+    serialize a Python loop against the device. Images smaller than
+    ``min_size`` pass through untouched."""
+    if images.shape[1] < min_size:
+        return images
+    n, h, w, _ = images.shape
+    padded = np.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                    mode="reflect")
+    ys = rng.integers(0, 2 * pad + 1, size=n)
+    xs = rng.integers(0, 2 * pad + 1, size=n)
+    rows = ys[:, None] + np.arange(h)
+    cols = xs[:, None] + np.arange(w)
+    out = padded[np.arange(n)[:, None, None],
+                 rows[:, :, None], cols[:, None, :]]
+    flips = rng.random(n) < 0.5
+    out[flips] = out[flips, :, ::-1]
+    return out

@@ -41,7 +41,7 @@ from flax.training import train_state
 from ..parallel import batch_sharding, build_mesh, replicated, shard_variables
 from ..parallel.chips import ChipGroup
 from .base import BaseModel, Params
-from .dataset import ImageDataset, load_image_dataset
+from .dataset import ImageDataset, load_image_dataset, normalize_query
 from .logger import logger
 
 
@@ -127,6 +127,7 @@ class JaxModel(BaseModel):
         self._predict_cache: Dict[int, Any] = {}
         self._sharded_vars = None
         self._eval_step = None
+        self._extra_dev = None
 
     # --- Subclass API ---
 
@@ -200,7 +201,10 @@ class JaxModel(BaseModel):
         batch_size = max(dp, (batch_size // dp) * dp)
         max_epochs = int(self.knobs.get("max_epochs", 5))
         if self.knobs.get("quick_train", False):
-            max_epochs = min(max_epochs, 1)
+            # QUICK_TRAIN policy: short search-phase pass (ENAS-style);
+            # trial_epochs controls its length, default 1.
+            max_epochs = min(max_epochs,
+                             int(self.knobs.get("trial_epochs", 1)))
         steps_per_epoch = max(1, ds.size // batch_size)
 
         extra_np = self.extra_apply_inputs()
@@ -412,8 +416,13 @@ class JaxModel(BaseModel):
         if self._sharded_vars is None:
             self._sharded_vars = shard_variables(self._variables, mesh)
         variables = self._sharded_vars
-        extra = {k: jax.device_put(jnp.asarray(v), replicated(mesh))
-                 for k, v in self.extra_apply_inputs().items()}
+        if self._extra_dev is None:
+            # Device-put once per compiled lifetime: this is the AOT
+            # serving hot path and the extras are per-model constants.
+            self._extra_dev = {
+                k: jax.device_put(jnp.asarray(v), replicated(mesh))
+                for k, v in self.extra_apply_inputs().items()}
+        extra = self._extra_dev
         compiled = self._predict_cache.get(bucket)
         if compiled is None:
             module = self._module
@@ -449,15 +458,7 @@ class JaxModel(BaseModel):
         self.predict_proba(np.zeros((1, *shape), np.float32))
 
     def _query_to_image(self, q: Any) -> np.ndarray:
-        arr = np.asarray(q)
-        if arr.ndim == 2:
-            arr = arr[..., None]
-        expected = tuple(self._meta["image_shape"])
-        if tuple(arr.shape) != expected:
-            raise ValueError(f"query shape {arr.shape} != {expected}")
-        if arr.dtype == np.uint8:
-            arr = arr.astype(np.float32) / 255.0
-        return arr.astype(np.float32)
+        return normalize_query(q, self._meta["image_shape"])
 
     # --- BaseModel: parameters ---
 
@@ -488,6 +489,7 @@ class JaxModel(BaseModel):
         self._predict_cache.clear()
         self._sharded_vars = None
         self._eval_step = None
+        self._extra_dev = None
 
     def destroy(self) -> None:
         self._invalidate_compiled()

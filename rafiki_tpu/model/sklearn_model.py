@@ -1,0 +1,99 @@
+"""SklearnModel: the scikit-learn implementation path of BaseModel.
+
+Parity: SURVEY.md §2 "Example models" — upstream bundles sklearn models
+(``SkDt``, ``SkSvm``) that train on flattened image pixels; they are the
+CPU-cheap members of the zoo (useful as ensemble diversity and as
+fast-trial filler while JAX models occupy the chips). The scaffolding
+here mirrors ``JaxModel``: subclasses only declare knobs and build an
+estimator.
+
+Parameters interchange: the fitted estimator is pickled into a uint8
+tensor under ``_sk/estimator`` so it round-trips through the ParamStore's
+flat ``{name: ndarray}`` format (safetensors-compatible).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import BaseModel, Params
+from .dataset import load_image_dataset, normalize_query
+from .logger import logger
+
+
+class SklearnModel(BaseModel):
+    """Base for sklearn-estimator-backed image classifiers."""
+
+    def __init__(self, **knobs: Any):
+        super().__init__(**knobs)
+        self._estimator = None
+        self._meta: Dict[str, Any] = {}
+
+    # --- Subclass API ---
+
+    def create_estimator(self):
+        raise NotImplementedError
+
+    # --- BaseModel contract ---
+
+    def train(self, dataset_path: str, *,
+              shared_params: Optional[Params] = None, **kwargs: Any) -> None:
+        ds = load_image_dataset(dataset_path)
+        x = ds.normalized().reshape(ds.size, -1)
+        y = ds.labels
+        self._estimator = self.create_estimator()
+        self._estimator.fit(x, y)
+        self._meta = {"n_classes": int(ds.n_classes),
+                      "image_shape": list(ds.image_shape)}
+        acc = float(self._estimator.score(x, y))
+        logger.log(msg="sklearn fit done", train_acc=acc)
+
+    def evaluate(self, dataset_path: str) -> float:
+        assert self._estimator is not None
+        ds = load_image_dataset(dataset_path)
+        x = ds.normalized().reshape(ds.size, -1)
+        return float(self._estimator.score(x, ds.labels))
+
+    def predict(self, queries: List[Any]) -> List[Any]:
+        assert self._estimator is not None
+        if not queries:
+            return []
+        n_classes = self._meta["n_classes"]
+        imgs = [normalize_query(q, self._meta["image_shape"]).reshape(-1)
+                for q in queries]
+        x = np.stack(imgs)
+        # Map estimator.classes_ columns back onto the full label range so
+        # the Predictor can average probabilities across heterogeneous
+        # ensemble members.
+        probs = np.zeros((len(imgs), n_classes), np.float32)
+        raw = self._estimator.predict_proba(x)
+        for col, cls in enumerate(self._estimator.classes_):
+            probs[:, int(cls)] = raw[:, col]
+        return [p.tolist() for p in probs]
+
+    def dump_parameters(self) -> Params:
+        assert self._estimator is not None
+        buf = io.BytesIO()
+        pickle.dump(self._estimator, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        return {
+            "_sk/estimator": np.frombuffer(buf.getvalue(), np.uint8),
+            "_meta/n_classes": np.asarray(self._meta["n_classes"]),
+            "_meta/image_shape": np.asarray(self._meta["image_shape"]),
+        }
+
+    def load_parameters(self, params: Params) -> None:
+        blob = params.get("_sk/estimator")
+        assert blob is not None, "params missing _sk/estimator"
+        self._estimator = pickle.loads(np.asarray(blob).tobytes())
+        self._meta = {
+            "n_classes": int(np.asarray(params["_meta/n_classes"]).reshape(-1)[0]),
+            "image_shape": [int(v) for v in
+                            np.asarray(params["_meta/image_shape"])],
+        }
+
+    def destroy(self) -> None:
+        self._estimator = None

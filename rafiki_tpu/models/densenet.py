@@ -26,6 +26,7 @@ import numpy as np
 import optax
 
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob, PolicyKnob
+from ..model.dataset import pad_crop_flip
 from ..model.jax_model import JaxModel
 
 
@@ -140,19 +141,4 @@ class JaxDenseNet(JaxModel):
 
     def augment_batch(self, images: np.ndarray,
                       rng: np.random.Generator) -> np.ndarray:
-        """Pad-4 random crop + horizontal flip (CIFAR recipe), host-side."""
-        n, h, w, _ = images.shape
-        pad = 4
-        padded = np.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
-                        mode="reflect")
-        ys = rng.integers(0, 2 * pad + 1, size=n)
-        xs = rng.integers(0, 2 * pad + 1, size=n)
-        # Vectorized gather: this hook runs host-side every optimizer step,
-        # so it must not serialize a Python loop against the device.
-        rows = ys[:, None] + np.arange(h)            # (n, h)
-        cols = xs[:, None] + np.arange(w)            # (n, w)
-        out = padded[np.arange(n)[:, None, None],
-                     rows[:, :, None], cols[:, None, :]]
-        flips = rng.random(n) < 0.5
-        out[flips] = out[flips, :, ::-1]
-        return out
+        return pad_crop_flip(images, rng)

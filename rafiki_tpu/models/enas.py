@@ -41,6 +41,7 @@ import numpy as np
 import optax
 
 from ..model import ArchKnob, FixedKnob, PolicyKnob
+from ..model.dataset import pad_crop_flip
 from ..model.jax_model import JaxModel
 
 N_OPS = 5  # identity, sep-conv 3x3, sep-conv 5x5, avg-pool 3x3, max-pool 3x3
@@ -251,15 +252,6 @@ class JaxEnas(JaxModel):
         arch = np.asarray([int(v) for v in self.knobs["arch"]], np.int32)
         return {"arch": arch.reshape(2, type(self).n_blocks, 4)}
 
-    def train(self, dataset_path: str, *, shared_params=None,
-              **kwargs: Any) -> None:
-        # QUICK_TRAIN caps epochs at trial_epochs (search trials take a
-        # short pass over shared weights; upstream TfEnas semantics).
-        if self.knobs.get("quick_train", False):
-            self.knobs = dict(self.knobs,
-                              max_epochs=int(self.knobs.get("trial_epochs", 1)))
-        super().train(dataset_path, shared_params=shared_params, **kwargs)
-
     def create_optimizer(self, steps_per_epoch: int,
                          max_epochs: int) -> optax.GradientTransformation:
         # Child-model recipe: SGD momentum + cosine decay (ENAS paper).
@@ -274,18 +266,4 @@ class JaxEnas(JaxModel):
 
     def augment_batch(self, images: np.ndarray,
                       rng: np.random.Generator) -> np.ndarray:
-        if images.shape[1] < 8:
-            return images
-        n, h, w, _ = images.shape
-        pad = 4
-        padded = np.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
-                        mode="reflect")
-        ys = rng.integers(0, 2 * pad + 1, size=n)
-        xs = rng.integers(0, 2 * pad + 1, size=n)
-        rows = ys[:, None] + np.arange(h)
-        cols = xs[:, None] + np.arange(w)
-        out = padded[np.arange(n)[:, None, None],
-                     rows[:, :, None], cols[:, None, :]]
-        flips = rng.random(n) < 0.5
-        out[flips] = out[flips, :, ::-1]
-        return out
+        return pad_crop_flip(images, rng)

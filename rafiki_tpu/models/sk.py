@@ -1,0 +1,58 @@
+"""SkDt / SkSvm: parity models for the reference's sklearn zoo entries.
+
+Parity: SURVEY.md §2 "Example models" — upstream bundles a decision tree
+(``SkDt``) and an SVM (``SkSvm``) for image classification over flattened
+pixels. They fill two platform roles: cheap CPU trials while JAX models
+hold the chips, and classifier diversity for the Predictor's ensemble.
+"""
+
+from __future__ import annotations
+
+from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
+from ..model.sklearn_model import SklearnModel
+
+
+class SkDt(SklearnModel):
+    """Decision-tree classifier on flattened pixels."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "max_depth": IntegerKnob(2, 16),
+            "criterion": CategoricalKnob(["gini", "entropy"]),
+            "min_samples_leaf": IntegerKnob(1, 8),
+        }
+
+    def create_estimator(self):
+        from sklearn.tree import DecisionTreeClassifier
+        return DecisionTreeClassifier(
+            max_depth=int(self.knobs["max_depth"]),
+            criterion=str(self.knobs["criterion"]),
+            min_samples_leaf=int(self.knobs["min_samples_leaf"]),
+            random_state=0,
+        )
+
+
+class SkSvm(SklearnModel):
+    """Linear-kernel SVM with probability calibration."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "C": FloatKnob(1e-2, 1e2, is_exp=True),
+            "kernel": CategoricalKnob(["linear", "rbf"]),
+            "max_iter": FixedKnob(1000),
+        }
+
+    def create_estimator(self):
+        from sklearn.calibration import CalibratedClassifierCV
+        from sklearn.svm import SVC
+        svc = SVC(
+            C=float(self.knobs["C"]),
+            kernel=str(self.knobs["kernel"]),
+            max_iter=int(self.knobs["max_iter"]),
+            random_state=0,
+        )
+        # sklearn 1.9 emits a FutureWarning that SVC(probability=True)
+        # will be removed in 1.11 and points here instead.
+        return CalibratedClassifierCV(svc, cv=3, ensemble=False)
