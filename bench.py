@@ -1,12 +1,21 @@
-"""Benchmark: AutoML trials/hour on the PR1 reference config.
+"""Benchmarks over the BASELINE.md configs; prints ONE JSON line.
 
-Runs K full trials (propose -> train -> evaluate) of JaxFeedForward on a
-synthetic fashion-MNIST-shaped dataset on the available accelerator and
-prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default (no args): AutoML trials/hour on the PR1 reference config —
+K full trials (propose -> train -> evaluate) of JaxFeedForward on a
+synthetic fashion-MNIST-shaped dataset.
+
+``--config serving``: ensemble-inference QPS through the real serving
+path (Predictor HTTP -> bus scatter/gather -> InferenceWorker AOT
+predict), BASELINE config[3].
+
+``--config multitenant``: aggregate trials/hour of two concurrent train
+jobs contending for chip ranges, BASELINE config[4] (needs >= 2 devices;
+run on the CPU mesh via JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8).
 
 The reference publishes no numbers (BASELINE.md): the first recorded run
-of this script on TPU establishes the baseline. BASELINE_TRIALS_PER_HOUR
-below is that recorded figure; update it when re-baselining.
+of each config on TPU establishes its baseline; the BASELINE_* constants
+below are those recorded figures; update them when re-baselining.
 """
 
 from __future__ import annotations
@@ -19,6 +28,8 @@ import numpy as np
 # Recorded from the first v5e-1 run of this script (see BASELINE.md).
 # None => this run establishes the baseline (vs_baseline = 1.0).
 BASELINE_TRIALS_PER_HOUR = None
+BASELINE_SERVING_QPS = None
+BASELINE_MT_TRIALS_PER_HOUR = None
 
 N_TRIALS = 3
 N_TRAIN, N_VAL = 4096, 512
@@ -72,5 +83,165 @@ def _run_trial(model_class, advisor, train_path: str, val_path: str) -> float:
     return score
 
 
+def _emit(metric: str, value: float, unit: str, baseline) -> None:
+    vs = 1.0 if baseline is None else value / baseline
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, "vs_baseline": round(vs, 3)}))
+
+
+def main_serving() -> None:
+    """Config[3]: ensemble QPS through Predictor HTTP + workers."""
+    import tempfile
+
+    import requests
+
+    from rafiki_tpu.cache import encode_payload
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.platform import LocalPlatform
+
+    import jax
+
+    n_chips = len(jax.devices())
+    max_models = min(2, n_chips)  # ensemble size bounded by the slice
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256)
+        platform = LocalPlatform(workdir=tmp + "/plat", http=True)
+        try:
+            user = platform.admin.create_user("b@x.c", "pw",
+                                              UserType.MODEL_DEVELOPER)
+            model = platform.admin.create_model(
+                user["id"], "ff", TaskType.IMAGE_CLASSIFICATION,
+                "rafiki_tpu.models.feedforward:JaxFeedForward")
+            job = platform.admin.create_train_job(
+                user["id"], "bench", TaskType.IMAGE_CLASSIFICATION,
+                [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: max_models},
+                train_path, val_path)
+            assert platform.admin.wait_until_train_job_done(job["id"],
+                                                            timeout=1200)
+            inf = platform.admin.create_inference_job(
+                user["id"], job["id"], max_models=max_models)
+            host = platform.admin.get_inference_job(
+                inf["id"])["predictor_host"]
+
+            val = load_image_dataset(val_path)
+            batch = [encode_payload(val.images[i % val.size])
+                     for i in range(64)]
+            url = f"http://{host}/predict"
+            # Warm-up (first request pays worker registration waits).
+            requests.post(url, json={"queries": batch}, timeout=300)
+
+            # Concurrent clients: measure server capacity, not one
+            # client's request latency.
+            import threading
+
+            counts = [0] * 4
+            errors: list = []
+            stop = threading.Event()
+
+            def client(i: int) -> None:
+                session = requests.Session()
+                try:
+                    while not stop.is_set():
+                        r = session.post(url, json={"queries": batch},
+                                         timeout=300)
+                        r.raise_for_status()
+                        counts[i] += len(batch)
+                except Exception as e:  # a dead client would silently
+                    errors.append(e)    # deflate the measured QPS
+                    stop.set()
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(counts))]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            time.sleep(20.0)
+            stop.set()
+            for t in threads:
+                t.join()
+            elapsed = time.time() - t0
+            if errors:
+                raise RuntimeError(f"bench client failed: {errors[0]}")
+            n_queries = sum(counts)
+            platform.admin.stop_inference_job(inf["id"])
+        finally:
+            platform.shutdown()
+    _emit("ensemble_inference_qps", n_queries / elapsed, "queries/s",
+          BASELINE_SERVING_QPS)
+
+
+def main_multitenant() -> None:
+    """Config[4]: aggregate trials/hour, two jobs contending for chips."""
+    import tempfile
+
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.platform import LocalPlatform
+
+    import jax
+
+    n_chips = len(jax.devices())
+    if n_chips < 2:
+        raise SystemExit("multitenant bench needs >= 2 devices "
+                         "(run on a slice or the virtual CPU mesh)")
+    trials_per_job = 4
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256)
+        platform = LocalPlatform(workdir=tmp + "/plat")
+        try:
+            t0 = time.time()
+            jobs = []
+            for i in range(2):
+                user = platform.admin.create_user(
+                    f"t{i}@x.c", "pw", UserType.MODEL_DEVELOPER)
+                model = platform.admin.create_model(
+                    user["id"], f"ff{i}", TaskType.IMAGE_CLASSIFICATION,
+                    "rafiki_tpu.models.feedforward:JaxFeedForward")
+                jobs.append(platform.admin.create_train_job(
+                    user["id"], f"app{i}", TaskType.IMAGE_CLASSIFICATION,
+                    [model["id"]],
+                    {BudgetOption.MODEL_TRIAL_COUNT: trials_per_job,
+                     BudgetOption.CHIP_COUNT: n_chips // 2},
+                    train_path, val_path))
+            for j in jobs:
+                assert platform.admin.wait_until_train_job_done(
+                    j["id"], timeout=1800)
+            elapsed = time.time() - t0
+        finally:
+            platform.shutdown()
+    total = 2 * trials_per_job
+    _emit("multitenant_trials_per_hour", total / (elapsed / 3600.0),
+          "trials/hour", BASELINE_MT_TRIALS_PER_HOUR)
+
+
+def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int):
+    from rafiki_tpu.datasets import make_synthetic_image_dataset
+
+    return make_synthetic_image_dataset(
+        tmp, n_train=n_train, n_val=n_val, image_shape=IMAGE_SHAPE,
+        n_classes=N_CLASSES)
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+    import os
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="trials",
+                        choices=["trials", "serving", "multitenant"])
+    args = parser.parse_args()
+
+    # The TPU sitecustomize imports jax at interpreter startup, latching
+    # JAX_PLATFORMS before this script runs; honor a cpu request (used to
+    # bench multi-chip configs on the virtual CPU mesh) via jax.config.
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    {"trials": main, "serving": main_serving,
+     "multitenant": main_multitenant}[args.config]()

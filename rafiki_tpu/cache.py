@@ -52,8 +52,46 @@ def decode_payload(value: Any) -> Any:
 
 
 class Cache:
+    # A reply landing after its gather timed out (and deleted the queue)
+    # recreates the queue with nobody left to pop it; deferred reaping
+    # sweeps those orphans on later gather calls.
+    _REAP_DELAY = 60.0
+
     def __init__(self, bus: BaseBus):
         self.bus = bus
+        self._reap_later: List[tuple] = []  # (monotonic_ts, queue_key)
+
+    def _reap_stale(self, now: float) -> None:
+        keep = []
+        for ts, key in self._reap_later:
+            if now - ts >= self._REAP_DELAY:
+                self.bus.delete_queue(key)
+            else:
+                keep.append((ts, key))
+        self._reap_later = keep
+
+    def _gather(self, queue_key: str, n_workers: int, timeout: float,
+                decode: Any) -> List[Dict[str, Any]]:
+        """Pop up to ``n_workers`` replies off a one-shot reply queue,
+        then reap it; stragglers are swept by deferred reaping."""
+        import time
+
+        now = time.monotonic()
+        self._reap_stale(now)
+        out: List[Dict[str, Any]] = []
+        deadline = now + timeout
+        while len(out) < n_workers:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            item = self.bus.pop(queue_key, timeout=remaining)
+            if item is None:
+                break
+            out.append(decode(item))
+        self.bus.delete_queue(queue_key)
+        if len(out) < n_workers:
+            self._reap_later.append((time.monotonic(), queue_key))
+        return out
 
     # --- Worker registry ---
 
@@ -81,32 +119,56 @@ class Cache:
     def gather_predictions(self, query_id: str, n_workers: int,
                            timeout: float = 5.0) -> List[Dict[str, Any]]:
         """Collect up to ``n_workers`` worker replies for one query."""
-        out: List[Dict[str, Any]] = []
-        import time
-        deadline = time.monotonic() + timeout
-        while len(out) < n_workers:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
-            item = self.bus.pop(f"r:{query_id}", timeout=remaining)
-            if item is None:
-                break
+        def decode(item):
             item["prediction"] = decode_payload(item["prediction"])
-            out.append(item)
-        # One-shot queue: reap it (and any reply landing after timeout).
-        self.bus.delete_queue(f"r:{query_id}")
-        return out
+            return item
+
+        return self._gather(f"r:{query_id}", n_workers, timeout, decode)
+
+    # --- Query batches (Predictor side) ---
+    #
+    # One message per (request, worker) instead of one per (query,
+    # worker): the serving QPS ceiling is bus round-trips, not chip
+    # compute, so the scatter/gather rides batch-granular frames.
+
+    def send_query_batch(self, worker_id: str, queries: List[Any],
+                         batch_id: Optional[str] = None,
+                         pre_encoded: bool = False) -> str:
+        """``pre_encoded=True`` lets a caller scattering the same batch
+        to many workers pay ``encode_payload`` once, not once per
+        worker (the serving hot path)."""
+        batch_id = batch_id or uuid.uuid4().hex
+        if not pre_encoded:
+            queries = [encode_payload(q) for q in queries]
+        self.bus.push(f"q:{worker_id}", {
+            "batch_id": batch_id, "queries": queries})
+        return batch_id
+
+    def gather_prediction_batches(self, batch_id: str, n_workers: int,
+                                  timeout: float = 5.0,
+                                  ) -> List[Dict[str, Any]]:
+        """Collect up to ``n_workers`` per-worker batch replies."""
+        def decode(item):
+            item["predictions"] = [decode_payload(p)
+                                   for p in item["predictions"]]
+            return item
+
+        return self._gather(f"r:{batch_id}", n_workers, timeout, decode)
 
     # --- Queries (InferenceWorker side) ---
 
     def pop_queries(self, worker_id: str, max_items: int = 0,
                     timeout: float = 1.0) -> List[Dict[str, Any]]:
-        """Blocking batched pop: waits for the first query, drains the
-        burst (the batched-TPU-inference pattern)."""
+        """Blocking batched pop: waits for the first item, drains the
+        burst (the batched-TPU-inference pattern). Items are single
+        queries (``query``) or batches (``queries``)."""
         items = self.bus.pop_all(f"q:{worker_id}", max_items=max_items,
                                  timeout=timeout)
         for it in items:
-            it["query"] = decode_payload(it["query"])
+            if "queries" in it:
+                it["queries"] = [decode_payload(q) for q in it["queries"]]
+            else:
+                it["query"] = decode_payload(it["query"])
         return items
 
     def send_prediction(self, query_id: str, worker_id: str,
@@ -114,3 +176,9 @@ class Cache:
         self.bus.push(f"r:{query_id}", {
             "worker_id": worker_id,
             "prediction": encode_payload(prediction)})
+
+    def send_prediction_batch(self, batch_id: str, worker_id: str,
+                              predictions: List[Any]) -> None:
+        self.bus.push(f"r:{batch_id}", {
+            "worker_id": worker_id,
+            "predictions": [encode_payload(p) for p in predictions]})

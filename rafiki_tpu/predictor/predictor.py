@@ -73,25 +73,35 @@ class Predictor:
             time.sleep(0.2)
 
     def predict(self, queries: List[Any]) -> List[Optional[Any]]:
-        """Scatter-gather-ensemble a batch of queries."""
+        """Scatter-gather-ensemble a batch of queries.
+
+        Batch-granular frames: ONE bus message per worker carries the
+        whole request, and each worker replies once — the scatter/gather
+        cost is O(workers), not O(queries x workers).
+        """
         workers = self._wait_workers()
         if not workers:
             raise RuntimeError(
                 f"no running inference workers for job "
                 f"{self.inference_job_id}")
-        query_ids = []
-        for q in queries:
-            qid = None
-            for w in workers:
-                qid = self.cache.send_query(w, q, query_id=qid)
-            query_ids.append(qid)
+        if not queries:
+            return []
+        from ..cache import encode_payload
+
+        encoded = [encode_payload(q) for q in queries]  # once, not per worker
+        batch_id = None
+        for w in workers:
+            batch_id = self.cache.send_query_batch(w, encoded,
+                                                   batch_id=batch_id,
+                                                   pre_encoded=True)
+        replies = self.cache.gather_prediction_batches(
+            batch_id, n_workers=len(workers), timeout=self.gather_timeout)
+        if len(replies) < len(workers):
+            _log.warning("batch %s: %d/%d workers replied", batch_id,
+                         len(replies), len(workers))
         results: List[Optional[Any]] = []
-        for qid in query_ids:
-            replies = self.cache.gather_predictions(
-                qid, n_workers=len(workers), timeout=self.gather_timeout)
-            if len(replies) < len(workers):
-                _log.warning("query %s: %d/%d workers replied", qid,
-                             len(replies), len(workers))
+        for i in range(len(queries)):
             results.append(ensemble_predictions(
-                [r["prediction"] for r in replies]))
+                [r["predictions"][i] for r in replies
+                 if i < len(r["predictions"])]))
         return results

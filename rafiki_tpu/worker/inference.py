@@ -111,11 +111,27 @@ class InferenceWorker:
                                          self.service_id)
 
     def _serve_batch(self, items: list) -> None:
-        queries = [it["query"] for it in items]
+        # A burst may mix batch frames and single-query frames; flatten
+        # into ONE chip-side predict call, then split replies per frame.
+        flat: list = []
+        spans: list = []  # (item, start, count, is_batch)
+        for it in items:
+            if "queries" in it:
+                spans.append((it, len(flat), len(it["queries"]), True))
+                flat.extend(it["queries"])
+            else:
+                spans.append((it, len(flat), 1, False))
+                flat.append(it["query"])
         try:
-            predictions = self._model.predict(queries)
+            predictions = self._model.predict(flat)
         except Exception as e:
-            _log.exception("predict failed on batch of %d", len(queries))
-            predictions = [{"error": f"{type(e).__name__}: {e}"}] * len(queries)
-        for it, pred in zip(items, predictions):
-            self.cache.send_prediction(it["query_id"], self.service_id, pred)
+            _log.exception("predict failed on batch of %d", len(flat))
+            predictions = [{"error": f"{type(e).__name__}: {e}"}] * len(flat)
+        for it, start, count, is_batch in spans:
+            if is_batch:
+                self.cache.send_prediction_batch(
+                    it["batch_id"], self.service_id,
+                    predictions[start:start + count])
+            else:
+                self.cache.send_prediction(it["query_id"], self.service_id,
+                                           predictions[start])

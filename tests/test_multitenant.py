@@ -1,0 +1,111 @@
+"""Multi-tenant chip-scheduler stress (BASELINE config[4], SURVEY.md §7
+step 10): concurrent train jobs from different users contending for the
+slice's chip ranges; fairness, graceful degradation, and accounting.
+"""
+
+import time
+
+import pytest
+
+from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+from rafiki_tpu.platform import LocalPlatform
+
+FF_CLASS = "rafiki_tpu.models.feedforward:JaxFeedForward"
+
+FAST_BUDGET = {BudgetOption.MODEL_TRIAL_COUNT: 4}
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = LocalPlatform(workdir=str(tmp_path / "plat"))
+    yield p
+    p.shutdown()
+
+
+def _tenant(platform, i):
+    user = platform.admin.create_user(f"t{i}@x.c", "pw",
+                                      UserType.MODEL_DEVELOPER)
+    model = platform.admin.create_model(
+        user["id"], f"ff{i}", TaskType.IMAGE_CLASSIFICATION, FF_CLASS)
+    return user, model
+
+
+def test_two_tenants_contend_and_complete(platform, synth_image_data):
+    """Two jobs each claim half the slice; both run concurrently at full
+    utilization and both finish with all trials completed."""
+    train_path, val_path = synth_image_data
+    jobs = []
+    for i in range(2):
+        user, model = _tenant(platform, i)
+        job = platform.admin.create_train_job(
+            user["id"], f"app{i}", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]],
+            {**FAST_BUDGET, BudgetOption.CHIP_COUNT: 4},
+            train_path, val_path)
+        jobs.append(job)
+
+    # Both jobs hold their ranges simultaneously: the slice is full.
+    assert platform.services.chip_utilization() == 1.0
+    assert platform.allocator.free_chips == 0
+
+    max_util = 0.0
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline:
+        max_util = max(max_util, platform.services.chip_utilization())
+        done = [platform.admin.get_train_job(j["id"])["status"] == "STOPPED"
+                for j in jobs]
+        if all(done):
+            break
+        time.sleep(0.5)
+    assert all(platform.admin.get_train_job(j["id"])["status"] == "STOPPED"
+               for j in jobs), "jobs did not finish under contention"
+    assert max_util == 1.0
+
+    for j in jobs:
+        detail = platform.admin.get_train_job(j["id"])
+        assert detail["sub_train_jobs"][0]["n_completed"] == \
+            FAST_BUDGET[BudgetOption.MODEL_TRIAL_COUNT]
+        assert detail["sub_train_jobs"][0]["n_errored"] == 0
+    # Every chip returned to the pool.
+    assert platform.allocator.free_chips == platform.allocator.n_chips
+
+
+def test_oversubscribed_job_degrades_gracefully(platform, synth_image_data):
+    """A job asking for more chips than the slice holds runs with fewer
+    workers instead of failing (trials queue behind the smaller pool)."""
+    train_path, val_path = synth_image_data
+    user, model = _tenant(platform, 0)
+    job = platform.admin.create_train_job(
+        user["id"], "big", TaskType.IMAGE_CLASSIFICATION, [model["id"]],
+        {**FAST_BUDGET, BudgetOption.CHIP_COUNT: 2 * platform.allocator.n_chips},
+        train_path, val_path)
+    # The whole slice is working, but nothing was over-allocated.
+    assert platform.allocator.free_chips == 0
+    assert platform.admin.wait_until_train_job_done(job["id"], timeout=600)
+    detail = platform.admin.get_train_job(job["id"])
+    assert detail["sub_train_jobs"][0]["n_completed"] == \
+        FAST_BUDGET[BudgetOption.MODEL_TRIAL_COUNT]
+    assert platform.allocator.free_chips == platform.allocator.n_chips
+
+
+def test_job_rejected_when_slice_full_no_leak(platform, synth_image_data):
+    """With zero free chips a new job fails fast — and leaks neither
+    chips nor running services."""
+    train_path, val_path = synth_image_data
+    hold = platform.allocator.allocate(platform.allocator.n_chips,
+                                       name="hog")
+    assert hold is not None
+    user, model = _tenant(platform, 0)
+    with pytest.raises(RuntimeError, match="no chips"):
+        platform.admin.create_train_job(
+            user["id"], "starved", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]], dict(FAST_BUDGET), train_path, val_path)
+    assert platform.allocator.free_chips == 0  # only the hog's chips held
+
+    # Once the hog releases, the same tenant's next job succeeds.
+    platform.allocator.release("hog")
+    job = platform.admin.create_train_job(
+        user["id"], "starved", TaskType.IMAGE_CLASSIFICATION,
+        [model["id"]], dict(FAST_BUDGET), train_path, val_path)
+    assert platform.admin.wait_until_train_job_done(job["id"], timeout=600)
+    assert platform.allocator.free_chips == platform.allocator.n_chips
