@@ -1,0 +1,38 @@
+"""Prepare CIFAR-10 in the platform dataset format.
+
+Parity: SURVEY.md §2 "Dataset prep scripts". With ``--raw-dir`` pointing
+at ``cifar-10-batches-py`` (what the upstream script downloads), converts
+it; with ``--synthetic``, writes a shape-identical synthetic stand-in.
+
+    python examples/datasets/cifar10.py --out-dir data/ --synthetic
+    python examples/datasets/cifar10.py --out-dir data/ \
+        --raw-dir ~/downloads/cifar10/
+"""
+
+import argparse
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--raw-dir", help="directory holding cifar-10-batches-py")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate a synthetic stand-in instead")
+    args = p.parse_args()
+
+    if args.synthetic:
+        from rafiki_tpu.datasets import make_synthetic_image_dataset
+        train, val = make_synthetic_image_dataset(
+            args.out_dir, n_train=8192, n_val=1024,
+            image_shape=(32, 32, 3), n_classes=10, name="cifar10")
+    else:
+        if not args.raw_dir:
+            raise SystemExit("--raw-dir or --synthetic is required")
+        from rafiki_tpu.datasets import prepare_cifar10
+        train, val = prepare_cifar10(args.raw_dir, args.out_dir)
+    print("train:", train)
+    print("val:  ", val)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Prepare fashion-MNIST in the platform dataset format.
+
+Parity: SURVEY.md §2 "Dataset prep scripts". With ``--raw-dir`` pointing
+at the standard IDX files (what the upstream script downloads), converts
+them; with ``--synthetic``, writes a shape-identical synthetic stand-in
+(this environment has no network).
+
+    python examples/datasets/fashion_mnist.py --out-dir data/ --synthetic
+    python examples/datasets/fashion_mnist.py --out-dir data/ \
+        --raw-dir ~/downloads/fashion-mnist/
+"""
+
+import argparse
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--raw-dir", help="directory with the IDX ubyte files")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate a synthetic stand-in instead")
+    args = p.parse_args()
+
+    if args.synthetic:
+        from rafiki_tpu.datasets import make_synthetic_image_dataset
+        train, val = make_synthetic_image_dataset(
+            args.out_dir, n_train=8192, n_val=1024,
+            image_shape=(28, 28, 1), n_classes=10, name="fashion_mnist")
+    else:
+        if not args.raw_dir:
+            raise SystemExit("--raw-dir or --synthetic is required")
+        from rafiki_tpu.datasets import prepare_fashion_mnist
+        train, val = prepare_fashion_mnist(args.raw_dir, args.out_dir)
+    print("train:", train)
+    print("val:  ", val)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Example custom model, uploaded as source by a model developer.
+
+Parity: SURVEY.md §3.4 — upstream model developers write a ``BaseModel``
+subclass in a file and upload it; workers re-materialise the class from
+the stored source (``rafiki_tpu.utils.model_loader``). This file is that
+workflow's example: a logistic-regression-style single-layer JAX model.
+
+Local self-check (the model-developer loop):
+
+    python examples/models/my_model.py
+"""
+
+import flax.linen as nn
+
+from rafiki_tpu.model import CategoricalKnob, FixedKnob, FloatKnob
+from rafiki_tpu.model.jax_model import JaxModel
+
+
+class _Linear(nn.Module):
+    n_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.n_classes)(x.reshape((x.shape[0], -1)))
+
+
+class MyModel(JaxModel):
+    """Single linear layer: the smallest possible JaxModel."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "learning_rate": FloatKnob(1e-3, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([32, 64]),
+            "max_epochs": FixedKnob(3),
+        }
+
+    def create_module(self, n_classes, image_shape):
+        return _Linear(n_classes=n_classes)
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    from rafiki_tpu.datasets import make_synthetic_image_dataset
+    from rafiki_tpu.model import test_model_class
+
+    tmp = tempfile.mkdtemp()
+    train, val = make_synthetic_image_dataset(tmp, n_train=512, n_val=128)
+    result = test_model_class(MyModel, "IMAGE_CLASSIFICATION", train, val,
+                              test_queries=None)
+    print("score:", result.score)

@@ -1,0 +1,114 @@
+"""Real-dataset converters → platform dataset format.
+
+Parity: SURVEY.md §2 "Dataset prep scripts" — upstream ships scripts that
+download fashion-MNIST / CIFAR-10 and convert them to the Rafiki dataset
+format. This environment has no network, so these converters read the
+standard distribution files from a local directory instead (the same
+files the upstream scripts download):
+
+- fashion-MNIST: IDX ubyte files (``train-images-idx3-ubyte[.gz]`` etc).
+- CIFAR-10: the python pickle batches (``cifar-10-batches-py/``).
+
+``examples/datasets/*.py`` are the CLI wrappers; with no raw data they
+fall back to shape-identical synthetic datasets (``synth.py``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..model.dataset import write_image_dataset_npz
+
+
+def _open_maybe_gz(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _find(raw_dir: str, stem: str) -> Optional[str]:
+    for suffix in ("", ".gz"):
+        p = os.path.join(raw_dir, stem + suffix)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 0x803:
+            raise ValueError(f"{path}: bad IDX image magic {magic:#x}")
+        data = np.frombuffer(f.read(), np.uint8)
+    return data.reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 0x801:
+            raise ValueError(f"{path}: bad IDX label magic {magic:#x}")
+        return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+
+def prepare_fashion_mnist(raw_dir: str, out_dir: str,
+                          val_frac: float = 0.0) -> Tuple[str, str]:
+    """Convert IDX files in ``raw_dir`` → train/val npz datasets.
+
+    ``val_frac`` > 0 carves the validation set out of the train split
+    (upstream evaluates on the test split; pass 0 to do the same with the
+    t10k files).
+    """
+    files = {stem: _find(raw_dir, stem) for stem in (
+        "train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+        "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")}
+    missing = [s for s, p in files.items() if p is None]
+    if missing:
+        raise FileNotFoundError(
+            f"fashion-MNIST files missing under {raw_dir}: {missing}")
+    tr_x = _read_idx_images(files["train-images-idx3-ubyte"])
+    tr_y = _read_idx_labels(files["train-labels-idx1-ubyte"])
+    te_x = _read_idx_images(files["t10k-images-idx3-ubyte"])
+    te_y = _read_idx_labels(files["t10k-labels-idx1-ubyte"])
+    if val_frac > 0:
+        n_val = int(len(tr_x) * val_frac)
+        te_x, te_y = tr_x[-n_val:], tr_y[-n_val:]
+        tr_x, tr_y = tr_x[:-n_val], tr_y[:-n_val]
+    os.makedirs(out_dir, exist_ok=True)
+    train_path = write_image_dataset_npz(
+        tr_x, tr_y, os.path.join(out_dir, "fashion_mnist_train.npz"), 10)
+    val_path = write_image_dataset_npz(
+        te_x, te_y, os.path.join(out_dir, "fashion_mnist_val.npz"), 10)
+    return train_path, val_path
+
+
+def prepare_cifar10(raw_dir: str, out_dir: str) -> Tuple[str, str]:
+    """Convert ``cifar-10-batches-py`` pickles → train/val npz datasets."""
+    batch_dir = raw_dir
+    if os.path.isdir(os.path.join(raw_dir, "cifar-10-batches-py")):
+        batch_dir = os.path.join(raw_dir, "cifar-10-batches-py")
+
+    def read_batch(name: str):
+        p = os.path.join(batch_dir, name)
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"CIFAR-10 batch missing: {p}")
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32)
+        return x.transpose(0, 2, 3, 1), np.asarray(d[b"labels"], np.int64)
+
+    xs, ys = zip(*[read_batch(f"data_batch_{i}") for i in range(1, 6)])
+    tr_x, tr_y = np.concatenate(xs), np.concatenate(ys)
+    te_x, te_y = read_batch("test_batch")
+    os.makedirs(out_dir, exist_ok=True)
+    train_path = write_image_dataset_npz(
+        tr_x, tr_y, os.path.join(out_dir, "cifar10_train.npz"), 10)
+    val_path = write_image_dataset_npz(
+        te_x, te_y, os.path.join(out_dir, "cifar10_val.npz"), 10)
+    return train_path, val_path

@@ -16,6 +16,7 @@ as either:
 from __future__ import annotations
 
 import importlib
+import sys
 import types
 from typing import Optional, Type
 
@@ -29,9 +30,22 @@ def model_class_path(cls: Type[BaseModel]) -> str:
 def load_model_class(model_class: str,
                      model_source: Optional[str] = None) -> Type[BaseModel]:
     if model_source:
-        mod = types.ModuleType(f"_rafiki_user_model_{abs(hash(model_source))}")
-        exec(compile(model_source, "<model_source>", "exec"), mod.__dict__)
-        cls = getattr(mod, model_class.split(":")[-1], None)
+        name = f"_rafiki_user_model_{abs(hash(model_source))}"
+        mod = types.ModuleType(name)
+        # Register before exec: dataclass-transform machinery (flax
+        # modules) resolves type hints via sys.modules[cls.__module__].
+        # The entry must outlive this call (the class object keeps
+        # resolving hints against it); keyed by source hash, re-loads of
+        # the same source replace it, so retention is bounded by the
+        # number of distinct sources the process ever loads.
+        sys.modules[name] = mod
+        try:
+            exec(compile(model_source, "<model_source>", "exec"),
+                 mod.__dict__)
+            cls = getattr(mod, model_class.split(":")[-1], None)
+        except BaseException:
+            del sys.modules[name]  # don't leak half-executed modules
+            raise
     else:
         module_name, _, qualname = model_class.partition(":")
         mod = importlib.import_module(module_name)

@@ -1,0 +1,98 @@
+"""Quickstart scripts as end-to-end tests (SURVEY.md §4: the upstream
+quickstarts are the de-facto integration suite). Each runs as a real
+subprocess on the virtual CPU mesh, exactly as a user would run it.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *argv, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *argv],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_quickstart_local_synthetic():
+    r = _run("examples/scripts/quickstart.py", "--local", "--synthetic",
+             "--trials", "2")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "QUICKSTART OK" in r.stdout
+
+
+def test_model_developer_upload_flow():
+    r = _run("examples/scripts/model_developer.py", "--local", "--synthetic")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MODEL_DEVELOPER OK" in r.stdout
+
+
+def test_dataset_prep_converters(tmp_path):
+    """The real-data converters parse the standard distribution formats
+    (synthesised here byte-for-byte: IDX and CIFAR pickle batches)."""
+    import gzip
+    import pickle
+    import struct
+
+    from rafiki_tpu.datasets import prepare_cifar10, prepare_fashion_mnist
+    from rafiki_tpu.model import load_image_dataset
+
+    rng = np.random.default_rng(0)
+
+    # fashion-MNIST IDX files (train gz, test plain: both paths).
+    raw = tmp_path / "fm"
+    raw.mkdir()
+
+    def idx_images(path, n, gz):
+        data = rng.integers(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        blob = struct.pack(">IIII", 0x803, n, 28, 28) + data.tobytes()
+        (gzip.open if gz else open)(str(path), "wb").write(blob)
+
+    def idx_labels(path, n, gz):
+        data = rng.integers(0, 10, size=n, dtype=np.uint8)
+        blob = struct.pack(">II", 0x801, n) + data.tobytes()
+        (gzip.open if gz else open)(str(path), "wb").write(blob)
+
+    idx_images(raw / "train-images-idx3-ubyte.gz", 64, True)
+    idx_labels(raw / "train-labels-idx1-ubyte.gz", 64, True)
+    idx_images(raw / "t10k-images-idx3-ubyte", 16, False)
+    idx_labels(raw / "t10k-labels-idx1-ubyte", 16, False)
+    train, val = prepare_fashion_mnist(str(raw), str(tmp_path / "fm_out"))
+    ds = load_image_dataset(train)
+    assert ds.size == 64 and tuple(ds.image_shape) == (28, 28, 1)
+    assert load_image_dataset(val).size == 16
+
+    # CIFAR-10 python batches.
+    craw = tmp_path / "cifar" / "cifar-10-batches-py"
+    craw.mkdir(parents=True)
+    for name, n in [(f"data_batch_{i}", 20) for i in range(1, 6)] + \
+                   [("test_batch", 10)]:
+        batch = {b"data": rng.integers(0, 256, size=(n, 3072),
+                                       dtype=np.uint8),
+                 b"labels": rng.integers(0, 10, size=n).tolist()}
+        with open(craw / name, "wb") as f:
+            pickle.dump(batch, f)
+    train, val = prepare_cifar10(str(tmp_path / "cifar"),
+                                 str(tmp_path / "cifar_out"))
+    ds = load_image_dataset(train)
+    assert ds.size == 100 and tuple(ds.image_shape) == (32, 32, 3)
+    assert load_image_dataset(val).size == 10
+
+
+def test_dataset_prep_cli_synthetic(tmp_path):
+    r = _run("examples/datasets/cifar10.py", "--out-dir", str(tmp_path),
+             "--synthetic", timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    from rafiki_tpu.model import load_image_dataset
+    ds = load_image_dataset(str(tmp_path / "cifar10_train.npz"))
+    assert tuple(ds.image_shape) == (32, 32, 3)
