@@ -27,7 +27,7 @@ import io
 import os
 import zipfile
 from dataclasses import dataclass
-from typing import Any, Iterator, List, Sequence, Tuple
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -196,8 +196,12 @@ def write_image_files_dataset(images: np.ndarray, labels: np.ndarray,
 
 
 def write_corpus_dataset(sentences: List[List[str]], tags: List[List[str]],
-                         out_path: str) -> str:
-    tag_names = sorted({t for sent in tags for t in sent})
+                         out_path: str,
+                         tag_names: Optional[List[str]] = None) -> str:
+    # An explicit tag vocabulary keeps tag-id spaces identical across
+    # splits even when a rare tag is absent from one of them.
+    if tag_names is None:
+        tag_names = sorted({t for sent in tags for t in sent})
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
     with zipfile.ZipFile(out_path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("tags.txt", "\n".join(tag_names) + "\n")

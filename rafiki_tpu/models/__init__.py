@@ -3,5 +3,8 @@
 from .densenet import JaxDenseNet
 from .enas import JaxEnas
 from .feedforward import JaxFeedForward
+from .pos_tagger import JaxPosTagger
+from .sk import SkDt, SkSvm
 
-__all__ = ["JaxFeedForward", "JaxDenseNet", "JaxEnas"]
+__all__ = ["JaxFeedForward", "JaxDenseNet", "JaxEnas", "JaxPosTagger",
+           "SkDt", "SkSvm"]

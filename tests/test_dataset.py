@@ -49,3 +49,13 @@ def test_corpus_roundtrip(tmp_path):
     assert ds.size == 2
     assert ds.sentences[0] == ["the", "cat", "sat"]
     assert [ds.tag_names[t] for t in ds.tags[1]] == ["NOUN", "VERB"]
+
+
+def test_corpus_splits_share_tag_id_space(tmp_path):
+    """A tag absent from the tiny val split must not shift val's tag ids."""
+    from rafiki_tpu.datasets import make_synthetic_corpus_dataset
+
+    tr, va = make_synthetic_corpus_dataset(
+        str(tmp_path), n_train=64, n_val=2, n_tags=12, max_len=4, seed=3)
+    assert (load_corpus_dataset(tr).tag_names
+            == load_corpus_dataset(va).tag_names)

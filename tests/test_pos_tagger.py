@@ -1,0 +1,54 @@
+"""JaxPosTagger (POS_TAGGING task parity, SURVEY.md §2 task types) tests."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.datasets import make_synthetic_corpus_dataset
+from rafiki_tpu.model import test_model_class
+from rafiki_tpu.model.dataset import load_corpus_dataset
+from rafiki_tpu.models import JaxPosTagger
+
+MAX_LEN = 64
+KNOBS = {"embed_dim": 32, "hidden": 32, "learning_rate": 5e-3,
+         "batch_size": 32, "max_epochs": 6, "max_len": MAX_LEN,
+         "vocab_size": 16384}
+
+
+@pytest.fixture(scope="module")
+def synth_corpus_data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("corpus")
+    return make_synthetic_corpus_dataset(str(out), n_train=192, n_val=48,
+                                         vocab=80, n_tags=5, max_len=10)
+
+
+def test_pos_tagger_end_to_end(synth_corpus_data):
+    train_path, val_path = synth_corpus_data
+    ds = load_corpus_dataset(val_path)
+    queries = ds.sentences[:3]
+    result = test_model_class(
+        JaxPosTagger, TaskType.POS_TAGGING, train_path, val_path,
+        test_queries=queries, knobs=KNOBS)
+    # 5 tags with a word->tag mapping signal; chance is 0.2.
+    assert result.score > 0.5
+    assert len(result.predictions) == 3
+    for q, pred in zip(queries, result.predictions):
+        assert len(pred) == min(len(q), MAX_LEN)
+        for dist in pred:  # per-token tag-probability distribution
+            assert len(dist) == 5
+            assert abs(sum(dist) - 1.0) < 1e-3
+
+
+def test_pos_tagger_params_roundtrip(synth_corpus_data):
+    train_path, val_path = synth_corpus_data
+    m = JaxPosTagger(**JaxPosTagger.validate_knobs(
+        {**KNOBS, "max_epochs": 3}))
+    m.train(train_path)
+    score = m.evaluate(val_path)
+    params = m.dump_parameters()
+    assert all(isinstance(v, np.ndarray) for v in params.values())
+
+    m2 = JaxPosTagger(**JaxPosTagger.validate_knobs(
+        {**KNOBS, "max_epochs": 3}))
+    m2.load_parameters(params)
+    assert abs(m2.evaluate(val_path) - score) < 1e-6
