@@ -1,0 +1,14 @@
+"""Observability: tracing, profiling, and chip-utilization metering.
+
+Parity+: SURVEY.md §5 "Tracing / profiling" — the reference has no
+first-party tracer (models used TF/Torch profilers ad hoc); the TPU-native
+rebuild makes tracing and utilization first-class: `jax.profiler` trace
+sessions per trial and an MFU (model FLOPs utilization) meter feeding the
+north-star "≥90% chip utilization" metric (BASELINE.md).
+"""
+
+from .profiling import (MfuMeter, device_peak_flops, flops_of_compiled,
+                        flops_of_lowered, trace_session, trial_trace_dir)
+
+__all__ = ["trace_session", "trial_trace_dir", "device_peak_flops",
+           "flops_of_lowered", "flops_of_compiled", "MfuMeter"]

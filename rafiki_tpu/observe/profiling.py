@@ -1,0 +1,161 @@
+"""Tracing and utilization metering (SURVEY.md §5, BASELINE.md north star).
+
+Two independent facilities:
+
+- **Trace sessions** — `jax.profiler.start_trace` wrapped in a context
+  manager, opt-in via the ``RAFIKI_TPU_TRACE_DIR`` env var. The TrialRunner
+  traces each trial into ``$RAFIKI_TPU_TRACE_DIR/<trial_id>/`` (viewable in
+  TensorBoard's profile plugin), so "why is this trial slow" is answerable
+  without code changes — per-trial toggles were the plan SURVEY.md §5 set
+  out for the rebuild.
+
+- **MFU metering** — model-FLOPs-utilization: achieved FLOP/s as a
+  fraction of the device's peak. FLOPs per step come from XLA's own cost
+  analysis of the *lowered* (pre-backend-compile) computation, so the
+  meter adds tracing cost only, never a second XLA compile. Peak FLOP/s
+  is looked up by device kind (bf16 peak — matmuls on the MXU run bf16);
+  unknown device kinds (e.g. the CPU test mesh) can be calibrated via
+  ``RAFIKI_TPU_PEAK_FLOPS``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+
+_log = logging.getLogger(__name__)
+
+TRACE_DIR_ENV = "RAFIKI_TPU_TRACE_DIR"
+PEAK_FLOPS_ENV = "RAFIKI_TPU_PEAK_FLOPS"
+
+# Peak dense-matmul FLOP/s per chip by device-kind substring (bf16, the
+# MXU's native training precision). Sources: public TPU spec sheets.
+_PEAK_FLOPS_BY_KIND = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,        # Trillium
+}
+
+
+def trial_trace_dir(trial_id: str) -> Optional[str]:
+    """Directory to trace this trial into, or None when tracing is off."""
+    root = os.environ.get(TRACE_DIR_ENV, "").strip()
+    if not root:
+        return None
+    return os.path.join(root, trial_id)
+
+
+@contextlib.contextmanager
+def trace_session(trace_dir: Optional[str]) -> Iterator[None]:
+    """Profile the enclosed block into ``trace_dir`` (no-op when None)."""
+    if not trace_dir:
+        yield
+        return
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        _log.info("trace written to %s", trace_dir)
+
+
+def device_peak_flops(device: Optional[Any] = None) -> Optional[float]:
+    """Peak FLOP/s of one device, or None when unknown."""
+    override = os.environ.get(PEAK_FLOPS_ENV, "").strip()
+    if override:
+        return float(override)
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for sub, peak in _PEAK_FLOPS_BY_KIND.items():
+        if sub in kind:
+            return peak
+    return None
+
+
+def _flops_of_cost(cost: Any) -> Optional[float]:
+    if isinstance(cost, list):  # some backends return one dict per module
+        cost = cost[0] if cost else {}
+    flops = (cost or {}).get("flops")
+    if flops is None or flops <= 0:
+        return None
+    return float(flops)
+
+
+def flops_of_lowered(lowered: Any) -> Optional[float]:
+    """FLOPs of one execution of a ``jax.stages.Lowered`` computation.
+
+    Uses the pre-compile cost analysis (tracing-cost only); the CPU
+    backend provides it, TPU does not (use ``flops_of_compiled`` there).
+    Returns None when the backend has no estimate.
+    """
+    try:
+        return _flops_of_cost(lowered.cost_analysis())
+    except Exception:
+        return None
+
+
+def flops_of_compiled(compiled: Any) -> Optional[float]:
+    """FLOPs of one execution of a ``jax.stages.Compiled`` executable —
+    XLA's post-compile cost model (available on TPU)."""
+    try:
+        return _flops_of_cost(compiled.cost_analysis())
+    except Exception:
+        return None
+
+
+class MfuMeter:
+    """Accumulates step counts against wall time → achieved FLOP/s and MFU.
+
+    ``flops_per_step`` is the whole-mesh cost of one (already sharded)
+    train step; ``n_devices`` scales the peak accordingly, so the reading
+    is utilization *of the chip group the trial runs on* — the quantity
+    the north star bounds (≥90% during train).
+    """
+
+    def __init__(self, flops_per_step: Optional[float],
+                 n_devices: int = 1,
+                 peak_flops_per_device: Optional[float] = None):
+        if peak_flops_per_device is None:
+            peak_flops_per_device = device_peak_flops()
+        self.flops_per_step = flops_per_step
+        self.peak = (peak_flops_per_device * n_devices
+                     if peak_flops_per_device else None)
+        self.n_steps = 0
+        self._t0 = time.time()
+
+    def tick(self, n_steps: int = 1) -> None:
+        self.n_steps += n_steps
+
+    def reset(self) -> None:
+        """Restart the measurement window (e.g. after the first-step
+        XLA compile, which is not part of steady-state utilization)."""
+        self.n_steps = 0
+        self._t0 = time.time()
+
+    @property
+    def elapsed(self) -> float:
+        return time.time() - self._t0
+
+    @property
+    def achieved_flops(self) -> Optional[float]:
+        """Achieved FLOP/s so far (None when the step cost is unknown)."""
+        if not self.flops_per_step or self.n_steps == 0:
+            return None
+        return self.flops_per_step * self.n_steps / max(self.elapsed, 1e-9)
+
+    @property
+    def mfu(self) -> Optional[float]:
+        """Fraction of peak [0, ~1], or None when peak/cost are unknown."""
+        achieved = self.achieved_flops
+        if achieved is None or not self.peak:
+            return None
+        return achieved / self.peak

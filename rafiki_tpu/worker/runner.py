@@ -19,6 +19,7 @@ from ..advisor.base import Proposal
 from ..constants import BudgetOption, TrialStatus
 from ..model.base import BaseModel
 from ..model.logger import logger
+from ..observe import trace_session, trial_trace_dir
 from ..store import MetaStore, ParamStore
 
 _log = logging.getLogger(__name__)
@@ -128,7 +129,12 @@ class TrialRunner:
                 worker_id=self.worker_id)
             model = self.model_class(**knobs)
             try:
-                model.train(self.train_dataset_path, shared_params=shared)
+                # Opt-in per-trial profiler trace (RAFIKI_TPU_TRACE_DIR);
+                # each trial's trace lands in its own TensorBoard-readable
+                # subdirectory (SURVEY.md §5 tracing plan).
+                with trace_session(trial_trace_dir(trial_id)):
+                    model.train(self.train_dataset_path,
+                                shared_params=shared)
                 score = float(model.evaluate(self.val_dataset_path))
                 params_id = self.params.save(
                     model.dump_parameters(),
