@@ -25,11 +25,14 @@ import time
 
 import numpy as np
 
-# Recorded from the first v5e-1 run of this script (see BASELINE.md).
-# None => this run establishes the baseline (vs_baseline = 1.0).
-BASELINE_TRIALS_PER_HOUR = None
-BASELINE_SERVING_QPS = None
-BASELINE_MT_TRIALS_PER_HOUR = None
+# Recorded from the first v5e-1 run of this script (see BASELINE.md,
+# 2026-07-30). None => this run establishes the baseline
+# (vs_baseline = 1.0).
+BASELINE_TRIALS_PER_HOUR = 268.0
+BASELINE_SERVING_QPS = 1097.0
+BASELINE_MT_TRIALS_PER_HOUR = None  # needs >= 2 chips; no TPU figure yet
+BASELINE_DENSENET_IMAGES_PER_SEC = 1504.0
+BASELINE_ENAS_TRIALS_PER_HOUR = 254.0
 
 N_TRIALS = 3
 N_TRAIN, N_VAL = 4096, 512
@@ -218,11 +221,80 @@ def main_multitenant() -> None:
           "trials/hour", BASELINE_MT_TRIALS_PER_HOUR)
 
 
-def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int):
+def main_densenet() -> None:
+    """Config[1]: flagship DenseNet-121 training throughput (CIFAR-10
+    shapes). A first train() pays the XLA compile; the timed second run
+    reuses the cached AOT step, so the figure is steady-state."""
+    import tempfile
+
+    from rafiki_tpu.datasets import make_synthetic_image_dataset
+    from rafiki_tpu.models import JaxDenseNet
+
+    epochs, batch = 6, 128  # min of the model's max_epochs knob range
+    knobs = JaxDenseNet.validate_knobs({
+        "arch": "densenet_121", "growth_rate": 32, "learning_rate": 0.1,
+        "batch_size": batch, "weight_decay": 1e-4, "max_epochs": epochs,
+        "early_stop_epochs": 5, "quick_train": False})
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, _ = make_synthetic_image_dataset(
+            tmp, n_train=2048, n_val=256, image_shape=(32, 32, 3),
+            n_classes=N_CLASSES)
+        warm = JaxDenseNet(**knobs)
+        warm.train(train_path)
+        warm.destroy()
+
+        m = JaxDenseNet(**knobs)
+        t0 = time.time()
+        m.train(train_path)
+        elapsed = time.time() - t0
+        m.destroy()
+
+    images = (2048 // batch) * batch * epochs
+    _emit("densenet_train_images_per_sec", images / elapsed, "images/s",
+          BASELINE_DENSENET_IMAGES_PER_SEC)
+
+
+def main_enas() -> None:
+    """Config[2]: ENAS architecture search — controller advisor proposing
+    architectures into weight-shared quick trials on the masked supernet."""
+    import tempfile
+
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.constants import BudgetOption
+    from rafiki_tpu.models import JaxEnas
+    from rafiki_tpu.store import MetaStore, ParamStore
+    from rafiki_tpu.worker.runner import TrialRunner
+
+    n_trials = 6
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256, image_shape=(32, 32, 3))
+        meta = MetaStore(":memory:")
+        params = ParamStore(tmp + "/params")
+        advisor = make_advisor(JaxEnas.get_knob_config(), seed=0,
+                               total_trials=n_trials + 1)
+        runner = TrialRunner(
+            JaxEnas, advisor, train_path, val_path, meta, params,
+            sub_train_job_id="bench-enas",
+            budget={BudgetOption.MODEL_TRIAL_COUNT: n_trials + 1})
+        runner.run_one()  # warm-up: pays the one supernet compile
+        t0 = time.time()
+        for _ in range(n_trials):
+            runner.run_one()
+        elapsed = time.time() - t0
+
+    _emit("enas_trials_per_hour", n_trials / (elapsed / 3600.0),
+          "trials/hour", BASELINE_ENAS_TRIALS_PER_HOUR)
+
+
+def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
+                                        image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
 
     return make_synthetic_image_dataset(
-        tmp, n_train=n_train, n_val=n_val, image_shape=IMAGE_SHAPE,
+        tmp, n_train=n_train, n_val=n_val, image_shape=image_shape,
         n_classes=N_CLASSES)
 
 
@@ -232,7 +304,8 @@ if __name__ == "__main__":
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="trials",
-                        choices=["trials", "serving", "multitenant"])
+                        choices=["trials", "serving", "multitenant",
+                                 "densenet", "enas"])
     args = parser.parse_args()
 
     # The TPU sitecustomize imports jax at interpreter startup, latching
@@ -244,4 +317,5 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
 
     {"trials": main, "serving": main_serving,
-     "multitenant": main_multitenant}[args.config]()
+     "multitenant": main_multitenant, "densenet": main_densenet,
+     "enas": main_enas}[args.config]()

@@ -216,8 +216,20 @@ class JaxModel(BaseModel):
 
         init_rng = jax.random.key(int(self.knobs.get("seed", 0)))
         dummy = jnp.zeros((1, *ds.image_shape), jnp.float32)
-        variables = self._module.init(init_rng, dummy, train=False,
-                                      **extra_np)
+        # Jitted (and process-cached) init: eager flax init dispatches
+        # every layer op to the device one by one — hundreds of round
+        # trips for deep nets (~150s for a DenseNet on a tunneled TPU);
+        # as one compiled program it is a single dispatch.
+        init_key = self._step_cache_key("init", mesh, tuple(dummy.shape))
+        ientry = _step_cache_get(init_key)
+        if ientry is None:
+            module = self._module
+            init_jit = jax.jit(
+                lambda rng, x, extra: module.init(rng, x, train=False,
+                                                  **extra))
+            ientry = {"init": init_jit}
+            _step_cache_put(init_key, ientry)
+        variables = ientry["init"](init_rng, dummy, extra)
         if shared_params is not None:
             variables = self._merge_shared(variables, shared_params)
         has_bs = "batch_stats" in variables

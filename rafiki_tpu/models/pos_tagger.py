@@ -130,7 +130,9 @@ class JaxPosTagger(BaseModel):
         steps = max(1, ds.size // batch_size)
 
         rng = jax.random.key(int(self.knobs.get("seed", 0)))
-        variables = self._module.init(
+        # Jitted init: one device dispatch instead of per-op round trips
+        # (see JaxModel.train).
+        variables = jax.jit(self._module.init)(
             rng, jnp.zeros((1, max_len), jnp.int32),
             jnp.ones((1,), jnp.int32))
         if shared_params is not None:
