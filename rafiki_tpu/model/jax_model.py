@@ -289,7 +289,6 @@ class JaxModel(BaseModel):
         logger.define_plot("Training", ["loss", "train_acc", "chip_util"],
                            x_axis="epoch")
         x_shard = batch_sharding(mesh)
-        rng = np.random.default_rng(int(self.knobs.get("seed", 0)))
         imgs_f = ds.normalized()
         key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
 
@@ -320,10 +319,32 @@ class JaxModel(BaseModel):
 
         early_stop = int(self.knobs.get("early_stop_epochs", 0))
         best_loss, bad_epochs = float("inf"), 0
+
+        # Optional mid-trial checkpointing (SURVEY.md §5): the caller
+        # (TrialRunner with RAFIKI_TPU_CKPT=1, or a direct user) passes a
+        # ``checkpoint_dir``; full train-state leaves are snapshotted
+        # every ``checkpoint_every_epochs`` and a rerun with the same dir
+        # resumes at the next epoch. Per-epoch host RNG and per-step
+        # fold_in keys make the resumed schedule identical to an
+        # uninterrupted run.
+        ckpt_dir = kwargs.get("checkpoint_dir")
+        ckpt_every = int(kwargs.get("checkpoint_every_epochs", 1))
+        mgr = None
+        start_epoch = 0
+        if ckpt_dir and ckpt_every > 0:
+            from ..store.checkpoint import CheckpointManager
+            mgr = CheckpointManager(ckpt_dir)
+            if mgr.latest_step() is not None:
+                state, start_epoch, best_loss, bad_epochs = \
+                    self._restore_ckpt(mgr, state)
+
         t0 = time.time()
-        step = 0
-        for epoch in range(max_epochs):
-            order = rng.permutation(ds.size)
+        step = start_epoch * steps_per_epoch
+        warmed = False
+        for epoch in range(start_epoch, max_epochs):
+            ep_rng = np.random.default_rng(
+                (int(self.knobs.get("seed", 0)) + 1) * 100003 + epoch)
+            order = ep_rng.permutation(ds.size)
             ep_loss, ep_acc, nb = 0.0, 0.0, 0
             for s in range(steps_per_epoch):
                 sel = order[s * batch_size:(s + 1) * batch_size]
@@ -332,17 +353,18 @@ class JaxModel(BaseModel):
                     # one dp-divisible batch: wrap so the epoch still takes
                     # a real optimizer step.
                     sel = np.resize(order, batch_size)
-                xb = self.augment_batch(imgs_f[sel], rng)
+                xb = self.augment_batch(imgs_f[sel], ep_rng)
                 yb = ds.labels[sel]
                 xb = jax.device_put(xb, x_shard)
                 yb = jax.device_put(yb, x_shard)
-                key, sub = jax.random.split(key)
+                sub = jax.random.fold_in(key, step)
                 state, loss, acc = step_fn(state, xb, yb, sub, extra)
                 step += 1
                 meter.tick()
-                if step == 1:
-                    # First dispatch pays the XLA compile; excluding it
-                    # from the utilization window is standard MFU practice.
+                if not warmed:
+                    # Exclude the warm-up dispatch (and, on the jit
+                    # fallback, its XLA compile) from the MFU window.
+                    warmed = True
                     meter.reset()
                 if s == steps_per_epoch - 1 or s % 50 == 49:
                     ep_loss += float(loss)
@@ -361,12 +383,50 @@ class JaxModel(BaseModel):
                     bad_epochs += 1
                     if bad_epochs >= early_stop:
                         break
+            if mgr is not None and (epoch + 1) % ckpt_every == 0 \
+                    and epoch + 1 < max_epochs:
+                self._save_ckpt(mgr, epoch, state, best_loss, bad_epochs)
 
         variables = {"params": jax.device_get(state.params)}
         if has_bs:
             variables["batch_stats"] = jax.device_get(state.batch_stats)
         self._variables = variables
         self._invalidate_compiled()
+
+    def _save_ckpt(self, mgr, epoch: int, state, best_loss: float,
+                   bad_epochs: int) -> None:
+        arrays = {f"leaf_{i}": np.asarray(jax.device_get(leaf))
+                  for i, leaf in enumerate(jax.tree.leaves(state))}
+        arrays["es_best_loss"] = np.asarray(best_loss, np.float64)
+        arrays["es_bad_epochs"] = np.asarray(bad_epochs, np.int64)
+        mgr.save(epoch, arrays)
+
+    def _restore_ckpt(self, mgr, state):
+        """Returns (state, start_epoch, best_loss, bad_epochs); falls back
+        to a fresh start when the snapshot's structure doesn't match (e.g.
+        the checkpoint is from a different knob config)."""
+        saved_epoch, arrays = mgr.restore()
+        leaves, treedef = jax.tree.flatten(state)
+        n_saved = sum(1 for k in arrays if k.startswith("leaf_"))
+        if n_saved != len(leaves):
+            _log.warning("checkpoint in %s has %d leaves, model has %d; "
+                         "starting fresh", mgr.ckpt_dir, n_saved,
+                         len(leaves))
+            return state, 0, float("inf"), 0
+        # safetensors round-trips 0-d arrays as shape (1,); restore each
+        # leaf to its exact aval so the AOT step accepts the state.
+        new_leaves = [
+            jax.device_put(
+                np.asarray(arrays[f"leaf_{i}"])
+                .reshape(leaf.shape).astype(leaf.dtype), leaf.sharding)
+            for i, leaf in enumerate(leaves)]
+        state = jax.tree.unflatten(treedef, new_leaves)
+        logger.log(msg=f"resumed from checkpoint epoch {saved_epoch}")
+        best_loss = np.asarray(
+            arrays.get("es_best_loss", np.inf)).reshape(-1)[0]
+        bad_epochs = np.asarray(
+            arrays.get("es_bad_epochs", 0)).reshape(-1)[0]
+        return state, saved_epoch + 1, float(best_loss), int(bad_epochs)
 
     def _merge_shared(self, variables, shared_params: Params):
         """Warm-start: overlay shared params whose path+shape match."""

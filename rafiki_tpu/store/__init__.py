@@ -8,7 +8,8 @@ contract, cross-process safe via sqlite's file locking) and the ParamStore
 on ``safetensors`` files with a sqlite index.
 """
 
+from .checkpoint import CheckpointManager
 from .meta import MetaStore
 from .params import ParamStore
 
-__all__ = ["MetaStore", "ParamStore"]
+__all__ = ["MetaStore", "ParamStore", "CheckpointManager"]

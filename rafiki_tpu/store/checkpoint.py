@@ -1,0 +1,81 @@
+"""Mid-trial checkpointing: epoch-granular train-state snapshots.
+
+Parity+: SURVEY.md §5 "Checkpoint / resume" — the reference persists only
+*completed* trials (``dump_parameters`` → ParamStore); a crashed trial
+restarts from scratch. The TPU rebuild adds the optional layer the survey
+planned: an orbax-style save of the full train-state pytree (params,
+optimizer state, batch stats, step counter) every N epochs, so a
+restarted worker resumes a long trial instead of repaying it.
+
+Format: one safetensors file per checkpoint (``ckpt_<epoch>.safetensors``,
+leaves indexed positionally as ``leaf_<i>`` — the consumer rebuilds the
+identical pytree structure from its own config and only needs the leaf
+values), written atomically (tmp + rename) with the oldest pruned.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+from safetensors.numpy import load_file, save_file
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.safetensors$")
+
+
+class CheckpointManager:
+    """Atomic save/restore of flat ``{name: ndarray}`` dicts keyed by an
+    integer step (epoch), keeping the newest ``keep_last`` on disk."""
+
+    def __init__(self, ckpt_dir: str, keep_last: int = 2):
+        self.ckpt_dir = ckpt_dir
+        self.keep_last = max(1, int(keep_last))
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.ckpt_dir, f"ckpt_{step}.safetensors")
+
+    def steps(self) -> list:
+        out = []
+        for name in os.listdir(self.ckpt_dir):
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, arrays: Dict[str, np.ndarray]) -> str:
+        path = self._path(step)
+        fd, tmp = tempfile.mkstemp(dir=self.ckpt_dir, suffix=".tmp")
+        os.close(fd)
+        try:
+            save_file({k: np.ascontiguousarray(v)
+                       for k, v in arrays.items()}, tmp)
+            os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self._prune()
+        return path
+
+    def restore(self, step: Optional[int] = None,
+                ) -> Tuple[int, Dict[str, np.ndarray]]:
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.ckpt_dir}")
+        return step, dict(load_file(self._path(step)))
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for s in steps[:-self.keep_last]:
+            try:
+                os.unlink(self._path(s))
+            except OSError:
+                pass

@@ -10,7 +10,11 @@ a bus-backed remote proxy).
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
+import os
+import shutil
 import time
 import traceback
 from typing import Any, Dict, List, Optional, Type
@@ -128,13 +132,19 @@ class TrialRunner:
                 proposal.params_type, session_id=self.sub_train_job_id,
                 worker_id=self.worker_id)
             model = self.model_class(**knobs)
+            # Opt-in mid-trial checkpointing (RAFIKI_TPU_CKPT=1): the dir
+            # is keyed by (sub_train_job, knobs), not trial id, so the
+            # re-proposed trial after a worker crash resumes the crashed
+            # attempt's epochs instead of repaying them (SURVEY.md §5).
+            ckpt_dir = self._ckpt_dir(knobs)
+            train_kwargs = {"checkpoint_dir": ckpt_dir} if ckpt_dir else {}
             try:
                 # Opt-in per-trial profiler trace (RAFIKI_TPU_TRACE_DIR);
                 # each trial's trace lands in its own TensorBoard-readable
                 # subdirectory (SURVEY.md §5 tracing plan).
                 with trace_session(trial_trace_dir(trial_id)):
                     model.train(self.train_dataset_path,
-                                shared_params=shared)
+                                shared_params=shared, **train_kwargs)
                 score = float(model.evaluate(self.val_dataset_path))
                 params_id = self.params.save(
                     model.dump_parameters(),
@@ -143,6 +153,8 @@ class TrialRunner:
             finally:
                 model.destroy()
             self.meta.mark_trial_completed(trial_id, score, params_id)
+            if ckpt_dir:
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
             self.advisor.feedback(proposal, score)
             _log.info("trial %s #%d done: score=%.4f (%.1fs)", trial_id[:8],
                       proposal.trial_no, score, time.time() - t0)
@@ -159,6 +171,16 @@ class TrialRunner:
         finally:
             logger.set_sink(None)
         return self.meta.get_trial(trial_id)
+
+
+    def _ckpt_dir(self, knobs: Dict[str, Any]) -> Optional[str]:
+        if os.environ.get("RAFIKI_TPU_CKPT") != "1":
+            return None
+        digest = hashlib.sha1(json.dumps(
+            {"sub": self.sub_train_job_id,
+             "knobs": _jsonable_knobs(knobs)},
+            sort_keys=True, default=str).encode()).hexdigest()[:16]
+        return os.path.join(self.params.params_dir, "ckpt", digest)
 
 
 def _jsonable_knobs(knobs: Dict[str, Any]) -> Dict[str, Any]:

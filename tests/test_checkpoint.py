@@ -1,0 +1,107 @@
+"""Mid-trial checkpoint/resume (SURVEY.md §5 "Checkpoint / resume")."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.store import CheckpointManager
+
+
+def test_manager_roundtrip_and_prune(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=2)
+    assert mgr.latest_step() is None
+    for step in range(4):
+        mgr.save(step, {"a": np.full((3,), step, np.float32),
+                        "b": np.asarray(step, np.int64)})
+    assert mgr.steps() == [2, 3]  # pruned to keep_last
+    step, arrs = mgr.restore()
+    assert step == 3
+    np.testing.assert_array_equal(arrs["a"], np.full((3,), 3, np.float32))
+
+
+def test_manager_restore_specific_step(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=3)
+    mgr.save(5, {"x": np.ones((2,))})
+    mgr.save(7, {"x": np.zeros((2,))})
+    step, arrs = mgr.restore(5)
+    assert step == 5 and arrs["x"].sum() == 2
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+def _knobs():
+    return {"hidden_layer_count": 1, "hidden_layer_units": 16,
+            "learning_rate": 1e-3, "batch_size": 64, "max_epochs": 5}
+
+
+def _epochs_logged(records):
+    return [r["values"]["epoch"] for r in records
+            if r.get("type") == "values" and "epoch" in r.get("values", {})]
+
+
+def test_train_interrupt_and_resume(tmp_path, synth_image_data):
+    """A crash mid-training resumes from the last epoch snapshot, and the
+    resumed model reaches a sane score."""
+    from rafiki_tpu.model.logger import logger
+    from rafiki_tpu.models import JaxFeedForward
+
+    train_path, val_path = synth_image_data
+    ckpt_dir = str(tmp_path / "trial_ck")
+
+    records = []
+
+    def crashing_sink(rec):
+        records.append(rec)
+        if rec.get("type") == "values" \
+                and rec.get("values", {}).get("epoch") == 2:
+            raise _Crash("simulated worker death after epoch 2 logged")
+
+    m = JaxFeedForward(**JaxFeedForward.validate_knobs(_knobs()))
+    logger.set_sink(crashing_sink)
+    try:
+        with pytest.raises(_Crash):
+            m.train(train_path, checkpoint_dir=ckpt_dir)
+    finally:
+        logger.set_sink(None)
+    mgr = CheckpointManager(ckpt_dir)
+    assert mgr.latest_step() is not None  # epochs 0/1 were snapshotted
+
+    # A fresh instance with the same knobs + dir resumes, not restarts.
+    records2 = []
+    m2 = JaxFeedForward(**JaxFeedForward.validate_knobs(_knobs()))
+    logger.set_sink(records2.append)
+    try:
+        m2.train(train_path, checkpoint_dir=ckpt_dir)
+    finally:
+        logger.set_sink(None)
+    epochs = _epochs_logged(records2)
+    assert epochs[0] > 0, f"resume re-ran epoch 0: {epochs}"
+    assert epochs[-1] == 4
+    assert m2.evaluate(val_path) > 0.5
+
+
+def test_runner_cleans_up_checkpoints(tmp_path, synth_image_data,
+                                      monkeypatch):
+    """With RAFIKI_TPU_CKPT=1 the runner checkpoints during the trial and
+    removes the snapshot dir once the trial completes."""
+    import os
+
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.models import JaxFeedForward
+    from rafiki_tpu.store import MetaStore, ParamStore
+    from rafiki_tpu.worker.runner import TrialRunner
+
+    monkeypatch.setenv("RAFIKI_TPU_CKPT", "1")
+    train_path, val_path = synth_image_data
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "params"))
+    advisor = make_advisor(JaxFeedForward.get_knob_config(), seed=0)
+    runner = TrialRunner(JaxFeedForward, advisor, train_path, val_path,
+                         meta, params, sub_train_job_id="s1",
+                         budget={"MODEL_TRIAL_COUNT": 1})
+    rows = runner.run()
+    assert rows and rows[0]["status"] == "COMPLETED"
+    ckpt_root = os.path.join(params.params_dir, "ckpt")
+    leftovers = os.listdir(ckpt_root) if os.path.isdir(ckpt_root) else []
+    assert leftovers == [], f"checkpoints not cleaned up: {leftovers}"
