@@ -1,0 +1,33 @@
+# rafiki-tpu platform node image.
+#
+# Parity: SURVEY.md §2 "Dockerfiles" — upstream built four CUDA/
+# nvidia-docker images (admin, worker, predictor, web). The TPU rebuild's
+# resident-runner design needs ONE image: every role (Admin REST + web
+# dashboard, train workers, inference workers, predictor) runs inside the
+# `python -m rafiki_tpu serve` process, scheduled onto chip groups. On a
+# multi-host slice, run this image on every host with RAFIKI_TPU_BUS_URI
+# pointing at host 0's bus (TCP over DCN).
+#
+# Build:  docker build -f dockerfiles/node.Dockerfile -t rafiki-tpu .
+# Run:    docker run --privileged --net=host \
+#           -e RAFIKI_TPU_WORKDIR=/data -v rafiki-data:/data rafiki-tpu
+# (--privileged + host networking are the standard requirements for TPU
+#  VM containers; no nvidia-docker runtime is involved anywhere.)
+
+FROM python:3.11-slim
+
+# libtpu + jax come from the TPU release wheel index; everything else is
+# pure-python.
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    flax optax safetensors numpy requests
+
+WORKDIR /app
+COPY rafiki_tpu /app/rafiki_tpu
+
+ENV RAFIKI_TPU_WORKDIR=/data \
+    RAFIKI_TPU_ADMIN_PORT=3000
+EXPOSE 3000
+
+ENTRYPOINT ["python", "-m", "rafiki_tpu", "serve"]
+CMD ["--workdir", "/data", "--port", "3000"]

@@ -1,0 +1,68 @@
+"""CLI: run a rafiki-tpu platform node.
+
+Parity: SURVEY.md §2 "Ops scripts" — the upstream ``scripts/start.sh``
+brings up Postgres/Redis/Admin/Web containers; the TPU rebuild's resident-
+runner deployment (one process owns the host's chips, SURVEY.md §7) makes
+that a single long-running process:
+
+    python -m rafiki_tpu serve --workdir /var/rafiki --port 3000
+
+which serves the Admin REST API + web dashboard and executes train /
+inference services in-process on chip groups. ``scripts/start.sh`` /
+``stop.sh`` wrap this with pid/log management, and the dockerfiles run the
+same command as a container entrypoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def _serve(args: argparse.Namespace) -> None:
+    from .platform import LocalPlatform
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    platform = LocalPlatform(workdir=args.workdir, http=True,
+                             admin_port=args.port,
+                             n_chips=args.chips, bus_uri=args.bus)
+    app = platform.app
+    print(f"rafiki-tpu admin on http://{app.host}:{app.port} "
+          f"(workdir={platform.workdir})", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        print("shutting down...", flush=True)
+        platform.shutdown()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="rafiki_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    serve = sub.add_parser("serve", help="run an Admin + worker node")
+    serve.add_argument("--workdir", default="./rafiki_workdir",
+                       help="state directory (sqlite meta + params)")
+    serve.add_argument("--port", type=int, default=3000)
+    serve.add_argument("--chips", type=int, default=None,
+                       help="limit to the first N chips (default: all)")
+    serve.add_argument("--bus", default="",
+                       help="bus URI ('' = in-process; 'tcp://host:port')")
+    serve.add_argument("--log-level", default="info")
+    serve.set_defaults(fn=_serve)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

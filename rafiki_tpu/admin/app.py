@@ -3,11 +3,13 @@
 Parity: SURVEY.md §2 "Admin" (upstream Flask ``app.py`` routes). Kept
 route-compatible so reference quickstart scripts port 1:1:
 
+- ``GET  /``                         web dashboard (SURVEY.md §2 "Web UI")
 - ``POST /tokens``                   login → ``{user_id, user_type, token}``
 - ``POST /users``                    (admin) create user
 - ``POST /models``                   register model (source or class path)
 - ``GET  /models``                   list visible models
 - ``POST /train_jobs``               create train job
+- ``GET  /train_jobs``               list own train jobs
 - ``GET  /train_jobs/<id>``          job detail + per-model progress
 - ``POST /train_jobs/<id>/stop``     stop workers
 - ``GET  /train_jobs/<id>/trials``   ``?type=best&max_count=k`` or all
@@ -35,11 +37,13 @@ class AdminApp:
     def __init__(self, admin: Admin, host: str = "0.0.0.0", port: int = 0):
         self.admin = admin
         self._http = JsonHttpServer([
+            ("GET", "/", self._dashboard),
             ("POST", "/tokens", self._login),
             ("POST", "/users", self._create_user),
             ("POST", "/models", self._create_model),
             ("GET", "/models", self._list_models),
             ("POST", "/train_jobs", self._create_train_job),
+            ("GET", "/train_jobs", self._list_train_jobs),
             ("GET", "/train_jobs/<job_id>", self._get_train_job),
             ("POST", "/train_jobs/<job_id>/stop", self._stop_train_job),
             ("GET", "/train_jobs/<job_id>/trials", self._get_trials),
@@ -49,6 +53,7 @@ class AdminApp:
             ("POST", "/inference_jobs/<job_id>/stop",
              self._stop_inference_job),
         ], host=host, port=port, name="admin")
+        self.host = self._http.host
         self.port = self._http.port
 
     def start(self) -> "AdminApp":
@@ -80,6 +85,16 @@ class AdminApp:
         return body
 
     # --- Routes ---
+
+    def _dashboard(self, params, body, ctx):
+        from ..utils.service import RawResponse
+        from ..web import dashboard_html
+        return 200, RawResponse("text/html; charset=utf-8",
+                                dashboard_html())
+
+    def _list_train_jobs(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_train_jobs(claims["user_id"])
 
     def _login(self, params, body, ctx):
         body = self._need(body, "email", "password")

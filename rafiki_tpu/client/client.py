@@ -96,6 +96,9 @@ class Client:
                           train_dataset_path=train_dataset_path,
                           val_dataset_path=val_dataset_path)
 
+    def get_train_jobs(self) -> List[Dict[str, Any]]:
+        return self._call("GET", "/train_jobs")
+
     def get_train_job(self, train_job_id: str) -> Dict[str, Any]:
         return self._call("GET", f"/train_jobs/{train_job_id}")
 

@@ -49,6 +49,15 @@ class HttpError(Exception):
         self.message = message
 
 
+class RawResponse:
+    """A handler return value served verbatim (e.g. the web dashboard's
+    HTML) instead of being JSON-encoded."""
+
+    def __init__(self, content_type: str, data):
+        self.content_type = content_type
+        self.data = data.encode() if isinstance(data, str) else data
+
+
 def _compile(path: str) -> re.Pattern:
     # "/train_jobs/<id>/stop" -> ^/train_jobs/(?P<id>[^/]+)/stop$
     pattern = re.sub(r"<(\w+)>", r"(?P<\1>[^/]+)", path)
@@ -107,9 +116,12 @@ class JsonHttpServer:
                 self._reply(404, {"error": f"no route {method} {parsed.path}"})
 
             def _reply(self, status: int, obj: Any):
-                data = json.dumps(obj).encode()
+                if isinstance(obj, RawResponse):
+                    data, ctype = obj.data, obj.content_type
+                else:
+                    data, ctype = json.dumps(obj).encode(), "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

@@ -1,0 +1,20 @@
+"""Web dashboard (SURVEY.md §2 "Web UI", layer L7).
+
+Parity of substance with the upstream React admin app — login, models,
+train jobs, per-trial detail, and live training charts rendered from
+TrialLog rows — served as one dependency-free static page against the
+Admin REST API (no node build step; the JsonHttpServer serves it at
+``GET /``).
+"""
+
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def dashboard_html() -> str:
+    with open(os.path.join(_HERE, "dashboard.html"), encoding="utf-8") as f:
+        return f.read()
+
+
+__all__ = ["dashboard_html"]

@@ -1,0 +1,15 @@
+# Deployment configuration for the ops scripts.
+# Parity: SURVEY.md §2 "Ops scripts" — upstream .env.sh exported image
+# tags, ports, and DB/Redis credentials; the TPU resident-runner node
+# needs only these.
+
+export RAFIKI_TPU_WORKDIR="${RAFIKI_TPU_WORKDIR:-$HOME/.rafiki_tpu}"
+export RAFIKI_TPU_ADMIN_PORT="${RAFIKI_TPU_ADMIN_PORT:-3000}"
+export RAFIKI_TPU_LOG_LEVEL="${RAFIKI_TPU_LOG_LEVEL:-info}"
+# '' = in-process bus (single node); 'tcp://host:port' for multi-host.
+export RAFIKI_TPU_BUS_URI="${RAFIKI_TPU_BUS_URI:-}"
+# Optional: cap the chips this node owns (default: all of jax.devices()).
+export RAFIKI_TPU_CHIPS="${RAFIKI_TPU_CHIPS:-}"
+# Optional observability toggles (SURVEY.md §5).
+#export RAFIKI_TPU_TRACE_DIR="$RAFIKI_TPU_WORKDIR/traces"
+#export RAFIKI_TPU_CKPT=1

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Start a rafiki-tpu platform node in the background.
+# Parity: SURVEY.md §2 "Ops scripts" (upstream start.sh brought up
+# Postgres/Redis/Admin/Web containers; here one resident-runner process
+# serves the Admin REST API + dashboard and owns the host's TPU chips).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+source scripts/.env.sh
+
+mkdir -p "$RAFIKI_TPU_WORKDIR"
+PID_FILE="$RAFIKI_TPU_WORKDIR/rafiki.pid"
+LOG_FILE="$RAFIKI_TPU_WORKDIR/rafiki.log"
+
+if [[ -f "$PID_FILE" ]] && kill -0 "$(cat "$PID_FILE")" 2>/dev/null; then
+  echo "already running (pid $(cat "$PID_FILE"))"
+  exit 0
+fi
+
+EXTRA=()
+[[ -n "$RAFIKI_TPU_CHIPS" ]] && EXTRA+=(--chips "$RAFIKI_TPU_CHIPS")
+[[ -n "$RAFIKI_TPU_BUS_URI" ]] && EXTRA+=(--bus "$RAFIKI_TPU_BUS_URI")
+
+nohup python -m rafiki_tpu serve \
+  --workdir "$RAFIKI_TPU_WORKDIR" \
+  --port "$RAFIKI_TPU_ADMIN_PORT" \
+  --log-level "$RAFIKI_TPU_LOG_LEVEL" \
+  "${EXTRA[@]}" >> "$LOG_FILE" 2>&1 &
+echo $! > "$PID_FILE"
+
+# Wait for the Admin HTTP frontend to come up.
+for _ in $(seq 1 60); do
+  if curl -fsS "http://127.0.0.1:$RAFIKI_TPU_ADMIN_PORT/" >/dev/null 2>&1; then
+    echo "rafiki-tpu up: http://127.0.0.1:$RAFIKI_TPU_ADMIN_PORT (pid $(cat "$PID_FILE"), log $LOG_FILE)"
+    exit 0
+  fi
+  sleep 1
+done
+echo "timed out waiting for admin; see $LOG_FILE" >&2
+exit 1

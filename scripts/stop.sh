@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Stop the rafiki-tpu platform node started by start.sh.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+source scripts/.env.sh
+
+PID_FILE="$RAFIKI_TPU_WORKDIR/rafiki.pid"
+if [[ ! -f "$PID_FILE" ]]; then
+  echo "not running (no $PID_FILE)"
+  exit 0
+fi
+PID="$(cat "$PID_FILE")"
+if kill -0 "$PID" 2>/dev/null; then
+  kill -TERM "$PID"  # SIGTERM → graceful: stops jobs, closes stores
+  for _ in $(seq 1 30); do
+    kill -0 "$PID" 2>/dev/null || break
+    sleep 1
+  done
+  kill -0 "$PID" 2>/dev/null && kill -KILL "$PID"
+fi
+rm -f "$PID_FILE"
+echo "stopped"

@@ -1,0 +1,78 @@
+"""Web dashboard + serve CLI (SURVEY.md §2 "Web UI" / "Ops scripts")."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu.constants import UserType
+from rafiki_tpu.platform import LocalPlatform
+
+
+@pytest.fixture()
+def http_platform(tmp_path):
+    platform = LocalPlatform(workdir=str(tmp_path / "plat"), http=True)
+    yield platform
+    platform.shutdown()
+
+
+def test_dashboard_served_unauthenticated(http_platform):
+    url = f"http://127.0.0.1:{http_platform.app.port}/"
+    r = requests.get(url, timeout=10)
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith("text/html")
+    assert "rafiki-tpu" in r.text and "Train jobs" in r.text
+
+
+def test_train_jobs_listing_route(http_platform):
+    from rafiki_tpu.client import Client
+
+    admin = http_platform.admin
+    client = Client(admin_port=http_platform.app.port)
+    client.login("superadmin@rafiki", "rafiki")
+    client.create_user("w@x.c", "pw", UserType.APP_DEVELOPER)
+    assert client.get_train_jobs() == []
+    assert admin is not None
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_serve_cli_starts_and_stops_gracefully(tmp_path):
+    """`python -m rafiki_tpu serve` comes up, serves the dashboard and the
+    REST API, and exits cleanly on SIGTERM (the stop.sh path)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rafiki_tpu", "serve",
+         "--workdir", str(tmp_path / "node"), "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(120):
+            try:
+                if requests.get(base + "/", timeout=2).status_code == 200:
+                    break
+            except requests.ConnectionError:
+                time.sleep(0.5)
+        else:
+            out = proc.stdout.read().decode() if proc.stdout else ""
+            pytest.fail(f"serve never came up:\n{out}")
+        r = requests.post(base + "/tokens", json={
+            "email": "superadmin@rafiki", "password": "rafiki"}, timeout=10)
+        assert r.status_code == 200 and "token" in r.json()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
