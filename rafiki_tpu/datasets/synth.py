@@ -95,3 +95,43 @@ def make_synthetic_corpus_dataset(
         va[0], va[1], os.path.join(out_dir, f"{name}_val.zip"),
         tag_names=tag_names)
     return train_path, val_path
+
+
+def make_synthetic_tabular_dataset(
+        out_dir: str,
+        n_train: int = 512,
+        n_val: int = 128,
+        n_features: int = 8,
+        n_classes: int = 0,
+        seed: int = 0,
+        name: str = "tab") -> Tuple[str, str]:
+    """Write train/val tabular CSVs; returns their paths.
+
+    ``n_classes > 0`` → classification (targets from a noisy linear
+    score, argmax over class weight vectors); ``n_classes == 0`` →
+    regression (noisy linear target). Either way the signal is linear in
+    the features, so simple learners beat chance/variance by a margin.
+    """
+    from ..model.dataset import write_tabular_dataset
+
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(n_features, max(n_classes, 1)))
+
+    def make(n, seed2):
+        r = np.random.default_rng(seed2)
+        x = r.normal(size=(n, n_features)).astype(np.float32)
+        scores = x @ w + 0.1 * r.normal(size=(n, max(n_classes, 1)))
+        if n_classes > 0:
+            y = scores.argmax(axis=1).astype(np.int64)
+        else:
+            y = scores[:, 0].astype(np.float32)
+        return x, y
+
+    os.makedirs(out_dir, exist_ok=True)
+    tr_x, tr_y = make(n_train, seed + 1)
+    va_x, va_y = make(n_val, seed + 2)
+    train_path = write_tabular_dataset(
+        tr_x, tr_y, os.path.join(out_dir, f"{name}_train.csv"))
+    val_path = write_tabular_dataset(
+        va_x, va_y, os.path.join(out_dir, f"{name}_val.csv"))
+    return train_path, val_path

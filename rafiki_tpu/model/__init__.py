@@ -4,10 +4,12 @@ See SURVEY.md §2 (Model SDK rows) for the reference parity map.
 """
 
 from .base import BaseModel, Params, params_size_bytes
-from .dataset import (CorpusDataset, ImageDataset, load_corpus_dataset,
-                      load_dataset_of_corpus, load_dataset_of_image_files,
-                      load_image_dataset, write_corpus_dataset,
-                      write_image_dataset_npz, write_image_files_dataset)
+from .dataset import (CorpusDataset, ImageDataset, TabularDataset,
+                      load_corpus_dataset, load_dataset_of_corpus,
+                      load_dataset_of_image_files, load_image_dataset,
+                      load_tabular_dataset, write_corpus_dataset,
+                      write_image_dataset_npz, write_image_files_dataset,
+                      write_tabular_dataset)
 from .dev import test_model_class
 from .knobs import (ArchKnob, BaseKnob, CategoricalKnob, FixedKnob, FloatKnob,
                     IntegerKnob, KnobConfig, Knobs, PolicyKnob,
@@ -22,7 +24,8 @@ __all__ = [
     "load_image_dataset", "load_dataset_of_image_files",
     "load_corpus_dataset", "load_dataset_of_corpus",
     "write_image_dataset_npz", "write_image_files_dataset",
-    "write_corpus_dataset",
+    "write_corpus_dataset", "TabularDataset", "load_tabular_dataset",
+    "write_tabular_dataset",
     "test_model_class",
     "BaseKnob", "CategoricalKnob", "FixedKnob", "FloatKnob", "IntegerKnob",
     "ArchKnob", "PolicyKnob", "KnobConfig", "Knobs",

@@ -65,6 +65,28 @@ class ImageDataset:
 
 
 @dataclass
+class TabularDataset:
+    """Rows of numeric features with a label/target column.
+
+    Parity: SURVEY.md §2 task types TABULAR_CLASSIFICATION /
+    TABULAR_REGRESSION — upstream tabular datasets are CSV files with a
+    header row; the label column is the last one unless named.
+    ``n_classes`` is set when the target column is integral
+    (classification) and None for regression.
+    """
+
+    features: np.ndarray  # (N, D) float32
+    targets: np.ndarray   # (N,) int64 (classification) or float32
+    feature_names: List[str]
+    target_name: str
+    n_classes: Optional[int]
+
+    @property
+    def size(self) -> int:
+        return int(self.features.shape[0])
+
+
+@dataclass
 class CorpusDataset:
     """A token-tagged corpus (e.g. POS tagging)."""
 
@@ -152,6 +174,56 @@ def load_corpus_dataset(dataset_path: str) -> CorpusDataset:
 
 
 load_dataset_of_corpus = load_corpus_dataset
+
+
+def load_tabular_dataset(dataset_path: str,
+                         label_col: Optional[str] = None) -> TabularDataset:
+    """Load a CSV tabular dataset (header row; numeric cells).
+
+    ``label_col`` defaults to the last column. Integral label values →
+    classification (``n_classes`` set); otherwise regression.
+    """
+    with open(dataset_path, newline="", encoding="utf-8") as f:
+        rows = list(csv.reader(f))
+    if len(rows) < 2:
+        raise ValueError(f"tabular dataset {dataset_path} has no data rows")
+    header, data = rows[0], rows[1:]
+    if label_col is None:
+        label_idx = len(header) - 1
+    else:
+        if label_col not in header:
+            raise ValueError(f"label column {label_col!r} not in {header}")
+        label_idx = header.index(label_col)
+    values = np.asarray(data, dtype=np.float64)
+    targets64 = values[:, label_idx]
+    features = np.delete(values, label_idx, axis=1).astype(np.float32)
+    feature_names = [h for i, h in enumerate(header) if i != label_idx]
+    if np.all(targets64 == np.round(targets64)):
+        targets = targets64.astype(np.int64)
+        n_classes: Optional[int] = int(targets.max()) + 1
+    else:
+        targets = targets64.astype(np.float32)
+        n_classes = None
+    return TabularDataset(features=features, targets=targets,
+                          feature_names=feature_names,
+                          target_name=header[label_idx],
+                          n_classes=n_classes)
+
+
+def write_tabular_dataset(features: np.ndarray, targets: np.ndarray,
+                          out_path: str,
+                          feature_names: Optional[List[str]] = None,
+                          target_name: str = "label") -> str:
+    features = np.asarray(features)
+    if feature_names is None:
+        feature_names = [f"f{i}" for i in range(features.shape[1])]
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(list(feature_names) + [target_name])
+        for x, y in zip(features, np.asarray(targets)):
+            w.writerow([repr(float(v)) for v in x] + [repr(float(y))])
+    return out_path
 
 
 # --- Writers (dataset preparation; SURVEY.md §2 "Dataset prep scripts") ---

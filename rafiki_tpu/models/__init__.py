@@ -5,6 +5,7 @@ from .enas import JaxEnas
 from .feedforward import JaxFeedForward
 from .pos_tagger import JaxPosTagger
 from .sk import SkDt, SkSvm
+from .tabular import JaxTabMlpClf, JaxTabMlpReg
 
 __all__ = ["JaxFeedForward", "JaxDenseNet", "JaxEnas", "JaxPosTagger",
-           "SkDt", "SkSvm"]
+           "SkDt", "SkSvm", "JaxTabMlpClf", "JaxTabMlpReg"]

@@ -1,0 +1,254 @@
+"""Tabular MLP models: TABULAR_CLASSIFICATION / TABULAR_REGRESSION parity.
+
+Parity: SURVEY.md §2 task types — the upstream zoo covers tabular tasks
+with sklearn/XGBoost templates; the TPU rebuild's native path is a flax
+MLP trained under one jitted step (static shapes; feature standardization
+is computed on the host once and baked into the parameter dict so
+dump/load round-trips it). Classification returns class-probability
+lists, regression returns scalars — both shapes the Predictor's ensemble
+combiner averages correctly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import traverse_util
+
+from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
+from ..model.base import BaseModel, Params
+from ..model.dataset import load_tabular_dataset
+from ..model.jax_model import _step_cache_get, _step_cache_put
+from ..model.logger import logger
+from ..parallel import batch_sharding, build_mesh, replicated
+from ..parallel.chips import ChipGroup
+
+
+class _Mlp(nn.Module):
+    hidden: Sequence[int]
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(self.out_dim)(x)
+
+
+class _JaxTabBase(BaseModel):
+    """Shared train/predict scaffolding; subclasses fix the objective."""
+
+    regression = False
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "hidden": IntegerKnob(16, 256),
+            "depth": IntegerKnob(1, 3),
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            "batch_size": CategoricalKnob([32, 64, 128]),
+            "max_epochs": IntegerKnob(5, 40),
+        }
+
+    def __init__(self, **knobs: Any):
+        super().__init__(**knobs)
+        self._variables = None
+        self._module: Optional[_Mlp] = None
+        self._meta: Dict[str, Any] = {}
+        self._mesh = None
+        self._predict_fn = None
+        self._vars_dev = None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = build_mesh(ChipGroup.current().devices())
+        return self._mesh
+
+    def _ensure_module(self) -> None:
+        if self._module is None:
+            hidden = [int(self.knobs.get("hidden", 64))] \
+                * int(self.knobs.get("depth", 2))
+            self._module = _Mlp(hidden=tuple(hidden),
+                                out_dim=int(self._meta["out_dim"]))
+
+    def _standardize(self, x: np.ndarray) -> np.ndarray:
+        mean = np.asarray(self._meta["mean"], np.float32)
+        std = np.asarray(self._meta["std"], np.float32)
+        return (x - mean) / std
+
+    # --- BaseModel ---
+
+    def train(self, dataset_path: str, *,
+              shared_params: Optional[Params] = None, **kwargs: Any) -> None:
+        ds = load_tabular_dataset(dataset_path)
+        if self.regression:
+            out_dim = 1
+            targets = ds.targets.astype(np.float32)
+        else:
+            if ds.n_classes is None:
+                raise ValueError("classification model given a "
+                                 "regression-target dataset")
+            out_dim = int(ds.n_classes)
+            targets = ds.targets.astype(np.int32)
+        mean = ds.features.mean(axis=0)
+        std = ds.features.std(axis=0) + 1e-6
+        self._meta = {"out_dim": out_dim, "n_features": ds.features.shape[1],
+                      "mean": mean.tolist(), "std": std.tolist(),
+                      "feature_names": list(ds.feature_names)}
+        self._ensure_module()
+        mesh = self.mesh
+        dp = mesh.shape["dp"]
+        x = self._standardize(ds.features)
+
+        batch_size = min(int(self.knobs.get("batch_size", 64)), ds.size)
+        batch_size = max(dp, (batch_size // dp) * dp)
+        max_epochs = int(self.knobs.get("max_epochs", 20))
+        if self.knobs.get("quick_train", False):
+            max_epochs = min(max_epochs,
+                             int(self.knobs.get("trial_epochs", 1)))
+        steps = max(1, ds.size // batch_size)
+
+        knob_items = tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in self.knobs.items()))
+        cache_key = (type(self), "train", self._module, knob_items, mesh,
+                     ds.features.shape[1], steps, max_epochs)
+        cached = _step_cache_get(cache_key)
+        if cached is not None:
+            tx, train_step = cached["tx"], cached["step"]
+        else:
+            lr = float(self.knobs.get("learning_rate", 1e-3))
+            tx = optax.adam(optax.cosine_decay_schedule(
+                lr, decay_steps=max(1, steps * max_epochs), alpha=0.01))
+            module = self._module
+            regression = self.regression
+
+            @jax.jit
+            def train_step(params, opt_state, xb, yb):
+                def loss_fn(p):
+                    out = module.apply({"params": p}, xb)
+                    if regression:
+                        return jnp.mean((out[:, 0] - yb) ** 2)
+                    return optax.softmax_cross_entropy_with_integer_labels(
+                        out, yb).mean()
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state, loss
+
+            _step_cache_put(cache_key, {"tx": tx, "step": train_step})
+
+        rng = jax.random.key(int(self.knobs.get("seed", 0)))
+        variables = jax.jit(self._module.init)(
+            rng, jnp.zeros((1, ds.features.shape[1]), jnp.float32))
+        params = jax.device_put(variables["params"], replicated(mesh))
+        opt_state = tx.init(params)
+
+        logger.define_plot("Training", ["loss"], x_axis="epoch")
+        x_shard = batch_sharding(mesh)
+        order_rng = np.random.default_rng(int(self.knobs.get("seed", 0)))
+        for epoch in range(max_epochs):
+            order = order_rng.permutation(ds.size)
+            ep_loss = 0.0
+            for s in range(steps):
+                sel = order[s * batch_size:(s + 1) * batch_size]
+                if len(sel) < batch_size:
+                    sel = np.resize(order, batch_size)
+                params, opt_state, loss = train_step(
+                    params, opt_state,
+                    jax.device_put(x[sel], x_shard),
+                    jax.device_put(targets[sel], x_shard))
+                ep_loss += float(loss)
+            logger.log(epoch=epoch, loss=ep_loss / steps)
+
+        self._variables = {"params": jax.device_get(params)}
+        self._invalidate_compiled()
+
+    def _forward(self, features: np.ndarray) -> np.ndarray:
+        self._ensure_module()
+        if self._vars_dev is None:
+            self._vars_dev = jax.device_put(
+                self._variables, replicated(self.mesh))
+        if self._predict_fn is None:
+            module = self._module
+            regression = self.regression
+            self._predict_fn = jax.jit(
+                lambda v, xb: module.apply(v, xb)[:, 0] if regression
+                else jax.nn.softmax(
+                    module.apply(v, xb).astype(jnp.float32), -1))
+        x = self._standardize(np.asarray(features, np.float32))
+        n = x.shape[0]
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        if n < bucket:
+            x = np.concatenate(
+                [x, np.zeros((bucket - n, x.shape[1]), x.dtype)])
+        return np.asarray(self._predict_fn(self._vars_dev, x))[:n]
+
+    def evaluate(self, dataset_path: str) -> float:
+        assert self._variables is not None
+        ds = load_tabular_dataset(dataset_path)
+        out = self._forward(ds.features)
+        if self.regression:
+            y = ds.targets.astype(np.float64)
+            ss_res = float(((out - y) ** 2).sum())
+            ss_tot = float(((y - y.mean()) ** 2).sum()) + 1e-12
+            return 1.0 - ss_res / ss_tot  # R^2: higher is better
+        return float((out.argmax(-1) == ds.targets).mean())
+
+    def predict(self, queries: List[Any]) -> List[Any]:
+        assert self._variables is not None
+        if not queries:
+            return []
+        out = self._forward(np.stack([np.asarray(q, np.float32).reshape(-1)
+                                      for q in queries]))
+        if self.regression:
+            return [float(v) for v in out]
+        return [p.tolist() for p in out]
+
+    def dump_parameters(self) -> Params:
+        assert self._variables is not None
+        flat = traverse_util.flatten_dict(self._variables, sep="/")
+        out: Params = {k: np.asarray(v) for k, v in flat.items()}
+        out["_meta/json"] = np.frombuffer(
+            json.dumps(self._meta).encode(), np.uint8)
+        return out
+
+    def load_parameters(self, params: Params) -> None:
+        blob = params.get("_meta/json")
+        assert blob is not None, "params missing _meta/json"
+        self._meta = json.loads(np.asarray(blob).tobytes().decode())
+        flat = {k: np.asarray(v) for k, v in params.items()
+                if not k.startswith("_meta/")}
+        self._variables = traverse_util.unflatten_dict(flat, sep="/")
+        self._module = None
+        self._invalidate_compiled()
+        self._ensure_module()
+
+    def _invalidate_compiled(self) -> None:
+        self._predict_fn = None
+        self._vars_dev = None
+
+    def destroy(self) -> None:
+        self._invalidate_compiled()
+        self._variables = None
+        self._module = None
+
+
+class JaxTabMlpClf(_JaxTabBase):
+    """MLP classifier over tabular rows (TABULAR_CLASSIFICATION)."""
+
+    regression = False
+
+
+class JaxTabMlpReg(_JaxTabBase):
+    """MLP regressor over tabular rows (TABULAR_REGRESSION)."""
+
+    regression = True

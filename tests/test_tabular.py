@@ -1,0 +1,79 @@
+"""Tabular task support (SURVEY.md §2 task types TABULAR_*)."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.datasets import make_synthetic_tabular_dataset
+from rafiki_tpu.model import load_tabular_dataset, test_model_class
+from rafiki_tpu.models import JaxTabMlpClf, JaxTabMlpReg
+
+KNOBS = {"hidden": 32, "depth": 2, "learning_rate": 5e-3,
+         "batch_size": 64, "max_epochs": 15}
+
+
+def test_tabular_csv_roundtrip(tmp_path):
+    from rafiki_tpu.model import write_tabular_dataset
+
+    x = np.random.default_rng(0).normal(size=(10, 3)).astype(np.float32)
+    y = np.arange(10) % 4
+    p = write_tabular_dataset(x, y, str(tmp_path / "t.csv"),
+                              feature_names=["a", "b", "c"])
+    ds = load_tabular_dataset(p)
+    assert ds.size == 10 and ds.n_classes == 4
+    assert ds.feature_names == ["a", "b", "c"]
+    np.testing.assert_allclose(ds.features, x, rtol=1e-6)
+    np.testing.assert_array_equal(ds.targets, y)
+
+
+def test_tabular_regression_target_detection(tmp_path):
+    tr, va = make_synthetic_tabular_dataset(str(tmp_path), n_classes=0)
+    ds = load_tabular_dataset(tr)
+    assert ds.n_classes is None
+    assert ds.targets.dtype == np.float32
+
+
+def test_tab_classifier_end_to_end(tmp_path):
+    tr, va = make_synthetic_tabular_dataset(
+        str(tmp_path), n_train=512, n_val=128, n_features=8, n_classes=4)
+    ds = load_tabular_dataset(va)
+    queries = [ds.features[i] for i in range(3)]
+    result = test_model_class(
+        JaxTabMlpClf, TaskType.TABULAR_CLASSIFICATION, tr, va,
+        test_queries=queries, knobs=KNOBS)
+    assert result.score > 0.6  # 4-class linear signal; chance 0.25
+    assert len(result.predictions) == 3
+    assert all(abs(sum(p) - 1.0) < 1e-3 for p in result.predictions)
+
+
+def test_tab_regressor_end_to_end(tmp_path):
+    tr, va = make_synthetic_tabular_dataset(
+        str(tmp_path), n_train=512, n_val=128, n_features=8, n_classes=0)
+    ds = load_tabular_dataset(va)
+    queries = [ds.features[i] for i in range(3)]
+    result = test_model_class(
+        JaxTabMlpReg, TaskType.TABULAR_REGRESSION, tr, va,
+        test_queries=queries, knobs=KNOBS)
+    assert result.score > 0.7  # R^2 on a linear target
+    assert all(isinstance(p, float) for p in result.predictions)
+
+
+def test_tab_params_roundtrip(tmp_path):
+    tr, va = make_synthetic_tabular_dataset(
+        str(tmp_path), n_train=256, n_val=64, n_classes=3)
+    m = JaxTabMlpClf(**JaxTabMlpClf.validate_knobs(KNOBS))
+    m.train(tr)
+    score = m.evaluate(va)
+    params = m.dump_parameters()
+    assert all(isinstance(v, np.ndarray) for v in params.values())
+
+    m2 = JaxTabMlpClf(**JaxTabMlpClf.validate_knobs(KNOBS))
+    m2.load_parameters(params)
+    assert abs(m2.evaluate(va) - score) < 1e-6
+
+
+def test_classifier_rejects_regression_dataset(tmp_path):
+    tr, _ = make_synthetic_tabular_dataset(str(tmp_path), n_classes=0)
+    m = JaxTabMlpClf(**JaxTabMlpClf.validate_knobs(KNOBS))
+    with pytest.raises(ValueError, match="regression-target"):
+        m.train(tr)
