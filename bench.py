@@ -32,7 +32,7 @@ BASELINE_TRIALS_PER_HOUR = 268.0
 BASELINE_SERVING_QPS = 1097.0
 BASELINE_MT_TRIALS_PER_HOUR = None  # needs >= 2 chips; no TPU figure yet
 BASELINE_DENSENET_IMAGES_PER_SEC = 1504.0
-BASELINE_ENAS_TRIALS_PER_HOUR = 254.0
+BASELINE_ENAS_TRIALS_PER_HOUR = 254.1
 
 N_TRIALS = 3
 N_TRAIN, N_VAL = 4096, 512

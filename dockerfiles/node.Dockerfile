@@ -20,7 +20,7 @@ FROM python:3.11-slim
 # pure-python.
 RUN pip install --no-cache-dir \
     "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    flax optax safetensors numpy requests
+    flax optax safetensors numpy requests scikit-learn pillow
 
 WORKDIR /app
 COPY rafiki_tpu /app/rafiki_tpu

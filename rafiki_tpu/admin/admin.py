@@ -187,7 +187,8 @@ class Admin:
 
     def get_train_jobs(self, user_id: str) -> List[Dict[str, Any]]:
         return [{"id": j["id"], "app": j["app"],
-                 "app_version": j["app_version"], "status": j["status"]}
+                 "app_version": j["app_version"], "task": j["task"],
+                 "status": j["status"]}
                 for j in self.meta.get_train_jobs(user_id)]
 
     def stop_train_job(self, train_job_id: str,

@@ -87,6 +87,19 @@ def clear_step_cache() -> None:
     _STEP_CACHE.clear()
 
 
+def step_cache_key(model: "BaseModel", kind: str, mesh, *parts: Any,
+                   exclude: frozenset = frozenset()) -> Any:
+    """The one cache-key convention for compiled steps, shared by every
+    model class (JaxModel subclasses and the standalone sequence/tabular
+    models): (class, kind, flax module, knobs-minus-excluded, mesh,
+    extra static parts). ``mesh`` objects are interned by build_mesh, so
+    identity is stable."""
+    knob_items = tuple(sorted(
+        (k, tuple(v) if isinstance(v, list) else v)
+        for k, v in model.knobs.items() if k not in exclude))
+    return (type(model), kind, model._module, knob_items, mesh, parts)
+
+
 def _canonicalize_state(state: Any, mesh) -> Any:
     """Pin every train-state leaf to a mesh NamedSharding and a strong
     dtype. ``TrainState.create`` leaves the step counter as a weak Python
@@ -166,13 +179,11 @@ class JaxModel(BaseModel):
         return {}
 
     def _step_cache_key(self, kind: str, mesh, *parts: Any) -> Any:
-        # ``mesh`` is interned by build_mesh, so the object itself is a
-        # stable identity for (devices, axis shape).
-        extra_names = frozenset(self.extra_apply_inputs())
-        knob_items = tuple(sorted(
-            (k, tuple(v) if isinstance(v, list) else v)
-            for k, v in self.knobs.items() if k not in extra_names))
-        return (type(self), kind, self._module, knob_items, mesh, parts)
+        # Knobs routed through extra_apply_inputs are traced inputs, not
+        # graph constants — exclude them so e.g. every ENAS architecture
+        # hits one executable.
+        return step_cache_key(self, kind, mesh, *parts,
+                              exclude=frozenset(self.extra_apply_inputs()))
 
     # --- Mesh / module plumbing ---
 
@@ -375,7 +386,8 @@ class JaxModel(BaseModel):
             util = {"chip_util": round(meter.mfu, 6)} \
                 if meter.mfu is not None else {}
             logger.log(epoch=epoch, loss=ep_loss, train_acc=ep_acc,
-                       steps_per_sec=step / (time.time() - t0), **util)
+                       steps_per_sec=(step - start_epoch * steps_per_epoch)
+                       / (time.time() - t0), **util)
             if early_stop:
                 if ep_loss < best_loss - 1e-4:
                     best_loss, bad_epochs = ep_loss, 0
@@ -415,11 +427,18 @@ class JaxModel(BaseModel):
             return state, 0, float("inf"), 0
         # safetensors round-trips 0-d arrays as shape (1,); restore each
         # leaf to its exact aval so the AOT step accepts the state.
-        new_leaves = [
-            jax.device_put(
-                np.asarray(arrays[f"leaf_{i}"])
-                .reshape(leaf.shape).astype(leaf.dtype), leaf.sharding)
-            for i, leaf in enumerate(leaves)]
+        try:
+            new_leaves = [
+                jax.device_put(
+                    np.asarray(arrays[f"leaf_{i}"])
+                    .reshape(leaf.shape).astype(leaf.dtype), leaf.sharding)
+                for i, leaf in enumerate(leaves)]
+        except ValueError:
+            # Same leaf count, different shapes (checkpoint from another
+            # knob config reusing the dir) — fresh start, as documented.
+            _log.warning("checkpoint in %s has incompatible leaf shapes; "
+                         "starting fresh", mgr.ckpt_dir)
+            return state, 0, float("inf"), 0
         state = jax.tree.unflatten(treedef, new_leaves)
         logger.log(msg=f"resumed from checkpoint epoch {saved_epoch}")
         best_loss = np.asarray(

@@ -25,7 +25,8 @@ from flax import traverse_util
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
 from ..model.base import BaseModel, Params
 from ..model.dataset import load_corpus_dataset
-from ..model.jax_model import _step_cache_get, _step_cache_put
+from ..model.jax_model import (_step_cache_get, _step_cache_put,
+                               step_cache_key)
 from ..model.logger import logger
 from ..parallel import batch_sharding, build_mesh, replicated
 from ..parallel.chips import ChipGroup
@@ -146,11 +147,7 @@ class JaxPosTagger(BaseModel):
         # Reuse the jitted step AND its optax tx across repeat trials with
         # identical static config (same process-level cache JaxModel uses;
         # a fresh tx per trial would defeat jit's cache).
-        knob_items = tuple(sorted(
-            (k, tuple(v) if isinstance(v, list) else v)
-            for k, v in self.knobs.items()))
-        cache_key = (type(self), "train", self._module, knob_items, mesh,
-                     steps, max_epochs)
+        cache_key = step_cache_key(self, "train", mesh, steps, max_epochs)
         cached = _step_cache_get(cache_key)
         if cached is not None:
             tx, train_step = cached["tx"], cached["step"]

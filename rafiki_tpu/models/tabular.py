@@ -24,7 +24,8 @@ from flax import traverse_util
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
 from ..model.base import BaseModel, Params
 from ..model.dataset import load_tabular_dataset
-from ..model.jax_model import _step_cache_get, _step_cache_put
+from ..model.jax_model import (_step_cache_get, _step_cache_put,
+                               step_cache_key)
 from ..model.logger import logger
 from ..parallel import batch_sharding, build_mesh, replicated
 from ..parallel.chips import ChipGroup
@@ -115,11 +116,8 @@ class _JaxTabBase(BaseModel):
                              int(self.knobs.get("trial_epochs", 1)))
         steps = max(1, ds.size // batch_size)
 
-        knob_items = tuple(sorted(
-            (k, tuple(v) if isinstance(v, list) else v)
-            for k, v in self.knobs.items()))
-        cache_key = (type(self), "train", self._module, knob_items, mesh,
-                     ds.features.shape[1], steps, max_epochs)
+        cache_key = step_cache_key(self, "train", mesh,
+                                   ds.features.shape[1], steps, max_epochs)
         cached = _step_cache_get(cache_key)
         if cached is not None:
             tx, train_step = cached["tx"], cached["step"]

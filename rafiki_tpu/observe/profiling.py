@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import threading
 import time
 from typing import Any, Iterator, Optional
 
@@ -52,19 +53,33 @@ def trial_trace_dir(trial_id: str) -> Optional[str]:
     return os.path.join(root, trial_id)
 
 
+# jax.profiler supports ONE active trace per process; concurrent trials
+# (resident-runner threads) must not turn an observability toggle into
+# trial failures, so a busy profiler means "skip this trial's trace".
+_trace_lock = threading.Lock()
+
+
 @contextlib.contextmanager
 def trace_session(trace_dir: Optional[str]) -> Iterator[None]:
-    """Profile the enclosed block into ``trace_dir`` (no-op when None)."""
+    """Profile the enclosed block into ``trace_dir`` (no-op when None or
+    when another trial is already being traced)."""
     if not trace_dir:
         yield
         return
-    os.makedirs(trace_dir, exist_ok=True)
-    jax.profiler.start_trace(trace_dir)
-    try:
+    if not _trace_lock.acquire(blocking=False):
+        _log.info("profiler busy; skipping trace for %s", trace_dir)
         yield
+        return
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            _log.info("trace written to %s", trace_dir)
     finally:
-        jax.profiler.stop_trace()
-        _log.info("trace written to %s", trace_dir)
+        _trace_lock.release()
 
 
 def device_peak_flops(device: Optional[Any] = None) -> Optional[float]:

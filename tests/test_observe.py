@@ -27,6 +27,15 @@ def test_trace_session_noop_without_dir():
         pass  # must not start the profiler
 
 
+def test_trace_session_concurrent_skips_not_raises(tmp_path):
+    """Only one profiler trace can be active; an overlapping session must
+    silently skip (not fail the trial)."""
+    with trace_session(str(tmp_path / "a")):
+        with trace_session(str(tmp_path / "b")):
+            jax.block_until_ready(jnp.ones((4, 4)) @ jnp.ones((4, 4)))
+    assert not os.path.isdir(str(tmp_path / "b"))
+
+
 def test_trace_session_writes_trace(tmp_path):
     d = str(tmp_path / "trace")
     with trace_session(d):
