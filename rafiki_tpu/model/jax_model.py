@@ -27,6 +27,7 @@ Knob conventions the scaffolding understands (all optional):
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import OrderedDict
 from functools import partial
@@ -40,7 +41,8 @@ from flax import traverse_util
 from flax.training import train_state
 
 from ..observe import MfuMeter, flops_of_compiled, flops_of_lowered
-from ..parallel import batch_sharding, build_mesh, replicated, shard_variables
+from ..parallel import (batch_sharding, build_mesh, replicated,
+                        shard_variables)
 from ..parallel.chips import ChipGroup
 from .base import BaseModel, Params
 from .dataset import ImageDataset, load_image_dataset, normalize_query
@@ -98,6 +100,28 @@ def step_cache_key(model: "BaseModel", kind: str, mesh, *parts: Any,
         (k, tuple(v) if isinstance(v, list) else v)
         for k, v in model.knobs.items() if k not in exclude))
     return (type(model), kind, model._module, knob_items, mesh, parts)
+
+
+def pad_crop_flip_graph(x: Any, rng: Any, pad: int = 4,
+                        min_size: int = 8) -> Any:
+    """Reflect-pad random crop + horizontal flip (the CIFAR recipe) as
+    XLA ops — augmentation runs ON DEVICE inside the train step, so the
+    input pipeline ships uint8 indices instead of augmented float batches
+    over the host link. Images smaller than ``min_size`` pass through."""
+    b, h, w, _ = x.shape
+    if h < min_size:
+        return x
+    r_y, r_x, r_f = jax.random.split(rng, 3)
+    padded = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                     mode="reflect")
+    ys = jax.random.randint(r_y, (b,), 0, 2 * pad + 1)
+    xs = jax.random.randint(r_x, (b,), 0, 2 * pad + 1)
+    rows = ys[:, None] + jnp.arange(h)                    # (b, h)
+    cols = xs[:, None] + jnp.arange(w)                    # (b, w)
+    out = padded[jnp.arange(b)[:, None, None],
+                 rows[:, :, None], cols[:, None, :]]
+    flip = jax.random.bernoulli(r_f, 0.5, (b,))
+    return jnp.where(flip[:, None, None, None], out[:, :, ::-1, :], out)
 
 
 def _canonicalize_state(state: Any, mesh) -> Any:
@@ -161,10 +185,12 @@ class JaxModel(BaseModel):
             return optax.adamw(sched, weight_decay=wd)
         return optax.adam(sched)
 
-    def augment_batch(self, images: np.ndarray,
-                      rng: np.random.Generator) -> np.ndarray:
-        """Host-side augmentation hook; default identity."""
-        return images
+    def augment_in_graph(self, x: Any, rng: Any) -> Any:
+        """In-graph (XLA) augmentation hook applied to each float batch
+        inside the compiled train step; default identity. Runs on device
+        so the input pipeline never ships augmented float data over the
+        host link."""
+        return x
 
     def extra_apply_inputs(self) -> Dict[str, np.ndarray]:
         """Extra *traced* inputs forwarded to every ``module.apply`` call
@@ -249,24 +275,40 @@ class JaxModel(BaseModel):
             "train", mesh, steps_per_epoch, max_epochs, has_bs)
         entry = _step_cache_get(cache_key)
         if entry is not None:
-            tx, train_step = entry["tx"], entry["step"]
+            tx, train_chunk = entry["tx"], entry["step"]
         else:
             tx = self.create_optimizer(steps_per_epoch, max_epochs)
             module = self._module
+            augment = self.augment_in_graph
+            base_key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
+            x_spec = batch_sharding(mesh)
 
-            @partial(jax.jit, donate_argnums=(0,))
-            def train_step(state: TrainState, x, y, step_rng, extra):
+            def one_step(state: TrainState, data, labels, sel, step_idx,
+                         extra):
+                # Gather this step's batch from the device-resident uint8
+                # dataset, then normalize + augment in-graph: the host
+                # ships int32 indices, not float image data (the remote
+                # host link measures ~32 MB/s — float staging was the
+                # training bottleneck, not compute).
+                x = jnp.take(data, sel, axis=0).astype(jnp.float32) / 255.0
+                x = jax.lax.with_sharding_constraint(x, x_spec)
+                y = jax.lax.with_sharding_constraint(
+                    jnp.take(labels, sel, axis=0), x_spec)
+                step_rng = jax.random.fold_in(base_key, step_idx)
+                aug_rng, drop_rng = jax.random.split(step_rng)
+                x = augment(x, aug_rng)
+
                 def loss_fn(params):
                     vs = {"params": params}
                     if has_bs:
                         vs["batch_stats"] = state.batch_stats
                         logits, upd = module.apply(
                             vs, x, train=True, mutable=["batch_stats"],
-                            rngs={"dropout": step_rng}, **extra)
+                            rngs={"dropout": drop_rng}, **extra)
                         new_bs = upd["batch_stats"]
                     else:
                         logits = module.apply(vs, x, train=True,
-                                              rngs={"dropout": step_rng},
+                                              rngs={"dropout": drop_rng},
                                               **extra)
                         new_bs = None
                     logits = logits.astype(jnp.float32)
@@ -282,7 +324,27 @@ class JaxModel(BaseModel):
                     state = state.replace(batch_stats=new_bs)
                 return state, loss, acc
 
-            entry = {"tx": tx, "step": train_step}
+            # K optimizer steps per device dispatch: lax.scan runs the
+            # steps inside ONE XLA program over a (K, batch) index matrix.
+            # On a tunneled/remote TPU this amortises the per-dispatch
+            # round trip; combined with the in-graph gather it reduces
+            # per-epoch host traffic to the index matrix (KB, not MB).
+            # Scan compiles the body once regardless of K.
+            @partial(jax.jit, donate_argnums=(0,))
+            def train_chunk(state: TrainState, data, labels, sels, idxs,
+                            extra):
+                def body(state, inp):
+                    sel, i = inp
+                    state, loss, acc = one_step(state, data, labels, sel,
+                                                i, extra)
+                    return state, (loss, acc)
+
+                state, (losses, accs) = jax.lax.scan(
+                    body, state, (sels, idxs))
+                return state, losses.mean(), accs.mean()
+
+            entry = {"tx": tx, "step": train_chunk, "exec": {},
+                     "flops": None}
             _step_cache_put(cache_key, entry)
 
         variables = shard_variables(variables, mesh)
@@ -299,33 +361,44 @@ class JaxModel(BaseModel):
 
         logger.define_plot("Training", ["loss", "train_acc", "chip_util"],
                            x_axis="epoch")
-        x_shard = batch_sharding(mesh)
-        imgs_f = ds.normalized()
-        key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
 
-        # AOT-compile the step once per cached config: the hot loop calls
-        # the compiled executable directly (never retraces), and the SAME
-        # executable's cost analysis supplies FLOPs-per-step for the MFU /
-        # chip-utilization metric of the north star — on TPU only the
-        # compiled (not the lowered) computation exposes a cost model.
-        if "compiled" not in entry:
-            try:
-                xb0 = jax.device_put(imgs_f[:batch_size], x_shard)
-                yb0 = jax.device_put(
-                    np.ascontiguousarray(ds.labels[:batch_size]), x_shard)
-                lowered = train_step.lower(
-                    state, xb0, yb0, jax.random.split(key)[1], extra)
-                entry["flops"] = flops_of_lowered(lowered)
-                entry["compiled"] = lowered.compile()
-                if entry["flops"] is None:
-                    entry["flops"] = flops_of_compiled(entry["compiled"])
-            except Exception:
-                _log.warning("AOT step compile failed; falling back to jit",
-                             exc_info=True)
-                entry["flops"] = None
-                entry["compiled"] = None
-        step_fn = entry["compiled"] if entry["compiled"] is not None \
-            else train_step
+        # Stage the whole dataset on device ONCE as uint8 (4x smaller
+        # than float, paid a single time); every epoch afterwards ships
+        # only an int32 index matrix. Falls back to per-chunk staging for
+        # datasets over the staging budget.
+        stage_bytes = int(os.environ.get("RAFIKI_TPU_STAGE_BYTES",
+                                         2 << 30))
+        staged = ds.images.nbytes <= stage_bytes
+        if staged:
+            data_dev = jax.device_put(
+                np.ascontiguousarray(ds.images), replicated(mesh))
+            labels_dev = jax.device_put(
+                ds.labels.astype(np.int32), replicated(mesh))
+        chunk_steps = max(1, min(steps_per_epoch, 128))
+
+        # AOT-compile per chunk length (at most two: full K + epoch tail),
+        # cached with the step. The executable's own cost analysis
+        # supplies FLOPs for the MFU / chip-utilization metric — XLA
+        # reports one scan iteration's cost, i.e. per-step FLOPs.
+        def dispatch(state, data, labels, sels, idxs):
+            sig = (int(sels.shape[0]), int(data.shape[0]))
+            exe = entry["exec"].get(sig)
+            if exe is None:
+                try:
+                    lowered = train_chunk.lower(state, data, labels, sels,
+                                                idxs, extra)
+                    exe = lowered.compile()
+                    if entry["flops"] is None:
+                        entry["flops"] = flops_of_compiled(exe) \
+                            or flops_of_lowered(lowered)
+                        meter.flops_per_step = entry["flops"]
+                except Exception:
+                    _log.warning("AOT chunk compile failed; jit fallback",
+                                 exc_info=True)
+                    exe = train_chunk
+                entry["exec"][sig] = exe
+            return exe(state, data, labels, sels, idxs, extra)
+
         meter = MfuMeter(entry.get("flops"), n_devices=mesh.size)
 
         early_stop = int(self.knobs.get("early_stop_epochs", 0))
@@ -356,33 +429,52 @@ class JaxModel(BaseModel):
             ep_rng = np.random.default_rng(
                 (int(self.knobs.get("seed", 0)) + 1) * 100003 + epoch)
             order = ep_rng.permutation(ds.size)
-            ep_loss, ep_acc, nb = 0.0, 0.0, 0
-            for s in range(steps_per_epoch):
-                sel = order[s * batch_size:(s + 1) * batch_size]
-                if len(sel) < batch_size:
-                    # Only possible at s == 0 with a dataset smaller than
-                    # one dp-divisible batch: wrap so the epoch still takes
-                    # a real optimizer step.
-                    sel = np.resize(order, batch_size)
-                xb = self.augment_batch(imgs_f[sel], ep_rng)
-                yb = ds.labels[sel]
-                xb = jax.device_put(xb, x_shard)
-                yb = jax.device_put(yb, x_shard)
-                sub = jax.random.fold_in(key, step)
-                state, loss, acc = step_fn(state, xb, yb, sub, extra)
-                step += 1
-                meter.tick()
+            need = steps_per_epoch * batch_size
+            if need > ds.size:
+                # Tiny dataset: wrap so every epoch still takes real
+                # optimizer steps at full batch shape.
+                order = np.resize(order, need)
+            sel_all = order[:need].reshape(steps_per_epoch, batch_size)
+            ep_loss, ep_acc, nw = 0.0, 0.0, 0
+            s = 0
+            while s < steps_per_epoch:
+                k = min(chunk_steps, steps_per_epoch - s)
+                sel = sel_all[s:s + k]
+                rep = replicated(mesh)
+                if staged:
+                    data, labels = data_dev, labels_dev
+                    sels = jax.device_put(
+                        np.ascontiguousarray(sel, np.int32), rep)
+                else:
+                    # Per-chunk staging for oversized datasets: ship this
+                    # chunk's images (still uint8 — 4x less than float;
+                    # normalize/augment stay on device) with identity
+                    # indices, keeping the executable's shapes constant.
+                    flat = sel.reshape(-1)
+                    data = jax.device_put(
+                        np.ascontiguousarray(ds.images[flat]), rep)
+                    labels = jax.device_put(
+                        ds.labels[flat].astype(np.int32), rep)
+                    sels = jax.device_put(
+                        np.arange(len(flat), dtype=np.int32)
+                        .reshape(k, batch_size), rep)
+                idxs = jax.device_put(
+                    np.arange(step, step + k, dtype=np.int32), rep)
+                state, loss, acc = dispatch(state, data, labels, sels,
+                                            idxs)
+                step += k
+                s += k
+                meter.tick(k)
                 if not warmed:
-                    # Exclude the warm-up dispatch (and, on the jit
-                    # fallback, its XLA compile) from the MFU window.
+                    # Exclude the warm-up dispatch (which pays the XLA
+                    # compile) from the MFU window.
                     warmed = True
                     meter.reset()
-                if s == steps_per_epoch - 1 or s % 50 == 49:
-                    ep_loss += float(loss)
-                    ep_acc += float(acc)
-                    nb += 1
-            ep_loss /= max(nb, 1)
-            ep_acc /= max(nb, 1)
+                ep_loss += float(loss) * k
+                ep_acc += float(acc) * k
+                nw += k
+            ep_loss /= max(nw, 1)
+            ep_acc /= max(nw, 1)
             util = {"chip_util": round(meter.mfu, 6)} \
                 if meter.mfu is not None else {}
             logger.log(epoch=epoch, loss=ep_loss, train_acc=ep_acc,

@@ -26,8 +26,7 @@ import numpy as np
 import optax
 
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob, PolicyKnob
-from ..model.dataset import pad_crop_flip
-from ..model.jax_model import JaxModel
+from ..model.jax_model import JaxModel, pad_crop_flip_graph
 
 
 class _DenseLayer(nn.Module):
@@ -139,6 +138,5 @@ class JaxDenseNet(JaxModel):
             optax.sgd(sched, momentum=0.9, nesterov=True),
         )
 
-    def augment_batch(self, images: np.ndarray,
-                      rng: np.random.Generator) -> np.ndarray:
-        return pad_crop_flip(images, rng)
+    def augment_in_graph(self, x, rng):
+        return pad_crop_flip_graph(x, rng)

@@ -41,8 +41,7 @@ import numpy as np
 import optax
 
 from ..model import ArchKnob, FixedKnob, PolicyKnob
-from ..model.dataset import pad_crop_flip
-from ..model.jax_model import JaxModel
+from ..model.jax_model import JaxModel, pad_crop_flip_graph
 
 N_OPS = 5  # identity, sep-conv 3x3, sep-conv 5x5, avg-pool 3x3, max-pool 3x3
 
@@ -264,6 +263,5 @@ class JaxEnas(JaxModel):
             optax.sgd(sched, momentum=0.9, nesterov=True),
         )
 
-    def augment_batch(self, images: np.ndarray,
-                      rng: np.random.Generator) -> np.ndarray:
-        return pad_crop_flip(images, rng)
+    def augment_in_graph(self, x, rng):
+        return pad_crop_flip_graph(x, rng)

@@ -67,7 +67,8 @@ def test_densenet_batchnorm_updates(synth_image_data):
 
 def test_densenet_augmentation_preserves_shape(rng):
     m = JaxDenseNet(**TINY_KNOBS)
-    imgs = rng.random((8, 12, 12, 1)).astype(np.float32)
-    out = m.augment_batch(imgs.copy(), np.random.default_rng(0))
+    imgs = jnp.asarray(rng.random((8, 12, 12, 1)).astype(np.float32))
+    out = m.augment_in_graph(imgs, jax.random.key(0))
     assert out.shape == imgs.shape
     assert out.dtype == imgs.dtype
+    assert not np.allclose(np.asarray(out), np.asarray(imgs))
