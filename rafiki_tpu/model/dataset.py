@@ -301,24 +301,3 @@ def normalize_query(q: Any, expected_shape: Sequence[int]) -> np.ndarray:
         arr = arr.astype(np.float32) / 255.0
     return arr.astype(np.float32)
 
-
-def pad_crop_flip(images: np.ndarray, rng: np.random.Generator,
-                  pad: int = 4, min_size: int = 8) -> np.ndarray:
-    """Reflect-pad random crop + horizontal flip (the CIFAR recipe),
-    vectorised host-side — this runs every optimizer step and must not
-    serialize a Python loop against the device. Images smaller than
-    ``min_size`` pass through untouched."""
-    if images.shape[1] < min_size:
-        return images
-    n, h, w, _ = images.shape
-    padded = np.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
-                    mode="reflect")
-    ys = rng.integers(0, 2 * pad + 1, size=n)
-    xs = rng.integers(0, 2 * pad + 1, size=n)
-    rows = ys[:, None] + np.arange(h)
-    cols = xs[:, None] + np.arange(w)
-    out = padded[np.arange(n)[:, None, None],
-                 rows[:, :, None], cols[:, None, :]]
-    flips = rng.random(n) < 0.5
-    out[flips] = out[flips, :, ::-1]
-    return out

@@ -154,13 +154,22 @@ class JaxModel(BaseModel):
     """Base for flax-module-backed image classifiers.
 
     Subclasses implement ``create_module(n_classes, image_shape)`` and may
-    override ``create_optimizer`` / ``augment_batch``.
+    override ``create_optimizer`` / ``augment_in_graph``.
     """
 
     max_predict_batch: int = 512
 
     def __init__(self, **knobs: Any):
         super().__init__(**knobs)
+        if hasattr(type(self), "augment_batch"):
+            # The host-side hook was replaced by the in-graph pipeline;
+            # silently ignoring an override would train without the
+            # model's augmentation.
+            raise TypeError(
+                f"{type(self).__name__} overrides the removed "
+                "augment_batch hook; augmentation now runs on device — "
+                "override augment_in_graph(x, rng) (see "
+                "pad_crop_flip_graph) instead")
         self._variables: Optional[Dict[str, Any]] = None
         self._module = None
         self._meta: Dict[str, Any] = {}
@@ -380,10 +389,13 @@ class JaxModel(BaseModel):
         # cached with the step. The executable's own cost analysis
         # supplies FLOPs for the MFU / chip-utilization metric — XLA
         # reports one scan iteration's cost, i.e. per-step FLOPs.
+        compiled_this_call = [False]
+
         def dispatch(state, data, labels, sels, idxs):
             sig = (int(sels.shape[0]), int(data.shape[0]))
             exe = entry["exec"].get(sig)
             if exe is None:
+                compiled_this_call[0] = True
                 try:
                     lowered = train_chunk.lower(state, data, labels, sels,
                                                 idxs, extra)
@@ -424,7 +436,6 @@ class JaxModel(BaseModel):
 
         t0 = time.time()
         step = start_epoch * steps_per_epoch
-        warmed = False
         for epoch in range(start_epoch, max_epochs):
             ep_rng = np.random.default_rng(
                 (int(self.knobs.get("seed", 0)) + 1) * 100003 + epoch)
@@ -465,10 +476,10 @@ class JaxModel(BaseModel):
                 step += k
                 s += k
                 meter.tick(k)
-                if not warmed:
-                    # Exclude the warm-up dispatch (which pays the XLA
-                    # compile) from the MFU window.
-                    warmed = True
+                if compiled_this_call[0]:
+                    # Any dispatch that paid an XLA compile (first chunk,
+                    # epoch-tail chunk) is excluded from the MFU window.
+                    compiled_this_call[0] = False
                     meter.reset()
                 ep_loss += float(loss) * k
                 ep_acc += float(acc) * k

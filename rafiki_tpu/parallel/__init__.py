@@ -2,12 +2,10 @@
 
 from .chips import ChipAllocator, ChipGroup
 from .mesh import (DP_AXIS, TP_AXIS, batch_sharding, build_mesh, param_spec,
-                   replicated, shard_variables, stacked_batch_sharding,
-                   variables_shardings)
+                   replicated, shard_variables, variables_shardings)
 
 __all__ = [
     "ChipAllocator", "ChipGroup",
     "DP_AXIS", "TP_AXIS", "build_mesh", "batch_sharding", "replicated",
-    "stacked_batch_sharding",
     "param_spec", "shard_variables", "variables_shardings",
 ]

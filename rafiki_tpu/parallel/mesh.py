@@ -52,12 +52,6 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DP_AXIS))
 
 
-def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for (steps, batch, ...) stacks staged for a scanned
-    multi-step dispatch: steps replicated, batch over dp."""
-    return NamedSharding(mesh, P(None, DP_AXIS))
-
-
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -63,8 +63,11 @@ def test_supernet_one_compile_many_archs(synth_image_data):
         "different archs created distinct train steps (recompile per trial)"
     # One set of AOT-compiled chunk executables serves both architectures;
     # the jit callable behind them must never have been traced twice.
-    assert train_entries[0]["exec"], "train chunks were not AOT-compiled"
-    assert train_entries[0]["step"]._cache_size() <= 1, \
+    entry = train_entries[0]
+    assert entry["exec"] and all(e is not entry["step"]
+                                 for e in entry["exec"].values()), \
+        "train chunks fell back to jit instead of AOT executables"
+    assert entry["step"]._cache_size() <= 1, \
         "train step retraced for the second architecture"
     eval_entries = [v for k, v in jax_model._STEP_CACHE.items()
                     if k[1] == "eval"]
