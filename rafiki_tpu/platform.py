@@ -11,8 +11,10 @@ multi-worker tests on one host, no mocks).
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
+import threading
 from typing import Optional
 
 from .admin import Admin, ServicesManager
@@ -21,6 +23,8 @@ from .bus import BusServer, MemoryBus, connect
 from .container import SystemContext, ThreadContainerManager
 from .parallel.chips import ChipAllocator
 from .store import MetaStore, ParamStore
+
+_log = logging.getLogger(__name__)
 
 
 class LocalPlatform:
@@ -33,7 +37,8 @@ class LocalPlatform:
 
     def __init__(self, workdir: Optional[str] = None,
                  n_chips: Optional[int] = None, http: bool = False,
-                 admin_port: int = 0, bus_uri: str = ""):
+                 admin_port: int = 0, bus_uri: str = "",
+                 supervise_interval: float = 10.0):
         self._tmp = None
         if workdir is None:
             self._tmp = tempfile.TemporaryDirectory(prefix="rafiki_tpu_")
@@ -58,12 +63,32 @@ class LocalPlatform:
         if http:
             self.app = AdminApp(self.admin, port=admin_port).start()
 
+        # Failure detection (SURVEY.md §5): sweep for dead worker
+        # services and restart train workers on fresh chip groups.
+        # Interval 0 disables (tests drive supervise() directly).
+        self._stop_supervisor = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        if supervise_interval > 0:
+            def _loop() -> None:
+                while not self._stop_supervisor.wait(supervise_interval):
+                    try:
+                        self.services.supervise()
+                    except Exception:
+                        _log.exception("supervision sweep failed")
+
+            self._supervisor = threading.Thread(
+                target=_loop, name="supervisor", daemon=True)
+            self._supervisor.start()
+
     @property
     def admin_port(self) -> int:
         assert self.app is not None, "platform started without http=True"
         return self.app.port
 
     def shutdown(self) -> None:
+        self._stop_supervisor.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
         if self.app is not None:
             self.app.stop()
         for job in self.meta.get_train_jobs(status="RUNNING"):

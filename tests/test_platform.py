@@ -21,7 +21,8 @@ FF_CLASS = "rafiki_tpu.models.feedforward:JaxFeedForward"
 
 @pytest.fixture()
 def platform(tmp_path):
-    p = LocalPlatform(workdir=str(tmp_path / "plat"), http=True)
+    p = LocalPlatform(workdir=str(tmp_path / "plat"), http=True,
+                      supervise_interval=0)
     yield p
     p.shutdown()
 
@@ -273,3 +274,39 @@ def test_supervise_restarts_dead_train_worker(platform, synth_image_data):
     completed = platform.meta.get_trials_of_train_job(
         job["id"], status=TrialStatus.COMPLETED)
     assert len(completed) == 3
+
+
+def test_supervisor_thread_sweeps_automatically(tmp_path, synth_image_data):
+    """A platform with a supervise interval detects a dead worker without
+    anyone calling supervise() by hand (the serve-node path)."""
+    train_path, val_path = synth_image_data
+    p = LocalPlatform(workdir=str(tmp_path / "sup"),
+                      supervise_interval=0.2)
+    try:
+        dev, model = _register_model(p, name="ff-auto-sup")
+        job = p.admin.create_train_job(
+            dev["id"], "auto-sup", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 2},
+            train_path, val_path)
+        svc = [s for s in p.meta.get_services()
+               if s["service_type"] == ServiceType.TRAIN][0]
+        worker = p.container.get(svc["container_id"])
+        worker.stop_flag.set()
+        deadline = time.monotonic() + 120
+        while worker.running and time.monotonic() < deadline:
+            time.sleep(0.1)
+        with p.container._lock:
+            p.container._services.pop(svc["id"], None)
+        p.meta.update_service(svc["id"], status=ServiceStatus.RUNNING)
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if p.meta.get_service(svc["id"])["status"] == \
+                    ServiceStatus.ERRORED:
+                break
+            time.sleep(0.2)
+        assert p.meta.get_service(svc["id"])["status"] == \
+            ServiceStatus.ERRORED, "supervisor thread never swept"
+        assert p.admin.wait_until_train_job_done(job["id"], timeout=600)
+    finally:
+        p.shutdown()
