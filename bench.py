@@ -137,10 +137,12 @@ def main_serving() -> None:
             requests.post(url, json={"queries": batch}, timeout=300)
 
             # Concurrent clients: measure server capacity, not one
-            # client's request latency.
+            # client's request latency. Enough in-flight batches that the
+            # workers' burst merging (many frames -> one chip call -> one
+            # host sync) is actually exercised.
             import threading
 
-            counts = [0] * 4
+            counts = [0] * 16
             errors: list = []
             stop = threading.Event()
 

@@ -350,7 +350,10 @@ class JaxModel(BaseModel):
 
                 state, (losses, accs) = jax.lax.scan(
                     body, state, (sels, idxs))
-                return state, losses.mean(), accs.mean()
+                # One stacked (2,) metrics array: the host reads loss and
+                # acc in a single D2H (each separate readback costs a
+                # full ~100ms flush window on the proxied TPU transport).
+                return state, jnp.stack([losses.mean(), accs.mean()])
 
             entry = {"tx": tx, "step": train_chunk, "exec": {},
                      "flops": None}
@@ -471,8 +474,7 @@ class JaxModel(BaseModel):
                         .reshape(k, batch_size), rep)
                 idxs = jax.device_put(
                     np.arange(step, step + k, dtype=np.int32), rep)
-                state, loss, acc = dispatch(state, data, labels, sels,
-                                            idxs)
+                state, metrics = dispatch(state, data, labels, sels, idxs)
                 step += k
                 s += k
                 meter.tick(k)
@@ -481,8 +483,9 @@ class JaxModel(BaseModel):
                     # epoch-tail chunk) is excluded from the MFU window.
                     compiled_this_call[0] = False
                     meter.reset()
-                ep_loss += float(loss) * k
-                ep_acc += float(acc) * k
+                loss_acc = np.asarray(metrics)  # single D2H per chunk
+                ep_loss += float(loss_acc[0]) * k
+                ep_acc += float(loss_acc[1]) * k
                 nw += k
             ep_loss /= max(nw, 1)
             ep_acc /= max(nw, 1)
