@@ -23,11 +23,23 @@ import threading
 
 
 def _serve(args: argparse.Namespace) -> None:
-    from .platform import LocalPlatform
-
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper()),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    # Multi-host slice membership (SURVEY.md §2.10): every host of a pod
+    # slice runs serve with the same coordinator address; JAX wires the
+    # ICI/DCN topology and jax.devices() becomes the global device list,
+    # which the chip allocator then partitions into per-trial groups.
+    if args.coordinator:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
+
+    from .platform import LocalPlatform
     platform = LocalPlatform(workdir=args.workdir, http=True,
                              admin_port=args.port,
                              n_chips=args.chips, bus_uri=args.bus)
@@ -58,6 +70,13 @@ def main(argv=None) -> None:
     serve.add_argument("--bus", default="",
                        help="bus URI ('' = in-process; 'tcp://host:port')")
     serve.add_argument("--log-level", default="info")
+    serve.add_argument("--coordinator", default="",
+                       help="jax.distributed coordinator host:port "
+                            "(multi-host slices; empty = single host)")
+    serve.add_argument("--num-processes", type=int, default=None,
+                       help="total serve processes in the slice")
+    serve.add_argument("--process-id", type=int, default=None,
+                       help="this process's rank in the slice")
     serve.set_defaults(fn=_serve)
 
     args = parser.parse_args(argv)

@@ -96,3 +96,9 @@ def test_dataset_prep_cli_synthetic(tmp_path):
     from rafiki_tpu.model import load_image_dataset
     ds = load_image_dataset(str(tmp_path / "cifar10_train.npz"))
     assert tuple(ds.image_shape) == (32, 32, 3)
+
+
+def test_tasks_tour():
+    r = _run("examples/scripts/tasks_tour.py", timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "TASKS TOUR OK" in r.stdout
