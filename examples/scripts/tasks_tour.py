@@ -19,7 +19,8 @@ def main() -> None:
     from rafiki_tpu.model import (load_corpus_dataset, load_image_dataset,
                                   load_tabular_dataset, test_model_class)
     from rafiki_tpu.models import (JaxFeedForward, JaxPosTagger,
-                                   JaxTabMlpClf, JaxTabMlpReg)
+                                   JaxTabMlpClf, JaxTabMlpReg,
+                                   JaxTransformerTagger)
 
     workdir = tempfile.mkdtemp(prefix="rafiki_tour_")
 
@@ -44,6 +45,16 @@ def main() -> None:
                "batch_size": 32, "max_epochs": 8, "max_len": 64,
                "vocab_size": 16384})
     print(f"POS_TAGGING           JaxPosTagger    token-acc={r.score:.3f}")
+
+    # 2b. POS tagging with the attention-ops Transformer (flash/ring)
+    r = test_model_class(
+        JaxTransformerTagger, TaskType.POS_TAGGING, tr, va,
+        test_queries=load_corpus_dataset(va).sentences[:2],
+        knobs={"d_model": 64, "n_heads": 2, "n_layers": 2,
+               "learning_rate": 1e-2, "batch_size": 32, "max_epochs": 15,
+               "max_len": 64, "dropout": 0.0, "vocab_size": 16384,
+               "sequence_parallel": 1})
+    print(f"POS_TAGGING           JaxTransformerTagger token-acc={r.score:.3f}")
 
     # 3. Tabular classification
     tr, va = make_synthetic_tabular_dataset(workdir, n_train=1024,

@@ -80,6 +80,13 @@ def main(argv=None) -> None:
     serve.set_defaults(fn=_serve)
 
     args = parser.parse_args(argv)
+    if args.cmd == "serve":
+        n_set = sum([args.coordinator != "", args.num_processes is not None,
+                     args.process_id is not None])
+        if n_set not in (0, 3):
+            parser.error(
+                "--coordinator, --num-processes and --process-id must be "
+                "given together (all three, or none)")
     args.fn(args)
 
 

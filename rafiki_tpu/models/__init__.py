@@ -6,6 +6,8 @@ from .feedforward import JaxFeedForward
 from .pos_tagger import JaxPosTagger
 from .sk import SkDt, SkSvm
 from .tabular import JaxTabMlpClf, JaxTabMlpReg
+from .transformer import JaxTransformerTagger
 
 __all__ = ["JaxFeedForward", "JaxDenseNet", "JaxEnas", "JaxPosTagger",
-           "SkDt", "SkSvm", "JaxTabMlpClf", "JaxTabMlpReg"]
+           "SkDt", "SkSvm", "JaxTabMlpClf", "JaxTabMlpReg",
+           "JaxTransformerTagger"]

@@ -1,0 +1,378 @@
+"""JaxTransformerTagger: Transformer encoder for sequence tagging.
+
+Beyond-parity zoo model: the reference's POS_TAGGING task ships only a
+BiLSTM (SURVEY.md §2 "Example models"); this adds a Transformer encoder
+built on the framework's own attention ops (``rafiki_tpu.ops``) so long
+sequences are first-class:
+
+- single chip / chip group: Pallas ``flash_attention`` on TPU (blockwise
+  XLA fallback elsewhere) — O(block) memory, so ``max_len`` can grow far
+  past what a materialised T×T score matrix allows;
+- ``sequence_parallel`` knob > 1: the sequence dimension shards over the
+  ``sp`` mesh axis and attention runs as a ``ppermute`` ring over ICI
+  (``ring_attention``), scaling context length with the chip group.
+
+Same corpus-dataset contract, hashed vocabulary, and per-token
+probability output as ``JaxPosTagger``, so the Advisor, TrainWorker, and
+Predictor ensemble treat the two interchangeably.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import zlib
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import traverse_util
+
+from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
+from ..model.base import BaseModel, Params
+from ..model.dataset import load_corpus_dataset
+from ..model.jax_model import (_step_cache_get, _step_cache_put,
+                               step_cache_key)
+from ..model.logger import logger
+from ..ops import (blockwise_attention, flash_attention,
+                   sequence_sharded_attention)
+from ..parallel import (DP_AXIS, SP_AXIS, batch_sharding, build_mesh,
+                        replicated)
+from ..parallel.chips import ChipGroup
+
+PAD_ID = 0
+
+
+def _token_ids(tokens: List[str], vocab_size: int,
+               max_len: int) -> np.ndarray:
+    ids = np.zeros((max_len,), np.int32)
+    for i, tok in enumerate(tokens[:max_len]):
+        ids[i] = 1 + (zlib.crc32(tok.encode("utf-8")) % (vocab_size - 1))
+    return ids
+
+
+def _sinusoidal(max_len: int, dim: int) -> np.ndarray:
+    pos = np.arange(max_len)[:, None]
+    div = np.exp(np.arange(0, dim, 2) * (-math.log(10000.0) / dim))
+    pe = np.zeros((max_len, dim), np.float32)
+    pe[:, 0::2] = np.sin(pos * div)
+    pe[:, 1::2] = np.cos(pos * div)
+    return pe
+
+
+class _EncoderBlock(nn.Module):
+    """Pre-LN encoder block; attention is injected so the same module
+    serves flash (single group) and ring (sequence-parallel) execution."""
+    n_heads: int
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, attn_fn, kv_mask, *, deterministic: bool):
+        d_model = x.shape[-1]
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(a):  # (B, T, D) -> (B, H, T, Dh)
+            b, t, _ = a.shape
+            return a.reshape(b, t, self.n_heads,
+                             d_model // self.n_heads).transpose(0, 2, 1, 3)
+
+        o = attn_fn(heads(q), heads(k), heads(v), kv_mask)
+        b, nh, t, dh = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, nh * dh)
+        x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype)(o)
+
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(4 * d_model, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
+        return x + nn.Dense(d_model, dtype=self.dtype)(h)
+
+
+class _TransformerTagger(nn.Module):
+    vocab_size: int
+    d_model: int
+    n_heads: int
+    n_layers: int
+    n_tags: int
+    max_len: int
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids, attn_fn, *, train: bool = False):
+        kv_mask = ids != PAD_ID  # hashed token ids are >= 1
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     dtype=self.dtype)(ids)
+        pe = jnp.asarray(_sinusoidal(self.max_len, self.d_model))
+        x = x + pe[None, :ids.shape[1]].astype(x.dtype)
+        for _ in range(self.n_layers):
+            x = _EncoderBlock(self.n_heads, dropout=self.dropout,
+                              dtype=self.dtype)(
+                x, attn_fn, kv_mask, deterministic=not train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.n_tags, dtype=jnp.float32)(x)
+
+
+class JaxTransformerTagger(BaseModel):
+    """Transformer token tagger; flash attention, optional sp ring."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "d_model": CategoricalKnob([64, 128, 256]),
+            "n_heads": CategoricalKnob([2, 4, 8]),
+            "n_layers": IntegerKnob(1, 6),
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            "batch_size": CategoricalKnob([16, 32, 64]),
+            "max_epochs": IntegerKnob(3, 30),
+            # Context length is searchable: flash/ring attention keep the
+            # memory profile linear in max_len, so long contexts are a
+            # knob, not a redesign.
+            "max_len": CategoricalKnob([32, 64, 128, 256, 512]),
+            "dropout": FloatKnob(0.0, 0.3),
+            "vocab_size": FixedKnob(16384),
+            # > 1 shards the sequence dim over sp chips (ring attention).
+            "sequence_parallel": FixedKnob(1),
+        }
+
+    def __init__(self, **knobs: Any):
+        super().__init__(**knobs)
+        self._variables = None
+        self._module: Optional[_TransformerTagger] = None
+        self._meta: Dict[str, Any] = {}
+        self._mesh = None
+        self._predict_fn = None
+        self._vars_dev = None
+
+    # --- plumbing ---
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            sp = int(self.knobs.get("sequence_parallel", 1))
+            self._mesh = build_mesh(ChipGroup.current().devices(), sp=sp)
+        return self._mesh
+
+    def _attn_fn(self):
+        """The attention the encoder blocks run, chosen by mesh shape.
+
+        Bidirectional (non-causal) in all cases; tagging attends the
+        whole sentence.
+        """
+        mesh = self.mesh
+        if mesh.shape[SP_AXIS] > 1:
+            return lambda q, k, v, kv_mask: sequence_sharded_attention(
+                q, k, v, mesh, causal=False, kv_mask=kv_mask)
+        if jax.default_backend() in ("tpu", "axon"):
+            return lambda q, k, v, kv_mask: flash_attention(
+                q, k, v, causal=False, kv_mask=kv_mask)
+        return lambda q, k, v, kv_mask: blockwise_attention(
+            q, k, v, causal=False, kv_mask=kv_mask)
+
+    def _ensure_module(self, n_tags: int) -> None:
+        if self._module is None:
+            self._module = _TransformerTagger(
+                vocab_size=int(self.knobs.get("vocab_size", 16384)),
+                d_model=int(self.knobs.get("d_model", 128)),
+                n_heads=int(self.knobs.get("n_heads", 4)),
+                n_layers=int(self.knobs.get("n_layers", 2)),
+                n_tags=n_tags,
+                max_len=int(self.knobs.get("max_len", 128)),
+                dropout=float(self.knobs.get("dropout", 0.0)))
+
+    def _encode(self, sentences: List[List[str]]):
+        max_len = int(self.knobs.get("max_len", 128))
+        vocab = int(self.knobs.get("vocab_size", 16384))
+        ids = np.stack([_token_ids(s, vocab, max_len) for s in sentences])
+        lengths = np.asarray([min(len(s), max_len) for s in sentences],
+                             np.int32)
+        return ids, lengths
+
+    # --- BaseModel ---
+
+    def train(self, dataset_path: str, *,
+              shared_params: Optional[Params] = None, **kwargs: Any) -> None:
+        ds = load_corpus_dataset(dataset_path)
+        n_tags = len(ds.tag_names)
+        self._ensure_module(n_tags)
+        self._meta = {"tag_names": list(ds.tag_names)}
+        mesh = self.mesh
+        dp = mesh.shape[DP_AXIS]
+        max_len = int(self.knobs.get("max_len", 128))
+
+        ids, lengths = self._encode(ds.sentences)
+        tags = np.zeros((ds.size, max_len), np.int32)
+        for i, t in enumerate(ds.tags):
+            tags[i, :min(len(t), max_len)] = t[:max_len]
+
+        batch_size = min(int(self.knobs.get("batch_size", 32)), ds.size)
+        batch_size = max(dp, (batch_size // dp) * dp)
+        max_epochs = int(self.knobs.get("max_epochs", 10))
+        if self.knobs.get("quick_train", False):
+            max_epochs = min(max_epochs,
+                             int(self.knobs.get("trial_epochs", 1)))
+        steps = max(1, ds.size // batch_size)
+
+        rng = jax.random.key(int(self.knobs.get("seed", 0)))
+        attn = self._attn_fn()
+        module = self._module
+        variables = jax.jit(
+            lambda r, ids: module.init(r, ids, attn, train=False))(
+            rng, jnp.zeros((dp, max_len), jnp.int32))
+        if shared_params is not None:
+            flat = traverse_util.flatten_dict(variables, sep="/")
+            for kk, vv in shared_params.items():
+                if kk in flat and tuple(flat[kk].shape) == tuple(vv.shape):
+                    flat[kk] = jnp.asarray(vv)
+            variables = traverse_util.unflatten_dict(flat, sep="/")
+        params = jax.device_put(variables["params"], replicated(mesh))
+
+        cache_key = step_cache_key(self, "train", mesh, steps, max_epochs)
+        cached = _step_cache_get(cache_key)
+        if cached is not None:
+            tx, train_step = cached["tx"], cached["step"]
+        else:
+            lr = float(self.knobs.get("learning_rate", 1e-3))
+            total = max(1, steps * max_epochs)
+            sched = optax.warmup_cosine_decay_schedule(
+                init_value=lr * 0.1, peak_value=lr,
+                warmup_steps=max(1, total // 10), decay_steps=total,
+                end_value=lr * 0.02)
+            tx = optax.adamw(sched, weight_decay=1e-3)
+            drop_key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
+
+            @jax.jit
+            def train_step(params, opt_state, ids, lengths, tags, step_i):
+                def loss_fn(p):
+                    logits = module.apply(
+                        {"params": p}, ids, attn, train=True,
+                        rngs={"dropout": jax.random.fold_in(drop_key,
+                                                            step_i)})
+                    mask = (jnp.arange(logits.shape[1])[None, :]
+                            < lengths[:, None]).astype(jnp.float32)
+                    losses = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, tags)
+                    loss = (losses * mask).sum() / jnp.maximum(mask.sum(),
+                                                               1)
+                    correct = ((logits.argmax(-1) == tags) * mask).sum() \
+                        / jnp.maximum(mask.sum(), 1)
+                    return loss, correct
+                (loss, acc), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state,
+                        loss, acc)
+
+            _step_cache_put(cache_key, {"tx": tx, "step": train_step})
+
+        opt_state = tx.init(params)
+        logger.define_plot("Training", ["loss", "token_acc"],
+                           x_axis="epoch")
+        x_shard = batch_sharding(mesh)
+        order_rng = np.random.default_rng(int(self.knobs.get("seed", 0)))
+        step_i = 0
+        for epoch in range(max_epochs):
+            order = order_rng.permutation(ds.size)
+            ep_loss = ep_acc = 0.0
+            for s in range(steps):
+                sel = order[s * batch_size:(s + 1) * batch_size]
+                if len(sel) < batch_size:
+                    sel = np.resize(order, batch_size)
+                params, opt_state, loss, acc = train_step(
+                    params, opt_state,
+                    jax.device_put(ids[sel], x_shard),
+                    jax.device_put(lengths[sel], x_shard),
+                    jax.device_put(tags[sel], x_shard),
+                    jnp.int32(step_i))
+                step_i += 1
+                ep_loss += float(loss)
+                ep_acc += float(acc)
+            logger.log(epoch=epoch, loss=ep_loss / steps,
+                       token_acc=ep_acc / steps)
+
+        self._variables = {"params": jax.device_get(params)}
+        self._invalidate_compiled()
+
+    def evaluate(self, dataset_path: str) -> float:
+        assert self._variables is not None
+        ds = load_corpus_dataset(dataset_path)
+        max_len = int(self.knobs.get("max_len", 128))
+        probs = self._predict_probs(ds.sentences)
+        n_correct = n_total = 0
+        for i, gold in enumerate(ds.tags):
+            length = min(len(gold), max_len)
+            pred = probs[i, :length].argmax(-1)
+            n_correct += int((pred == np.asarray(gold[:length])).sum())
+            n_total += length
+        return n_correct / max(n_total, 1)
+
+    def predict(self, queries: List[Any]) -> List[Any]:
+        """Per-token tag distributions (the Predictor ensemble contract;
+        see JaxPosTagger.predict)."""
+        assert self._variables is not None
+        if not queries:
+            return []
+        sentences = [list(q) for q in queries]
+        probs = self._predict_probs(sentences)
+        max_len = int(self.knobs.get("max_len", 128))
+        return [probs[i, :min(len(s), max_len)].tolist()
+                for i, s in enumerate(sentences)]
+
+    def _predict_probs(self, sentences: List[List[str]]) -> np.ndarray:
+        self._ensure_module(len(self._meta["tag_names"]))
+        dp = self.mesh.shape[DP_AXIS]
+        if self._vars_dev is None:
+            self._vars_dev = jax.device_put(
+                self._variables, replicated(self.mesh))
+        if self._predict_fn is None:
+            module, attn = self._module, self._attn_fn()
+            self._predict_fn = jax.jit(
+                lambda v, ids: jax.nn.softmax(
+                    module.apply(v, ids, attn, train=False), -1))
+        ids, _ = self._encode(sentences)
+        n = len(sentences)
+        bucket = dp
+        while bucket < n:
+            bucket *= 2
+        if n < bucket:
+            ids = np.concatenate(
+                [ids, np.zeros((bucket - n, ids.shape[1]), ids.dtype)])
+        out = np.asarray(self._predict_fn(
+            self._vars_dev, jax.device_put(ids, batch_sharding(self.mesh))))
+        return out[:n]
+
+    def dump_parameters(self) -> Params:
+        assert self._variables is not None
+        flat = traverse_util.flatten_dict(self._variables, sep="/")
+        out: Params = {k: np.asarray(v) for k, v in flat.items()}
+        out["_meta/tag_names_json"] = np.frombuffer(
+            json.dumps(self._meta["tag_names"]).encode(), np.uint8)
+        return out
+
+    def load_parameters(self, params: Params) -> None:
+        blob = params.get("_meta/tag_names_json")
+        assert blob is not None, "params missing _meta/tag_names_json"
+        self._meta = {"tag_names": json.loads(
+            np.asarray(blob).tobytes().decode())}
+        flat = {k: np.asarray(v) for k, v in params.items()
+                if not k.startswith("_meta/")}
+        self._variables = traverse_util.unflatten_dict(flat, sep="/")
+        self._module = None
+        self._invalidate_compiled()
+        self._ensure_module(len(self._meta["tag_names"]))
+
+    def _invalidate_compiled(self) -> None:
+        self._predict_fn = None
+        self._vars_dev = None
+
+    def destroy(self) -> None:
+        self._invalidate_compiled()
+        self._variables = None
+        self._module = None

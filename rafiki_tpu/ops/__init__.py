@@ -1,0 +1,16 @@
+"""TPU kernel / collective ops layer.
+
+Hot ops the zoo models call into: Pallas TPU kernels where a hand
+schedule beats XLA fusion, pure-XLA blockwise formulations everywhere
+else, and shard_map ring collectives for sequence parallelism over the
+``sp`` mesh axis (SURVEY.md §5 — absent upstream, first-class here).
+"""
+
+from .attention import (blockwise_attention, flash_attention,
+                        naive_attention, ring_attention,
+                        sequence_sharded_attention)
+
+__all__ = [
+    "blockwise_attention", "flash_attention", "naive_attention",
+    "ring_attention", "sequence_sharded_attention",
+]

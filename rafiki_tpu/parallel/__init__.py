@@ -1,11 +1,12 @@
 """Parallel execution layer: chip groups, meshes, sharding rules."""
 
 from .chips import ChipAllocator, ChipGroup
-from .mesh import (DP_AXIS, TP_AXIS, batch_sharding, build_mesh, param_spec,
-                   replicated, shard_variables, variables_shardings)
+from .mesh import (DP_AXIS, SP_AXIS, TP_AXIS, batch_sharding, build_mesh,
+                   param_spec, replicated, shard_variables,
+                   variables_shardings)
 
 __all__ = [
     "ChipAllocator", "ChipGroup",
-    "DP_AXIS", "TP_AXIS", "build_mesh", "batch_sharding", "replicated",
-    "param_spec", "shard_variables", "variables_shardings",
+    "DP_AXIS", "SP_AXIS", "TP_AXIS", "build_mesh", "batch_sharding",
+    "replicated", "param_spec", "shard_variables", "variables_shardings",
 ]

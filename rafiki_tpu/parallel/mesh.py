@@ -1,11 +1,14 @@
 """Mesh construction and sharding rules for trial execution.
 
 The platform's intra-trial parallelism (SURVEY.md §2.9): each trial trains
-under ``jax.jit`` over a 2-D ``Mesh`` with axes ``("dp", "tp")`` built from
-its chip group — batch data-parallel over ``dp``, optional tensor-parallel
-sharding of large kernels over ``tp``. XLA inserts the ICI collectives
-(psum for grads on ``dp``, all-gather/reduce-scatter on ``tp``); nothing
-here issues a collective by hand.
+under ``jax.jit`` over a 3-D ``Mesh`` with axes ``("dp", "sp", "tp")``
+built from its chip group — batch data-parallel over ``dp``, sequence /
+context parallelism over ``sp`` (long sequences split across chips; the
+ring-attention op in ``rafiki_tpu.ops`` rotates K/V shards over ICI), and
+optional tensor-parallel sharding of large kernels over ``tp``. XLA
+inserts the ICI collectives (psum for grads on ``dp``, all-gather /
+reduce-scatter on ``tp``); only the ring schedule issues a collective
+(``ppermute``) by hand.
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DP_AXIS = "dp"
+SP_AXIS = "sp"
 TP_AXIS = "tp"
 
 # Kernels smaller than this are cheaper to replicate than to shard+gather.
@@ -30,19 +34,28 @@ _TP_MIN_FEATURES = 256
 _MESH_CACHE: dict = {}
 
 
-def build_mesh(devices: Optional[Sequence[Any]] = None, tp: int = 1) -> Mesh:
-    """Arrange ``devices`` into a (dp, tp) mesh; dp = n_devices / tp."""
+def build_mesh(devices: Optional[Sequence[Any]] = None, tp: int = 1,
+               sp: int = 1) -> Mesh:
+    """Arrange ``devices`` into a (dp, sp, tp) mesh; dp = n / (sp * tp).
+
+    Axis order puts ``tp`` fastest-varying (adjacent devices — its
+    all-gathers are the most latency-sensitive collectives), then ``sp``:
+    with ``tp == 1`` (the common case) ring-attention's ``ppermute``
+    hops between devices adjacent in device order; with ``tp > 1`` the
+    sp ring hops stride ``tp``.
+    """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
     n = len(devices)
-    if n % tp != 0:
-        raise ValueError(f"{n} devices not divisible by tp={tp}")
-    key = (tuple(devices), tp)
+    if n % (tp * sp) != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+    key = (tuple(devices), tp, sp)
     mesh = _MESH_CACHE.get(key)
     if mesh is None:
-        arr = np.asarray(devices, dtype=object).reshape(n // tp, tp)
-        mesh = Mesh(arr, (DP_AXIS, TP_AXIS))
+        arr = np.asarray(devices, dtype=object).reshape(
+            n // (sp * tp), sp, tp)
+        mesh = Mesh(arr, (DP_AXIS, SP_AXIS, TP_AXIS))
         _MESH_CACHE[key] = mesh
     return mesh
 
