@@ -99,6 +99,23 @@ class CorpusDataset:
         return len(self.sentences)
 
 
+# Hashing vocabulary shared by the sequence models (JaxPosTagger,
+# JaxTransformerTagger): tokens map to embedding rows via crc32 mod
+# vocab — no host-side vocab fitting, identical across processes, so
+# dump/load needs no vocab artifact. Row 0 is reserved for padding.
+PAD_ID = 0
+
+
+def hash_token_ids(tokens: List[str], vocab_size: int,
+                   max_len: int) -> np.ndarray:
+    import zlib
+
+    ids = np.zeros((max_len,), np.int32)
+    for i, tok in enumerate(tokens[:max_len]):
+        ids[i] = 1 + (zlib.crc32(tok.encode("utf-8")) % (vocab_size - 1))
+    return ids
+
+
 # --- Loaders ---
 
 def load_image_dataset(dataset_path: str) -> ImageDataset:

@@ -213,12 +213,61 @@ class JaxModel(BaseModel):
         """
         return {}
 
+    # Knob names that enter the compiled step as traced optimizer
+    # hyperparameters (optax.inject_hyperparams) instead of baked
+    # schedule constants — continuous lr/wd searches then reuse ONE
+    # executable across trials. Subclasses that opt in must build their
+    # tx with ``traced_hyperparam_optimizer`` (whose hyperparameter
+    # names must match this set) and list a default per name (models are
+    # directly constructible without every knob).
+    traced_knobs: frozenset = frozenset()
+    traced_knob_defaults: Dict[str, float] = {}
+
+    def traced_hyperparam_optimizer(self, steps_per_epoch: int,
+                                    max_epochs: int, opt: str = "adam",
+                                    warmup: bool = False,
+                                    weight_decay: bool = False):
+        """An optimizer whose lr (and optionally wd) live in the opt
+        state: the normalised (peak=1) schedule bakes in, the per-trial
+        values multiply it at trace time from ``opt_state.hyperparams``.
+        """
+        total = max(1, steps_per_epoch * max_epochs)
+        if warmup:
+            wsteps = max(1, min(total // 20, 5 * steps_per_epoch))
+            sched01 = optax.warmup_cosine_decay_schedule(
+                init_value=0.1, peak_value=1.0, warmup_steps=wsteps,
+                decay_steps=total, end_value=1e-3)
+        else:
+            sched01 = optax.cosine_decay_schedule(1.0, decay_steps=total,
+                                                  alpha=0.01)
+        scale_by = {"adam": optax.scale_by_adam,
+                    "sgdm": lambda: optax.trace(decay=0.9, nesterov=True),
+                    }[opt]
+
+        if weight_decay:
+            def make(learning_rate, weight_decay):
+                return optax.chain(
+                    optax.add_decayed_weights(weight_decay),
+                    scale_by(),
+                    optax.scale_by_schedule(sched01),
+                    optax.scale(-1.0 * learning_rate))
+            return optax.inject_hyperparams(make)(learning_rate=0.0,
+                                                  weight_decay=0.0)
+
+        def make(learning_rate):
+            return optax.chain(
+                scale_by(),
+                optax.scale_by_schedule(sched01),
+                optax.scale(-1.0 * learning_rate))
+        return optax.inject_hyperparams(make)(learning_rate=0.0)
+
     def _step_cache_key(self, kind: str, mesh, *parts: Any) -> Any:
         # Knobs routed through extra_apply_inputs are traced inputs, not
         # graph constants — exclude them so e.g. every ENAS architecture
-        # hits one executable.
+        # hits one executable. Same for traced optimizer hyperparameters.
+        exclude = set(self.extra_apply_inputs()) | self.traced_knobs
         return step_cache_key(self, kind, mesh, *parts,
-                              exclude=frozenset(self.extra_apply_inputs()))
+                              exclude=frozenset(exclude))
 
     # --- Mesh / module plumbing ---
 
@@ -369,6 +418,13 @@ class JaxModel(BaseModel):
             batch_stats=variables.get("batch_stats"),
             tx=tx,
         )
+        for name in self.traced_knobs:
+            # Per-trial hyperparameters ride in the (traced) optimizer
+            # state; the compiled step never sees them as constants.
+            value = self.knobs.get(name, self.traced_knob_defaults.get(
+                name, 0.0))
+            state.opt_state.hyperparams[name] = jnp.asarray(
+                float(value), jnp.float32)
         state = _canonicalize_state(state, mesh)
 
         logger.define_plot("Training", ["loss", "train_acc", "chip_util"],

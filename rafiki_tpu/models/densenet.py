@@ -101,6 +101,12 @@ _BLOCK_CONFIGS = {
 class JaxDenseNet(JaxModel):
     """DenseNet-BC image classifier (CIFAR-10 parity model)."""
 
+    # lr and wd are continuous search knobs: traced as optimizer
+    # hyperparameters so trials recompile only when the architecture
+    # (arch / growth_rate) actually changes shape.
+    traced_knobs = frozenset({"learning_rate", "weight_decay"})
+    traced_knob_defaults = {"learning_rate": 0.1, "weight_decay": 1e-4}
+
     @staticmethod
     def get_knob_config():
         return {
@@ -125,18 +131,11 @@ class JaxDenseNet(JaxModel):
 
     def create_optimizer(self, steps_per_epoch: int,
                          max_epochs: int) -> optax.GradientTransformation:
-        # SGD + momentum + cosine decay: the reference DenseNet recipe.
-        lr = float(self.knobs.get("learning_rate", 0.1))
-        total = max(1, steps_per_epoch * max_epochs)
-        warmup = min(total // 20, 5 * steps_per_epoch)
-        sched = optax.warmup_cosine_decay_schedule(
-            init_value=lr * 0.1, peak_value=lr, warmup_steps=max(1, warmup),
-            decay_steps=total, end_value=lr * 1e-3)
-        wd = float(self.knobs.get("weight_decay", 1e-4))
-        return optax.chain(
-            optax.add_decayed_weights(wd),
-            optax.sgd(sched, momentum=0.9, nesterov=True),
-        )
+        # SGD + momentum + warmup-cosine: the reference DenseNet recipe,
+        # with lr/wd as traced hyperparameters (see traced_knobs).
+        return self.traced_hyperparam_optimizer(
+            steps_per_epoch, max_epochs, opt="sgdm", warmup=True,
+            weight_decay=True)
 
     def augment_in_graph(self, x, rng):
         return pad_crop_flip_graph(x, rng)

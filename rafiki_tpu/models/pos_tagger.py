@@ -12,7 +12,6 @@ across processes, so dump/load needs no vocab artifact.
 from __future__ import annotations
 
 import json
-import zlib
 from typing import Any, Dict, List, Optional
 
 import flax.linen as nn
@@ -24,22 +23,13 @@ from flax import traverse_util
 
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
 from ..model.base import BaseModel, Params
-from ..model.dataset import load_corpus_dataset
+from ..model.dataset import (PAD_ID, hash_token_ids,  # noqa: F401
+                             load_corpus_dataset)
 from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
 from ..parallel import batch_sharding, build_mesh, replicated
 from ..parallel.chips import ChipGroup
-
-PAD_ID = 0  # hashed ids live in [1, vocab_size)
-
-
-def _token_ids(tokens: List[str], vocab_size: int,
-               max_len: int) -> np.ndarray:
-    ids = np.zeros((max_len,), np.int32)
-    for i, tok in enumerate(tokens[:max_len]):
-        ids[i] = 1 + (zlib.crc32(tok.encode("utf-8")) % (vocab_size - 1))
-    return ids
 
 
 class _BiLstm(nn.Module):
@@ -100,7 +90,8 @@ class JaxPosTagger(BaseModel):
     def _encode(self, sentences: List[List[str]]):
         max_len = int(self.knobs.get("max_len", 64))
         vocab = int(self.knobs.get("vocab_size", 16384))
-        ids = np.stack([_token_ids(s, vocab, max_len) for s in sentences])
+        ids = np.stack([hash_token_ids(s, vocab, max_len)
+                        for s in sentences])
         lengths = np.asarray([min(len(s), max_len) for s in sentences],
                              np.int32)
         return ids, lengths

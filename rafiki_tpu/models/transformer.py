@@ -22,7 +22,6 @@ from __future__ import annotations
 import functools
 import json
 import math
-import zlib
 from typing import Any, Dict, List, Optional
 
 import flax.linen as nn
@@ -34,7 +33,8 @@ from flax import traverse_util
 
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
 from ..model.base import BaseModel, Params
-from ..model.dataset import load_corpus_dataset
+from ..model.dataset import (PAD_ID, hash_token_ids,
+                             load_corpus_dataset)
 from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
@@ -43,17 +43,6 @@ from ..ops import (blockwise_attention, flash_attention,
 from ..parallel import (DP_AXIS, SP_AXIS, batch_sharding, build_mesh,
                         replicated)
 from ..parallel.chips import ChipGroup
-
-PAD_ID = 0
-
-
-def _token_ids(tokens: List[str], vocab_size: int,
-               max_len: int) -> np.ndarray:
-    ids = np.zeros((max_len,), np.int32)
-    for i, tok in enumerate(tokens[:max_len]):
-        ids[i] = 1 + (zlib.crc32(tok.encode("utf-8")) % (vocab_size - 1))
-    return ids
-
 
 def _sinusoidal(max_len: int, dim: int) -> np.ndarray:
     pos = np.arange(max_len)[:, None]
@@ -190,7 +179,8 @@ class JaxTransformerTagger(BaseModel):
     def _encode(self, sentences: List[List[str]]):
         max_len = int(self.knobs.get("max_len", 128))
         vocab = int(self.knobs.get("vocab_size", 16384))
-        ids = np.stack([_token_ids(s, vocab, max_len) for s in sentences])
+        ids = np.stack([hash_token_ids(s, vocab, max_len)
+                        for s in sentences])
         lengths = np.asarray([min(len(s), max_len) for s in sentences],
                              np.int32)
         return ids, lengths
