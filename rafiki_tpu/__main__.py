@@ -57,6 +57,27 @@ def _serve(args: argparse.Namespace) -> None:
         platform.shutdown()
 
 
+def _broker(args: argparse.Namespace) -> None:
+    from .bus import NativeBusServer, serve_broker
+
+    server = serve_broker(args.host, args.port,
+                          native=False if args.python else None)
+    kind = type(server).__name__
+    print(f"bus broker ({kind}) on {server.uri}", flush=True)
+    try:
+        if isinstance(server, NativeBusServer):
+            server.serve_forever()  # raises if the child broker crashes
+        else:
+            # The Python BusServer already serves on its own daemon
+            # thread; a second serve_forever loop would fight it over
+            # socketserver's shutdown state — just block.
+            threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="rafiki_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -78,6 +99,16 @@ def main(argv=None) -> None:
     serve.add_argument("--process-id", type=int, default=None,
                        help="this process's rank in the slice")
     serve.set_defaults(fn=_serve)
+
+    broker = sub.add_parser(
+        "broker", help="run a standalone bus broker (multi-process / "
+                       "multi-host deployments point --bus at it)")
+    broker.add_argument("--host", default="127.0.0.1")
+    broker.add_argument("--port", type=int, default=6380)
+    broker.add_argument("--python", action="store_true",
+                        help="force the Python broker (default: the C++ "
+                             "broker when a toolchain exists)")
+    broker.set_defaults(fn=_broker)
 
     args = parser.parse_args(argv)
     if args.cmd == "serve":

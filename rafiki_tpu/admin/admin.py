@@ -127,6 +127,7 @@ class Admin:
     def create_train_job(self, user_id: str, app: str, task: str,
                          model_ids: List[str], budget: Dict[str, Any],
                          train_dataset_path: str, val_dataset_path: str,
+                         advisor_type: Optional[str] = None,
                          ) -> Dict[str, Any]:
         budget = normalize_budget(budget)
         budget.setdefault(BudgetOption.MODEL_TRIAL_COUNT, 5)
@@ -146,7 +147,8 @@ class Admin:
             user_id, app, task, budget, train_dataset_path,
             val_dataset_path, TrainJobStatus.STARTED)
         for model_id in model_ids:
-            self.meta.create_sub_train_job(job["id"], model_id, "STARTED")
+            self.meta.create_sub_train_job(job["id"], model_id, "STARTED",
+                                           advisor_type=advisor_type)
         self.services.create_train_services(job["id"])
         self.meta.update_train_job(job["id"], status=TrainJobStatus.RUNNING)
         return {"id": job["id"], "app": job["app"],

@@ -128,7 +128,8 @@ class AdminApp:
         return 201, self.admin.create_train_job(
             claims["user_id"], body["app"], body["task"], body["model_ids"],
             body.get("budget", {}), body["train_dataset_path"],
-            body["val_dataset_path"])
+            body["val_dataset_path"],
+            advisor_type=body.get("advisor_type"))
 
     def _get_train_job(self, params, body, ctx):
         claims = self._auth(ctx)

@@ -12,11 +12,14 @@ across the slice. Strategies:
 - ``EnasAdvisor`` — RNN-policy controller trained with REINFORCE, proposing
   ``ArchKnob`` encodings with weight sharing via the ParamStore
   (upstream ENAS controller advisor). Lives in ``enas.py``.
+- ``AshaAdvisor`` — asynchronous successive halving over the model's
+  epoch-budget knob (beyond parity; ``advisor_type="asha"``).
 
 ``make_advisor`` picks the right strategy from the knob config, like the
 upstream factory.
 """
 
+from .asha import AshaAdvisor
 from .base import BaseAdvisor, Proposal
 from .bayes import BayesOptAdvisor
 from .enas import EnasAdvisor
@@ -25,5 +28,5 @@ from .registry import make_advisor
 
 __all__ = [
     "BaseAdvisor", "Proposal", "RandomAdvisor", "BayesOptAdvisor",
-    "EnasAdvisor", "make_advisor",
+    "EnasAdvisor", "AshaAdvisor", "make_advisor",
 ]

@@ -1,0 +1,126 @@
+"""ASHA: Asynchronous Successive Halving over an epoch-budget knob.
+
+Beyond-parity search strategy (upstream ships random / Bayesian-opt /
+ENAS — SURVEY.md §2 "Advisor"): most AutoML wall-clock goes to trials
+that were never going to win. ASHA runs new configurations at a small
+epoch budget (rung 0) and only *promotes* a configuration to the next
+rung — eta times the budget — once it places in the top 1/eta of its
+rung. Asynchronous: promotions are issued the moment one is justified,
+so parallel TrainWorkers never block on a synchronous bracket barrier
+(the property that matters when trials fan out across chip groups).
+
+The budget rides the model's own ``max_epochs`` knob (IntegerKnob range
+or the sorted numeric values of a CategoricalKnob), so any zoo model is
+ASHA-compatible unmodified; promoted trials retrain at the larger budget
+(no mid-trial checkpoint dependency). With no tunable budget knob the
+strategy degenerates to random search at a fixed budget.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..model.knobs import CategoricalKnob, IntegerKnob, KnobConfig, Knobs
+from .base import BaseAdvisor, Proposal
+
+
+def _budget_ladder(knob, eta: int) -> List[int]:
+    """Geometric rung budgets within the knob's legal values."""
+    if isinstance(knob, IntegerKnob):
+        lo, hi = knob.value_min, knob.value_max
+        if lo >= hi:
+            return [lo]
+        ladder = [lo]
+        while ladder[-1] < hi:
+            ladder.append(min(ladder[-1] * eta, hi))
+        return ladder
+    if isinstance(knob, CategoricalKnob):
+        numeric = sorted({int(v) for v in knob.values
+                          if isinstance(v, (int, float))})
+        if not numeric:
+            return []
+        # Subsample the sorted values geometrically: always keep the
+        # smallest and largest, and only values >= eta x the previous rung.
+        ladder = [numeric[0]]
+        for v in numeric[1:]:
+            if v >= ladder[-1] * eta or v == numeric[-1]:
+                ladder.append(v)
+        return ladder
+    return []
+
+
+class AshaAdvisor(BaseAdvisor):
+    """Asynchronous successive halving; thread-safe like every advisor."""
+
+    def __init__(self, knob_config: KnobConfig, seed: int = 0,
+                 total_trials: Optional[int] = None, *, eta: int = 3,
+                 budget_knob: str = "max_epochs"):
+        super().__init__(knob_config, seed, total_trials=total_trials)
+        self.eta = max(2, int(eta))
+        self.budget_knob = budget_knob
+        self._ladder = _budget_ladder(knob_config.get(budget_knob),
+                                      self.eta)
+        n_rungs = max(1, len(self._ladder))
+        # Per rung: best score seen per configuration id.
+        self._rung_scores: List[Dict[int, float]] = [
+            {} for _ in range(n_rungs)]
+        self._promoted: List[Set[int]] = [set() for _ in range(n_rungs)]
+        self._configs: Dict[int, Knobs] = {}
+        self._next_config = 0
+        # trial_no -> (config_id, rung); popped by _observe/_forget.
+        self._pending: Dict[int, Tuple[int, int]] = {}
+
+    # --- Strategy hooks (called under the base lock) ---
+
+    def _propose_knobs(self, trial_no: int) -> Knobs:
+        promo = self._find_promotion()
+        if promo is not None:
+            cid, rung = promo
+            knobs = dict(self._configs[cid])
+            knobs[self.budget_knob] = self._ladder[rung]
+            self._pending[trial_no] = (cid, rung)
+            return knobs
+        # New configuration at rung 0.
+        knobs = {name: knob.sample(self.rng)
+                 for name, knob in self.knob_config.items()}
+        cid = self._next_config
+        self._next_config += 1
+        base = dict(knobs)
+        base.pop(self.budget_knob, None)
+        self._configs[cid] = base
+        if self._ladder:
+            knobs[self.budget_knob] = self._ladder[0]
+        self._pending[trial_no] = (cid, 0)
+        return knobs
+
+    def _find_promotion(self) -> Optional[Tuple[int, int]]:
+        """Highest-rung promotable configuration, or None."""
+        for rung in reversed(range(len(self._ladder) - 1)):
+            scores = self._rung_scores[rung]
+            k = len(scores) // self.eta
+            if k == 0:
+                continue
+            top = sorted(scores.items(), key=lambda kv: -kv[1])[:k]
+            for cid, _ in top:
+                if cid not in self._promoted[rung]:
+                    self._promoted[rung].add(cid)
+                    return cid, rung + 1
+        return None
+
+    def _observe(self, proposal: Proposal, score: float) -> None:
+        entry = self._pending.pop(proposal.trial_no, None)
+        if entry is None:
+            return
+        cid, rung = entry
+        prev = self._rung_scores[rung].get(cid)
+        if prev is None or score > prev:
+            self._rung_scores[rung][cid] = float(score)
+
+    def _forget(self, proposal: Proposal) -> None:
+        entry = self._pending.pop(proposal.trial_no, None)
+        if entry is None:
+            return
+        cid, rung = entry
+        # A promotion that never reported stays eligible for re-issue.
+        if rung > 0:
+            self._promoted[rung - 1].discard(cid)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .asha import AshaAdvisor
 from .base import BaseAdvisor
 from .bayes import BayesOptAdvisor
 from .enas import EnasAdvisor
@@ -19,6 +20,7 @@ ADVISOR_TYPES = {
     "random": RandomAdvisor,
     "bayes": BayesOptAdvisor,
     "enas": EnasAdvisor,
+    "asha": AshaAdvisor,
 }
 
 

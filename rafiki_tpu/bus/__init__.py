@@ -12,13 +12,19 @@ bus is first-party: one wire-compatible interface with two backends —
   multi-process / multi-host deployments over DCN. Device-side collectives
   never touch this path — XLA moves tensors over ICI; the bus carries
   control-plane JSON and (base64) query payloads only.
+- ``NativeBusServer`` (``bus.native``): the same wire protocol served by
+  a C++ poll() event loop (``native_broker.cpp``) — no GIL, zero-copy
+  payload splicing; ``serve_broker`` picks it automatically when a
+  toolchain exists. Python ``BusClient``s connect to either.
 """
 
 from .base import BaseBus
 from .memory import MemoryBus
+from .native import NativeBusServer, serve_broker
 from .tcp import BusClient, BusServer
 
-__all__ = ["BaseBus", "MemoryBus", "BusClient", "BusServer", "connect"]
+__all__ = ["BaseBus", "MemoryBus", "BusClient", "BusServer",
+           "NativeBusServer", "serve_broker", "connect"]
 
 
 def connect(uri: str = "") -> BaseBus:

@@ -1,0 +1,142 @@
+"""Native bus broker: compile-on-first-use C++ event-loop server.
+
+The Python ``BusServer`` (tcp.py) parses and re-encodes every frame under
+the GIL, so a busy node's control-plane traffic contends with model host
+code. ``NativeBusServer`` runs the wire-compatible C++ broker
+(``native_broker.cpp`` — poll() event loop, zero-copy payload splicing)
+as a child process; Python ``BusClient``s connect to either unchanged.
+
+The binary is built with g++ on first use and cached per source hash
+under the user cache dir. ``NativeBusServer.available()`` reports whether
+a toolchain (or cached binary) exists; callers fall back to the Python
+broker when it doesn't (see ``serve_broker``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SOURCE = os.path.join(_HERE, "native_broker.cpp")
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    path = os.path.join(base, "rafiki_tpu")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _binary_path() -> str:
+    with open(_SOURCE, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_cache_dir(), f"native_broker_{digest}")
+
+
+def build_broker(force: bool = False) -> str:
+    """Compile the broker if its cached binary is missing; returns the
+    binary path. Raises on compiler failure."""
+    binary = _binary_path()
+    if not force and os.path.exists(binary):
+        return binary
+    # Build to a temp name then rename: concurrent builders race benignly.
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(binary))
+    os.close(fd)
+    cmd = ["g++", "-O2", "-std=c++17", "-o", tmp, _SOURCE]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True,
+                       timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        os.unlink(tmp)
+        detail = getattr(e, "stderr", "") or str(e)
+        raise RuntimeError(f"native broker build failed: {detail}") from e
+    os.chmod(tmp, 0o755)
+    os.replace(tmp, binary)
+    return binary
+
+
+class NativeBusServer:
+    """Broker-process handle mirroring ``BusServer``'s API."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._binary = build_broker()
+        self._requested = (host, port)
+        self.host = host
+        self.port = port
+        self._proc: Optional[subprocess.Popen] = None
+
+    @staticmethod
+    def available() -> bool:
+        try:
+            build_broker()
+            return True
+        except RuntimeError:
+            return False
+
+    @property
+    def uri(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    def start(self) -> "NativeBusServer":
+        host, port = self._requested
+        self._proc = subprocess.Popen(
+            [self._binary, host, str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        line = self._proc.stdout.readline().strip()  # "PORT <n>"
+        if not line.startswith("PORT "):
+            self.stop()
+            raise RuntimeError(
+                f"native broker failed to start (got {line!r})")
+        self.port = int(line.split()[1])
+        return self
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+            self._proc = None
+
+    def serve_forever(self) -> None:
+        """Run in the foreground (broker-process entrypoint). Raises when
+        the child broker dies on its own — a crash must not look like a
+        clean shutdown to process supervisors."""
+        if self._proc is None:
+            self.start()
+        proc = self._proc
+        rc = proc.wait()
+        if self._proc is not None and rc != 0:
+            raise RuntimeError(f"native broker exited with status {rc}")
+
+
+def serve_broker(host: str = "127.0.0.1", port: int = 0, *,
+                 native: Optional[bool] = None):
+    """Start a broker, preferring the native one.
+
+    ``native=None`` auto-selects: C++ broker when a toolchain/cached
+    binary exists, Python ``BusServer`` otherwise. Returns the started
+    server object (``.uri``, ``.stop()``).
+    """
+    from .tcp import BusServer
+
+    if native is None:
+        native = NativeBusServer.available()
+    if native:
+        try:
+            return NativeBusServer(host, port).start()
+        except RuntimeError:
+            _log.warning("native broker unavailable; using Python broker",
+                         exc_info=True)
+    return BusServer(host, port).start()

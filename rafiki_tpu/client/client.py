@@ -90,11 +90,14 @@ class Client:
 
     def create_train_job(self, app: str, task: str, model_ids: List[str],
                          budget: Dict[str, Any], train_dataset_path: str,
-                         val_dataset_path: str) -> Dict[str, Any]:
+                         val_dataset_path: str,
+                         advisor_type: Optional[str] = None,
+                         ) -> Dict[str, Any]:
         return self._call("POST", "/train_jobs", app=app, task=task,
                           model_ids=model_ids, budget=budget,
                           train_dataset_path=train_dataset_path,
-                          val_dataset_path=val_dataset_path)
+                          val_dataset_path=val_dataset_path,
+                          advisor_type=advisor_type)
 
     def get_train_jobs(self) -> List[Dict[str, Any]]:
         return self._call("GET", "/train_jobs")

@@ -74,6 +74,15 @@ class BaseModel(abc.ABC):
 
     # --- Optional hooks ---
 
+    def predict_submit(self, queries: List[Any]):
+        """Dispatch prediction and return a zero-arg finisher yielding
+        ``predict(queries)``'s result. Default is synchronous; device
+        models override to return before the device round-trip completes
+        so a serving loop can pipeline bursts (see
+        ``JaxModel.predict_submit``)."""
+        predictions = self.predict(queries)
+        return lambda: predictions
+
     def destroy(self) -> None:
         """Release device/process resources. Idempotent."""
 

@@ -92,13 +92,22 @@ class InferenceWorker:
                                      status=ServiceStatus.ERRORED)
             raise
         try:
+            # One burst stays in flight: dispatch burst N+1's compute to
+            # the device BEFORE blocking on burst N's result readback
+            # (predict_submit), hiding the device->host sync latency
+            # behind the next burst's compute.
+            pending = None
             while not self.stop_flag.is_set():
                 items = self.cache.pop_queries(
                     self.service_id, max_items=self.max_batch,
-                    timeout=self.batch_timeout)
-                if not items:
-                    continue
-                self._serve_batch(items)
+                    timeout=0.0 if pending is not None
+                    else self.batch_timeout)
+                handle = self._dispatch_batch(items) if items else None
+                if pending is not None:
+                    self._complete_batch(*pending)
+                pending = handle
+            if pending is not None:
+                self._complete_batch(*pending)
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.STOPPED)
         except Exception:
@@ -110,9 +119,10 @@ class InferenceWorker:
             self.cache.unregister_worker(self.inference_job_id,
                                          self.service_id)
 
-    def _serve_batch(self, items: list) -> None:
-        # A burst may mix batch frames and single-query frames; flatten
-        # into ONE chip-side predict call, then split replies per frame.
+    def _dispatch_batch(self, items: list):
+        """Flatten a burst into ONE chip-side predict dispatch; returns
+        (finisher, spans, n) for ``_complete_batch``. A burst may mix
+        batch frames and single-query frames."""
         flat: list = []
         spans: list = []  # (item, start, count, is_batch)
         for it in items:
@@ -123,10 +133,20 @@ class InferenceWorker:
                 spans.append((it, len(flat), 1, False))
                 flat.append(it["query"])
         try:
-            predictions = self._model.predict(flat)
+            finisher = self._model.predict_submit(flat)
         except Exception as e:
-            _log.exception("predict failed on batch of %d", len(flat))
-            predictions = [{"error": f"{type(e).__name__}: {e}"}] * len(flat)
+            _log.exception("predict dispatch failed on batch of %d",
+                           len(flat))
+            err = {"error": f"{type(e).__name__}: {e}"}
+            finisher = lambda n=len(flat): [err] * n  # noqa: E731
+        return finisher, spans, len(flat)
+
+    def _complete_batch(self, finisher, spans: list, n: int) -> None:
+        try:
+            predictions = finisher()
+        except Exception as e:
+            _log.exception("predict failed on batch of %d", n)
+            predictions = [{"error": f"{type(e).__name__}: {e}"}] * n
         for it, start, count, is_batch in spans:
             if is_batch:
                 self.cache.send_prediction_batch(

@@ -1,0 +1,128 @@
+"""AshaAdvisor: rung ladders, promotion policy, platform integration."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.advisor import AshaAdvisor, make_advisor
+from rafiki_tpu.advisor.asha import _budget_ladder
+from rafiki_tpu.model.knobs import (CategoricalKnob, FixedKnob, FloatKnob,
+                                    IntegerKnob)
+
+CONFIG = {
+    "width": IntegerKnob(8, 64),
+    "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+    "max_epochs": IntegerKnob(1, 27),
+}
+
+
+def test_budget_ladder_shapes():
+    assert _budget_ladder(IntegerKnob(1, 27), 3) == [1, 3, 9, 27]
+    assert _budget_ladder(IntegerKnob(2, 20), 3) == [2, 6, 18, 20]
+    assert _budget_ladder(IntegerKnob(5, 5), 3) == [5]
+    assert _budget_ladder(CategoricalKnob([5, 10, 20, 40]), 2) == \
+        [5, 10, 20, 40]
+    assert _budget_ladder(CategoricalKnob([3, 4, 30]), 3) == [3, 30]
+    assert _budget_ladder(CategoricalKnob(["a", "b"]), 3) == []
+    assert _budget_ladder(FixedKnob(7), 3) == []
+    assert _budget_ladder(None, 3) == []
+
+
+def test_new_configs_start_at_rung_zero():
+    adv = AshaAdvisor(CONFIG, seed=0)
+    for _ in range(5):
+        p = adv.propose()
+        assert p.knobs["max_epochs"] == 1  # rung-0 budget
+        assert 8 <= p.knobs["width"] <= 64
+
+
+def test_promotion_reuses_config_at_higher_budget():
+    adv = AshaAdvisor(CONFIG, seed=0, eta=3)
+    proposals = [adv.propose() for _ in range(6)]
+    scores = [0.1, 0.9, 0.2, 0.8, 0.3, 0.4]
+    for p, s in zip(proposals, scores):
+        adv.feedback(p, s)
+    # 6 completed at rung 0 -> floor(6/3)=2 promotable; the next two
+    # proposals must be the two best configs at the rung-1 budget.
+    p7 = adv.propose()
+    p8 = adv.propose()
+    promoted = sorted([p7, p8], key=lambda p: -p.knobs["width"] * 0)
+    budgets = {p.knobs["max_epochs"] for p in promoted}
+    assert budgets == {3}
+    promoted_widths = {p.knobs["width"] for p in promoted}
+    best_widths = {proposals[1].knobs["width"], proposals[3].knobs["width"]}
+    assert promoted_widths == best_widths
+    # And learning rate (the config identity) is carried over unchanged.
+    assert {p.knobs["learning_rate"] for p in promoted} == \
+        {proposals[1].knobs["learning_rate"],
+         proposals[3].knobs["learning_rate"]}
+
+
+def test_promotions_climb_to_top_rung():
+    rng = np.random.default_rng(0)
+    adv = AshaAdvisor(CONFIG, seed=1, eta=3, total_trials=60)
+    seen_budgets = set()
+    while True:
+        p = adv.propose()
+        if p is None:
+            break
+        seen_budgets.add(p.knobs["max_epochs"])
+        # Score correlated with width: halving should drive the widest
+        # configs upward through every rung.
+        adv.feedback(p, p.knobs["width"] / 64 + rng.normal(0, 0.01))
+    assert seen_budgets == {1, 3, 9, 27}
+    best_knobs, _ = adv.best()
+    assert best_knobs["width"] >= 40
+
+
+def test_forget_refunds_promotion():
+    adv = AshaAdvisor(CONFIG, seed=0, eta=2)
+    proposals = [adv.propose() for _ in range(2)]
+    adv.feedback(proposals[0], 0.9)
+    adv.feedback(proposals[1], 0.1)
+    promo = adv.propose()
+    assert promo.knobs["max_epochs"] == 2  # IntegerKnob(1,27), eta=2
+    adv.forget(promo)
+    # The promotion slot is refunded: the same config is re-promotable.
+    promo2 = adv.propose()
+    assert promo2.knobs["max_epochs"] == 2
+    assert promo2.knobs["width"] == promo.knobs["width"]
+
+
+def test_degenerates_without_budget_knob():
+    adv = AshaAdvisor({"x": IntegerKnob(1, 4)}, seed=0)
+    p = adv.propose()
+    assert 1 <= p.knobs["x"] <= 4
+    adv.feedback(p, 0.5)
+    assert adv.propose() is not None
+
+
+def test_registry_selects_asha():
+    adv = make_advisor(CONFIG, advisor_type="asha", total_trials=3)
+    assert isinstance(adv, AshaAdvisor)
+    assert [adv.propose() is not None for _ in range(3)] == [True] * 3
+    assert adv.propose() is None  # budget enforced
+
+
+def test_asha_through_platform(tmp_path, synth_image_data):
+    """End-to-end: a train job with advisor_type=asha schedules rung-0
+    budgets through real workers."""
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.platform import LocalPlatform
+
+    train_path, val_path = synth_image_data
+    p = LocalPlatform(workdir=str(tmp_path / "plat"), supervise_interval=0)
+    try:
+        dev = p.admin.create_user("dev@x.c", "pw",
+                                  UserType.MODEL_DEVELOPER)
+        model = p.admin.create_model(
+            dev["id"], "ff", TaskType.IMAGE_CLASSIFICATION,
+            "rafiki_tpu.models.feedforward:JaxFeedForward")
+        job = p.admin.create_train_job(
+            dev["id"], "app", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 3},
+            train_path, val_path, advisor_type="asha")
+        assert p.admin.wait_until_train_job_done(job["id"], timeout=600)
+        detail = p.admin.get_train_job(job["id"])
+        assert detail["sub_train_jobs"][0]["n_completed"] == 3
+    finally:
+        p.shutdown()

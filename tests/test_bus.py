@@ -7,19 +7,23 @@ import numpy as np
 import pytest
 
 from rafiki_tpu.bus import BusClient, BusServer, MemoryBus, connect
+from rafiki_tpu.bus.native import NativeBusServer
 from rafiki_tpu.cache import Cache, decode_payload, encode_payload
 
 
-@pytest.fixture(params=["memory", "tcp"])
+@pytest.fixture(params=["memory", "tcp", "native"])
 def bus(request):
     if request.param == "memory":
         yield MemoryBus()
-    else:
-        server = BusServer().start()
-        client = BusClient(server.host, server.port)
-        yield client
-        client.close()
-        server.stop()
+        return
+    if request.param == "native" and not NativeBusServer.available():
+        pytest.skip("no C++ toolchain for the native broker")
+    server_cls = NativeBusServer if request.param == "native" else BusServer
+    server = server_cls().start()
+    client = BusClient(server.host, server.port)
+    yield client
+    client.close()
+    server.stop()
 
 
 class TestBus:
