@@ -16,6 +16,11 @@
 
 FROM python:3.11-slim
 
+# g++ builds the native bus broker (rafiki_tpu/bus/native_broker.cpp);
+# the platform falls back to the pure-Python broker without it.
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
 # libtpu + jax come from the TPU release wheel index; everything else is
 # pure-python.
 RUN pip install --no-cache-dir \

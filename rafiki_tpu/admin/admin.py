@@ -53,10 +53,16 @@ class Admin:
                 "token": token}
 
     def authorize(self, token: str) -> Dict[str, Any]:
+        """Decode a bearer token AND re-check the user row: a ban must
+        revoke existing sessions immediately, not at token expiry."""
         try:
-            return auth.decode_token(token, self.jwt_secret)
+            claims = auth.decode_token(token, self.jwt_secret)
         except ValueError as e:
             raise PermissionError(f"invalid token: {e}")
+        user = self.meta.get_user(claims.get("user_id", ""))
+        if user is None or user["banned_at"] is not None:
+            raise PermissionError("user is banned or deleted")
+        return claims
 
     def create_user(self, email: str, password: str,
                     user_type: str) -> Dict[str, Any]:
@@ -270,6 +276,31 @@ class Admin:
                           claims: Optional[Dict[str, Any]] = None,
                           ) -> Dict[str, Any]:
         return dict(self._owned_inference_job(inference_job_id, claims))
+
+    def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
+        return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
+
+    # --- User administration (ADMIN-only; enforced by the REST layer) ---
+
+    def get_users(self) -> List[Dict[str, Any]]:
+        return [{"id": u["id"], "email": u["email"],
+                 "user_type": u["user_type"],
+                 "banned": u["banned_at"] is not None}
+                for u in self.meta.get_users()]
+
+    def ban_user(self, user_id: str,
+                 claims: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        target = self.meta.get_user(user_id)
+        if target is None:
+            raise ValueError(f"unknown user {user_id}")
+        # The root account must stay recoverable (there is no unban
+        # route), and self-bans lock out the very session issuing them.
+        if target["user_type"] == UserType.SUPERADMIN:
+            raise PermissionError("the superadmin cannot be banned")
+        if claims is not None and claims.get("user_id") == user_id:
+            raise PermissionError("cannot ban yourself")
+        self.meta.ban_user(user_id)
+        return {"banned": user_id}
 
     def stop_inference_job(self, inference_job_id: str,
                            claims: Optional[Dict[str, Any]] = None) -> None:

@@ -49,9 +49,12 @@ class AdminApp:
             ("GET", "/train_jobs/<job_id>/trials", self._get_trials),
             ("GET", "/trials/<trial_id>/logs", self._get_trial_logs),
             ("POST", "/inference_jobs", self._create_inference_job),
+            ("GET", "/inference_jobs", self._list_inference_jobs),
             ("GET", "/inference_jobs/<job_id>", self._get_inference_job),
             ("POST", "/inference_jobs/<job_id>/stop",
              self._stop_inference_job),
+            ("GET", "/users", self._list_users),
+            ("POST", "/users/<user_id>/ban", self._ban_user),
         ], host=host, port=port, name="admin")
         self.host = self._http.host
         self.port = self._http.port
@@ -169,3 +172,15 @@ class AdminApp:
         claims = self._auth(ctx)
         self.admin.stop_inference_job(params["job_id"], claims=claims)
         return 200, {"stopped": params["job_id"]}
+
+    def _list_inference_jobs(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_inference_jobs(claims["user_id"])
+
+    def _list_users(self, params, body, ctx):
+        self._auth(ctx, UserType.SUPERADMIN, UserType.ADMIN)
+        return 200, self.admin.get_users()
+
+    def _ban_user(self, params, body, ctx):
+        claims = self._auth(ctx, UserType.SUPERADMIN, UserType.ADMIN)
+        return 200, self.admin.ban_user(params["user_id"], claims=claims)

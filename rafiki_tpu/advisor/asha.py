@@ -27,7 +27,9 @@ from .base import BaseAdvisor, Proposal
 def _budget_ladder(knob, eta: int) -> List[int]:
     """Geometric rung budgets within the knob's legal values."""
     if isinstance(knob, IntegerKnob):
-        lo, hi = knob.value_min, knob.value_max
+        # A zero/negative floor would make the geometric ladder never
+        # grow; the smallest meaningful epoch budget is 1.
+        lo, hi = max(1, knob.value_min), knob.value_max
         if lo >= hi:
             return [lo]
         ladder = [lo]

@@ -248,6 +248,9 @@ static bool parse_envelope(const char* data, size_t n,
 
 struct Waiter {
     int fd;
+    uint64_t gen;      // connection generation: fds get recycled by the
+                       // kernel; a stale waiter must never match a new
+                       // connection that happens to reuse the fd
     double deadline;   // monotonic seconds
     bool batch;        // pop_all vs pop
     long max_items;    // for pop_all
@@ -255,10 +258,13 @@ struct Waiter {
 
 struct Conn {
     int fd = -1;
+    uint64_t gen = 0;
     std::string rbuf;
     std::string wbuf;
     bool parked = false;  // a blocking pop is outstanding
 };
+
+static uint64_t next_gen = 1;
 
 static std::map<int, Conn> conns;
 static std::map<std::string, std::deque<std::string>> queues;
@@ -324,7 +330,8 @@ static bool fulfil_waiter(const std::string& qname,
         Waiter w = dq.front();
         dq.pop_front();
         auto cit = conns.find(w.fd);
-        if (cit == conns.end()) continue;  // connection died while parked
+        if (cit == conns.end() || cit->second.gen != w.gen)
+            continue;  // connection died while parked (fd may be reused)
         Conn& c = cit->second;
         c.parked = false;
         if (w.batch) {
@@ -349,8 +356,8 @@ static double expire_waiters() {
         auto& dq = it->second;
         for (auto w = dq.begin(); w != dq.end();) {
             auto cit = conns.find(w->fd);
-            if (cit == conns.end()) {
-                w = dq.erase(w);
+            if (cit == conns.end() || cit->second.gen != w->gen) {
+                w = dq.erase(w);  // dead or recycled connection
                 continue;
             }
             if (w->deadline <= now) {
@@ -434,7 +441,7 @@ static void handle_request(Conn& c, const char* data, size_t n) {
             return;
         }
         waiters[qname].push_back(
-            Waiter{c.fd, now_mono() + timeout, batch, max_items});
+            Waiter{c.fd, c.gen, now_mono() + timeout, batch, max_items});
         c.parked = true;  // response deferred
         return;
     }
@@ -628,7 +635,10 @@ int main(int argc, char** argv) {
                 int fl = fcntl(cfd, F_GETFL, 0);
                 fcntl(cfd, F_SETFL, fl | O_NONBLOCK);
                 setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-                conns[cfd] = Conn{cfd};
+                Conn c;
+                c.fd = cfd;
+                c.gen = next_gen++;
+                conns[cfd] = c;
             }
         }
         for (size_t i = 1; i < pfds.size(); ++i) {

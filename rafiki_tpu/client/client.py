@@ -142,6 +142,17 @@ class Client:
         return self._call("POST", "/inference_jobs",
                           train_job_id=train_job_id, max_models=max_models)
 
+    def get_inference_jobs(self) -> List[Dict[str, Any]]:
+        return self._call("GET", "/inference_jobs")
+
+    def get_users(self) -> List[Dict[str, Any]]:
+        """Admin-only: list users with their type and ban state."""
+        return self._call("GET", "/users")
+
+    def ban_user(self, user_id: str) -> Dict[str, Any]:
+        """Admin-only: banned users can no longer authenticate."""
+        return self._call("POST", f"/users/{user_id}/ban")
+
     def get_inference_job(self, inference_job_id: str) -> Dict[str, Any]:
         return self._call("GET", f"/inference_jobs/{inference_job_id}")
 

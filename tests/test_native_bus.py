@@ -91,6 +91,39 @@ def test_native_broker_concurrent_hammer():
         server.stop()
 
 
+def test_parked_pop_not_misdelivered_after_fd_reuse():
+    """A client that dies with a parked pop must never cause its queued
+    response to land on a NEW connection that recycles the same fd
+    (waiters carry a connection generation, not just the fd)."""
+    import json
+    import socket
+    import struct
+    import time
+
+    server = NativeBusServer().start()
+    hdr = struct.Struct(">I")
+
+    def frame(obj):
+        d = json.dumps(obj).encode()
+        return hdr.pack(len(d)) + d
+
+    try:
+        c1 = socket.create_connection((server.host, server.port))
+        c1.sendall(frame({"op": "pop", "queue": "q", "timeout": 30}))
+        time.sleep(0.2)
+        c1.close()
+        time.sleep(0.2)
+
+        c2 = BusClient(server.host, server.port)
+        assert c2.ping()
+        c2.push("q", {"v": 1})
+        assert c2.ping()  # response stream must stay in lockstep
+        assert c2.pop("q", timeout=1.0) == {"v": 1}
+        c2.close()
+    finally:
+        server.stop()
+
+
 def test_serve_broker_fallback_selects():
     server = serve_broker()
     try:

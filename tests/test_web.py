@@ -40,6 +40,73 @@ def test_train_jobs_listing_route(http_platform):
     assert admin is not None
 
 
+def test_user_admin_routes(http_platform):
+    from rafiki_tpu.client import Client, ClientError
+
+    client = Client(admin_port=http_platform.app.port)
+    client.login("superadmin@rafiki", "rafiki")
+    made = client.create_user("victim@x.c", "pw", UserType.APP_DEVELOPER)
+    users = client.get_users()
+    assert any(u["email"] == "victim@x.c" and not u["banned"]
+               for u in users)
+
+    # a ban revokes EXISTING sessions too, not just future logins
+    victim = Client(admin_port=http_platform.app.port)
+    victim.login("victim@x.c", "pw")
+    assert victim.get_train_jobs() == []
+    client.ban_user(made["id"])
+    assert any(u["email"] == "victim@x.c" and u["banned"]
+               for u in client.get_users())
+    with pytest.raises(ClientError):
+        victim.get_train_jobs()  # live token now rejected
+    with pytest.raises(ClientError):
+        Client(admin_port=http_platform.app.port).login("victim@x.c",
+                                                        "pw")
+
+    # the root account and the caller themselves are unbannable
+    su = next(u for u in client.get_users()
+              if u["user_type"] == "SUPERADMIN")
+    with pytest.raises(ClientError):
+        client.ban_user(su["id"])
+    admin2 = client.create_user("adm2@x.c", "pw", UserType.ADMIN)
+    c2 = Client(admin_port=http_platform.app.port)
+    c2.login("adm2@x.c", "pw")
+    with pytest.raises(ClientError):
+        c2.ban_user(admin2["id"])  # self-ban
+
+    # non-admins get 403 on the users routes
+    client.create_user("plain@x.c", "pw", UserType.APP_DEVELOPER)
+    plain = Client(admin_port=http_platform.app.port)
+    plain.login("plain@x.c", "pw")
+    with pytest.raises(ClientError) as e:
+        plain.get_users()
+    assert e.value.status == 403
+
+
+def test_inference_jobs_listing(http_platform, synth_image_data):
+    from rafiki_tpu.client import Client
+    from rafiki_tpu.constants import BudgetOption, TaskType
+
+    train_path, val_path = synth_image_data
+    client = Client(admin_port=http_platform.app.port)
+    client.login("superadmin@rafiki", "rafiki")
+    assert client.get_inference_jobs() == []
+    model = client.create_model(
+        "ff", TaskType.IMAGE_CLASSIFICATION,
+        "rafiki_tpu.models.feedforward:JaxFeedForward")
+    job = client.create_train_job(
+        "app", TaskType.IMAGE_CLASSIFICATION, [model["id"]],
+        {BudgetOption.MODEL_TRIAL_COUNT: 1}, train_path, val_path)
+    assert client.wait_until_train_job_done(job["id"], timeout=600)
+    inf = client.create_inference_job(job["id"], max_models=1)
+    listed = client.get_inference_jobs()
+    assert [j["id"] for j in listed] == [inf["id"]]
+    assert listed[0]["status"] == "RUNNING"
+    assert listed[0]["predictor_host"]
+    client.stop_inference_job(inf["id"])
+    assert client.get_inference_jobs()[0]["status"] == "STOPPED"
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
