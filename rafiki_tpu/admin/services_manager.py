@@ -193,24 +193,40 @@ class ServicesManager:
                                   trial_ids: List[str],
                                   chips_per_worker: int = 1,
                                   ) -> List[Dict[str, Any]]:
-        services = []
-        for trial_id in trial_ids:
+        # Ensemble packing: with fewer allocatable chip groups than
+        # trials, one worker serves several models from its group
+        # (round-robin bins) instead of failing the deploy — a v5e-1
+        # still serves a real top-k ensemble. Groups are allocated
+        # greedily (free-chip math would overestimate under
+        # fragmentation: allocate() needs contiguous runs), so the
+        # worker count degrades to whatever actually fits.
+        grabbed: List[Dict[str, Any]] = []  # service rows with a group
+        for _ in trial_ids:
             svc_row = self.meta.create_service(ServiceType.INFERENCE,
                                                ServiceStatus.DEPLOYING)
             group = self.allocator.allocate(
                 chips_per_worker, name=self._alloc_name(svc_row["id"]))
             if group is None:
-                # A worker without an allocation would fall back to ALL
-                # devices and trample running jobs' chip groups; fail the
-                # deploy and release what we launched so far instead.
                 self.meta.update_service(svc_row["id"],
-                                         status=ServiceStatus.ERRORED)
-                for launched in services:
-                    self._stop_service(launched["id"])
-                raise RuntimeError(
-                    f"no chips available for inference job "
-                    f"{inference_job_id} (need {chips_per_worker}/worker; "
-                    f"{self.allocator.free_chips} free)")
+                                         status=ServiceStatus.STOPPED)
+                break
+            grabbed.append({"row": svc_row, "group": group})
+        if not grabbed:
+            # A worker without an allocation would fall back to ALL
+            # devices and trample running jobs' chip groups; fail the
+            # deploy instead.
+            raise RuntimeError(
+                f"no chips available for inference job "
+                f"{inference_job_id} (need {chips_per_worker}/worker; "
+                f"{self.allocator.free_chips} free, fragmented)")
+        bins: List[List[str]] = [[] for _ in grabbed]
+        for i, tid in enumerate(trial_ids):
+            bins[i % len(grabbed)].append(tid)
+
+        services = []
+        for holder, bin_ids in zip(grabbed, bins):
+            trial_id = ",".join(bin_ids)
+            svc_row, group = holder["row"], holder["group"]
             chips = list(group.indices)
             env = {
                 EnvVars.META_URI: self.meta_uri,
@@ -227,9 +243,16 @@ class ServicesManager:
                 container_id = self.container.create_service(svc_row["id"],
                                                              env)
             except Exception:
-                self.allocator.release(self._alloc_name(svc_row["id"]))
+                # Roll back everything: this holder, holders not yet
+                # launched, and workers already launched for this job.
+                for h in grabbed:
+                    if h["row"]["id"] not in {s["id"] for s in services}:
+                        self.allocator.release(
+                            self._alloc_name(h["row"]["id"]))
                 self.meta.update_service(svc_row["id"],
                                          status=ServiceStatus.ERRORED)
+                for launched in services:
+                    self._stop_service(launched["id"])
                 raise
             self.meta.update_service(svc_row["id"],
                                      container_id=container_id, chips=chips)

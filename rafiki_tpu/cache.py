@@ -172,13 +172,17 @@ class Cache:
         return items
 
     def send_prediction(self, query_id: str, worker_id: str,
-                        prediction: Any) -> None:
+                        prediction: Any, weight: int = 1) -> None:
+        """``weight`` = how many ensemble members this worker's reply
+        already averages (packed-ensemble workers report > 1 so the
+        Predictor's cross-worker mean stays unweighted over trials)."""
         self.bus.push(f"r:{query_id}", {
-            "worker_id": worker_id,
+            "worker_id": worker_id, "weight": int(weight),
             "prediction": encode_payload(prediction)})
 
     def send_prediction_batch(self, batch_id: str, worker_id: str,
-                              predictions: List[Any]) -> None:
+                              predictions: List[Any],
+                              weight: int = 1) -> None:
         self.bus.push(f"r:{batch_id}", {
-            "worker_id": worker_id,
+            "worker_id": worker_id, "weight": int(weight),
             "predictions": [encode_payload(p) for p in predictions]})

@@ -20,31 +20,46 @@ from ..cache import Cache
 _log = logging.getLogger(__name__)
 
 
-def ensemble_predictions(worker_predictions: List[Any]) -> Any:
+def ensemble_predictions(worker_predictions: List[Any],
+                         weights: Optional[List[int]] = None) -> Any:
     """Combine one query's per-worker predictions.
 
     Numeric vectors (class probabilities) → elementwise mean, the
-    reference's image-classification combiner. Non-numeric predictions →
-    majority vote, falling back to the first (upstream serves the first
-    worker's output for tasks without a combiner).
+    reference's image-classification combiner; ``weights`` (ensemble
+    members already averaged inside each reply — packed workers) make it
+    an unweighted mean over trials. Non-numeric predictions → majority
+    vote (one vote per worker), falling back to the first (upstream
+    serves the first worker's output for tasks without a combiner).
     """
-    preds = [p for p in worker_predictions
-             if not (isinstance(p, dict) and "error" in p)]
-    if not preds:
+    pairs = []
+    for i, p in enumerate(worker_predictions):
+        if isinstance(p, dict) and "error" in p:
+            continue
+        if isinstance(p, dict) and "__members__" in p:
+            # Packed workers ship non-numeric member predictions
+            # un-combined so each trial gets its own vote here.
+            pairs.extend((m, 1) for m in p["__members__"])
+            continue
+        pairs.append((p, weights[i] if weights else 1))
+    if not pairs:
         return None
+    preds = [p for p, _ in pairs]
     try:
         arr = np.asarray(preds, dtype=np.float64)
         if not np.isnan(arr).any():
-            return np.mean(arr, axis=0).tolist()
+            w = np.asarray([w for _, w in pairs], dtype=np.float64)
+            return np.average(arr, axis=0, weights=w).tolist()
     except (ValueError, TypeError):
         pass
     # Non-numeric: majority vote by value (repr as the equality key),
-    # ties broken by worker order.
+    # each entry voting its weight; ties broken by arrival order.
     from collections import Counter
 
-    reprs = [repr(p) for p in preds]
-    winner = Counter(reprs).most_common(1)[0][0]
-    return preds[reprs.index(winner)]
+    counts: Counter = Counter()
+    for p, w in pairs:
+        counts[repr(p)] += int(w)
+    winner = counts.most_common(1)[0][0]
+    return next(p for p, _ in pairs if repr(p) == winner)
 
 
 class Predictor:
@@ -101,7 +116,8 @@ class Predictor:
                          len(replies), len(workers))
         results: List[Optional[Any]] = []
         for i in range(len(queries)):
+            live = [r for r in replies if i < len(r["predictions"])]
             results.append(ensemble_predictions(
-                [r["predictions"][i] for r in replies
-                 if i < len(r["predictions"])]))
+                [r["predictions"][i] for r in live],
+                weights=[int(r.get("weight", 1)) for r in live]))
         return results

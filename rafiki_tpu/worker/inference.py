@@ -23,6 +23,76 @@ from ..utils.model_loader import load_model_class
 _log = logging.getLogger(__name__)
 
 
+class _PackedEnsemble:
+    """Several trial models sharing one chip group, served as one unit.
+
+    ``predict_submit`` dispatches every member's compute back-to-back
+    (all async) before any result readback, so members overlap on the
+    device. The finisher pre-averages numeric (probability) predictions
+    and reports ``last_weight`` = surviving member count, so the
+    Predictor's weighted cross-worker mean equals the unweighted mean
+    over all trials; non-numeric predictions ship un-combined in a
+    ``__members__`` envelope (the Predictor votes over individual
+    trials — pre-voting would lose the member distribution). A failing
+    member drops ONLY its own vote: the other packed trials keep
+    serving (per-member fault isolation).
+    """
+
+    def __init__(self, models: list):
+        self.models = models
+        self.last_weight = len(models)
+
+    def predict_submit(self, queries: list):
+        import numpy as np
+
+        finishers = []
+        for m in self.models:
+            try:
+                finishers.append(m.predict_submit(queries))
+            except Exception:
+                _log.exception("packed member dispatch failed; dropping "
+                               "its vote")
+
+        def finish() -> list:
+            member_preds = []
+            for f in finishers:
+                try:
+                    member_preds.append(f())
+                except Exception:
+                    _log.exception("packed member predict failed; "
+                                   "dropping its vote")
+            if not member_preds:
+                raise RuntimeError("every packed ensemble member failed")
+            self.last_weight = len(member_preds)
+            out = []
+            for i in range(len(queries)):
+                votes = [p[i] for p in member_preds]
+                try:
+                    arr = np.asarray(votes, dtype=np.float64)
+                    if not np.isnan(arr).any():
+                        out.append(np.mean(arr, axis=0).tolist())
+                        continue
+                except (ValueError, TypeError):
+                    pass
+                out.append({"__members__": votes})
+            return out
+
+        return finish
+
+    def predict(self, queries: list) -> list:
+        return self.predict_submit(queries)()
+
+    def warmup(self) -> None:
+        for m in self.models:
+            warm = getattr(m, "warmup", None)
+            if warm is not None:
+                warm()
+
+    def destroy(self) -> None:
+        for m in self.models:
+            m.destroy()
+
+
 class InferenceWorker:
     def __init__(self, service_id: str, inference_job_id: str, trial_id: str,
                  meta: MetaStore, params: ParamStore, bus: BaseBus,
@@ -61,15 +131,24 @@ class InferenceWorker:
     # --- Setup + loop ---
 
     def _load_model(self) -> Any:
-        trial = self.meta.get_trial(self.trial_id)
-        if trial is None:
-            raise ValueError(f"unknown trial {self.trial_id}")
-        model_row = self.meta.get_model(trial["model_id"])
-        model_class = load_model_class(model_row["model_class"],
-                                       model_row.get("model_source"))
-        model = model_class(**model_class.validate_knobs(trial["knobs"]))
-        model.load_parameters(self.params.load(trial["params_id"]))
-        return model
+        """Load the worker's trial model(s); ``trial_id`` may be a
+        comma-joined list when the scheduler packed an ensemble onto one
+        chip group (see ServicesManager.create_inference_services)."""
+        models = []
+        for tid in str(self.trial_id).split(","):
+            trial = self.meta.get_trial(tid)
+            if trial is None:
+                raise ValueError(f"unknown trial {tid}")
+            model_row = self.meta.get_model(trial["model_id"])
+            model_class = load_model_class(model_row["model_class"],
+                                           model_row.get("model_source"))
+            model = model_class(
+                **model_class.validate_knobs(trial["knobs"]))
+            model.load_parameters(self.params.load(trial["params_id"]))
+            models.append(model)
+        if len(models) == 1:
+            return models[0]
+        return _PackedEnsemble(models)
 
     def run(self) -> None:
         if self.chips is not None:
@@ -147,11 +226,13 @@ class InferenceWorker:
         except Exception as e:
             _log.exception("predict failed on batch of %d", n)
             predictions = [{"error": f"{type(e).__name__}: {e}"}] * n
+        weight = int(getattr(self._model, "last_weight", 1))
         for it, start, count, is_batch in spans:
             if is_batch:
                 self.cache.send_prediction_batch(
                     it["batch_id"], self.service_id,
-                    predictions[start:start + count])
+                    predictions[start:start + count], weight=weight)
             else:
                 self.cache.send_prediction(it["query_id"], self.service_id,
-                                           predictions[start])
+                                           predictions[start],
+                                           weight=weight)

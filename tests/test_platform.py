@@ -237,6 +237,63 @@ def test_parallel_workers_respect_trial_budget(platform, synth_image_data):
     assert len(train_svcs) == 3
 
 
+def test_weighted_ensemble_combiner():
+    from rafiki_tpu.predictor.predictor import ensemble_predictions
+
+    # A packed worker's reply (weight 2, already the mean of 2 members)
+    # plus a single-model worker: result = unweighted mean over 3 trials.
+    packed = [0.6, 0.4]   # mean of two members
+    single = [0.0, 1.0]
+    out = ensemble_predictions([packed, single], weights=[2, 1])
+    np.testing.assert_allclose(out, [(0.6 * 2 + 0.0) / 3,
+                                     (0.4 * 2 + 1.0) / 3])
+    # errors are dropped with their weights
+    out = ensemble_predictions([{"error": "x"}, single], weights=[2, 1])
+    np.testing.assert_allclose(out, single)
+    # non-numeric: weighted majority vote
+    assert ensemble_predictions(["a", "b", "a"], weights=[1, 5, 1]) == "b"
+    # packed non-numeric members arrive un-combined and vote per trial
+    assert ensemble_predictions(
+        [{"__members__": ["a", "b"]}, "b"], weights=[2, 1]) == "b"
+
+
+def test_ensemble_packs_onto_one_chip_group(tmp_path, synth_image_data):
+    """With 1 chip and a 2-model ensemble, one worker serves both trials
+    (packed) and the endpoint still returns the full-ensemble mean."""
+    train_path, val_path = synth_image_data
+    p = LocalPlatform(workdir=str(tmp_path / "plat"), http=True,
+                      n_chips=1, supervise_interval=0)
+    try:
+        dev, model = _register_model(p)
+        job = p.admin.create_train_job(
+            dev["id"], "pack-app", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 2},
+            train_path, val_path)
+        assert p.admin.wait_until_train_job_done(job["id"], timeout=600)
+        inf = p.admin.create_inference_job(dev["id"], job["id"],
+                                           max_models=2)
+        assert len(inf["trial_ids"]) == 2
+        # One packed worker (plus the predictor service row), not two:
+        workers = [w for w in p.meta.get_inference_job_workers(inf["id"])
+                   if w["trial_id"] != "__predictor__"]
+        assert len(workers) == 1
+        assert set(workers[0]["trial_id"].split(",")) == \
+            set(inf["trial_ids"])
+        host = p.admin.get_inference_job(inf["id"])["predictor_host"]
+        ds = load_image_dataset(val_path)
+        from rafiki_tpu.cache import encode_payload
+        r = requests.post(f"http://{host}/predict",
+                          json={"queries": [encode_payload(ds.images[0])]},
+                          timeout=300)
+        r.raise_for_status()
+        probs = r.json()["predictions"][0]
+        assert len(probs) == ds.n_classes
+        assert abs(sum(probs) - 1.0) < 1e-3
+        p.admin.stop_inference_job(inf["id"])
+    finally:
+        p.shutdown()
+
+
 def test_supervise_restarts_dead_train_worker(platform, synth_image_data):
     train_path, val_path = synth_image_data
     dev, model = _register_model(platform, name="ff-sup")
