@@ -38,6 +38,9 @@ BASELINE_SERVING_QPS = 1097.0
 BASELINE_MT_TRIALS_PER_HOUR = None  # needs >= 2 chips; no TPU figure yet
 BASELINE_DENSENET_IMAGES_PER_SEC = 1504.0
 BASELINE_ENAS_TRIALS_PER_HOUR = 254.1
+# The XLA O(T^2) attention is the "reference implementation" this
+# kernel replaces; its measured v5e-1 throughput is the baseline.
+BASELINE_ATTENTION_TFLOPS = 16.5
 
 N_TRIALS = 3
 N_TRAIN, N_VAL = 4096, 512
@@ -304,6 +307,52 @@ def main_enas() -> None:
           "trials/hour", BASELINE_ENAS_TRIALS_PER_HOUR)
 
 
+def main_attention() -> None:
+    """Flash-attention kernel throughput (bf16, causal, T=8192) on the
+    real chip. The tunneled TPU hides up to ~0.7 s of compute inside its
+    sync latency, so the op loops inside ONE jit via lax.scan and the
+    measured window subtracts that constant (see BASELINE.md notes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.ops import flash_attention
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        raise SystemExit("attention bench needs the TPU (the CPU "
+                         "interpreter path would take hours at T=8192)")
+    B, H, T, D = 2, 8, 8192, 128
+    N = 400
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    flops = B * H * T * T * D * 2 * 2 / 2  # causal
+
+    @jax.jit
+    def looped(q, k, v):
+        def body(qq, _):
+            return qq + flash_attention(qq, k, v, causal=True) * 1e-6, ()
+        qq, _ = jax.lax.scan(body, q, None, length=N)
+        return qq
+
+    def sync(o):
+        return np.asarray(jax.jit(
+            lambda x: x.reshape(-1)[:1].astype(jnp.float32))(o))
+
+    sync(looped(q, k, v))  # compile + warm
+    best = float("inf")
+    for _ in range(2):  # best of two windows (see module docstring)
+        t0 = time.time()
+        sync(looped(q, k, v))
+        best = min(best, time.time() - t0)
+    # The ~0.7 s sync constant is a property of the axon tunnel; a
+    # directly attached chip has none.
+    overhead = 0.7 if jax.default_backend() == "axon" else 0.0
+    per_iter = max(best - overhead, 1e-9) / N
+    _emit("flash_attention_tflops", flops / per_iter / 1e12, "TFLOP/s",
+          BASELINE_ATTENTION_TFLOPS)
+
+
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
                                         image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
@@ -320,7 +369,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="trials",
                         choices=["trials", "serving", "multitenant",
-                                 "densenet", "enas"])
+                                 "densenet", "enas", "attention"])
     args = parser.parse_args()
 
     # The TPU sitecustomize imports jax at interpreter startup, latching
@@ -333,4 +382,4 @@ if __name__ == "__main__":
 
     {"trials": main, "serving": main_serving,
      "multitenant": main_multitenant, "densenet": main_densenet,
-     "enas": main_enas}[args.config]()
+     "enas": main_enas, "attention": main_attention}[args.config]()

@@ -280,6 +280,21 @@ class Admin:
     def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
         return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
 
+    def get_status(self) -> Dict[str, Any]:
+        """Node status for operators: chip allocation + live services."""
+        alloc = self.services.allocator
+        running = self.meta.get_services(status="RUNNING")
+        by_type: Dict[str, int] = {}
+        for s in running:
+            by_type[s["service_type"]] = by_type.get(s["service_type"],
+                                                     0) + 1
+        return {
+            "n_chips": alloc.n_chips,
+            "free_chips": alloc.free_chips,
+            "chip_allocation": round(alloc.utilization(), 4),
+            "services_running": by_type,
+        }
+
     # --- User administration (ADMIN-only; enforced by the REST layer) ---
 
     def get_users(self) -> List[Dict[str, Any]]:

@@ -55,6 +55,7 @@ class AdminApp:
              self._stop_inference_job),
             ("GET", "/users", self._list_users),
             ("POST", "/users/<user_id>/ban", self._ban_user),
+            ("GET", "/status", self._status),
         ], host=host, port=port, name="admin")
         self.host = self._http.host
         self.port = self._http.port
@@ -176,6 +177,10 @@ class AdminApp:
     def _list_inference_jobs(self, params, body, ctx):
         claims = self._auth(ctx)
         return 200, self.admin.get_inference_jobs(claims["user_id"])
+
+    def _status(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_status()
 
     def _list_users(self, params, body, ctx):
         self._auth(ctx, UserType.SUPERADMIN, UserType.ADMIN)

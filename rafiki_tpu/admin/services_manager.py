@@ -245,12 +245,15 @@ class ServicesManager:
             except Exception:
                 # Roll back everything: this holder, holders not yet
                 # launched, and workers already launched for this job.
+                launched_ids = {s["id"] for s in services}
                 for h in grabbed:
-                    if h["row"]["id"] not in {s["id"] for s in services}:
-                        self.allocator.release(
-                            self._alloc_name(h["row"]["id"]))
-                self.meta.update_service(svc_row["id"],
-                                         status=ServiceStatus.ERRORED)
+                    hid = h["row"]["id"]
+                    if hid in launched_ids:
+                        continue
+                    self.allocator.release(self._alloc_name(hid))
+                    self.meta.update_service(
+                        hid, status=ServiceStatus.ERRORED
+                        if hid == svc_row["id"] else ServiceStatus.STOPPED)
                 for launched in services:
                     self._stop_service(launched["id"])
                 raise

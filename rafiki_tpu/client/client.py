@@ -145,6 +145,10 @@ class Client:
     def get_inference_jobs(self) -> List[Dict[str, Any]]:
         return self._call("GET", "/inference_jobs")
 
+    def get_status(self) -> Dict[str, Any]:
+        """Node status: chips total/free, allocation, running services."""
+        return self._call("GET", "/status")
+
     def get_users(self) -> List[Dict[str, Any]]:
         """Admin-only: list users with their type and ban state."""
         return self._call("GET", "/users")

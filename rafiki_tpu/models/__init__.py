@@ -1,5 +1,6 @@
 """Bundled model zoo (SURVEY.md §2 "Example models")."""
 
+from .cnn import JaxCnn
 from .densenet import JaxDenseNet
 from .enas import JaxEnas
 from .feedforward import JaxFeedForward
@@ -8,6 +9,6 @@ from .sk import SkDt, SkSvm
 from .tabular import JaxTabMlpClf, JaxTabMlpReg
 from .transformer import JaxTransformerTagger
 
-__all__ = ["JaxFeedForward", "JaxDenseNet", "JaxEnas", "JaxPosTagger",
-           "SkDt", "SkSvm", "JaxTabMlpClf", "JaxTabMlpReg",
-           "JaxTransformerTagger"]
+__all__ = ["JaxFeedForward", "JaxCnn", "JaxDenseNet", "JaxEnas",
+           "JaxPosTagger", "SkDt", "SkSvm", "JaxTabMlpClf",
+           "JaxTabMlpReg", "JaxTransformerTagger"]

@@ -83,6 +83,17 @@ def test_user_admin_routes(http_platform):
     assert e.value.status == 403
 
 
+def test_status_route(http_platform):
+    from rafiki_tpu.client import Client
+
+    client = Client(admin_port=http_platform.app.port)
+    client.login("superadmin@rafiki", "rafiki")
+    s = client.get_status()
+    assert s["n_chips"] >= 1
+    assert 0.0 <= s["chip_allocation"] <= 1.0
+    assert isinstance(s["services_running"], dict)
+
+
 def test_inference_jobs_listing(http_platform, synth_image_data):
     from rafiki_tpu.client import Client
     from rafiki_tpu.constants import BudgetOption, TaskType
