@@ -335,9 +335,12 @@ def main_attention() -> None:
         qq, _ = jax.lax.scan(body, q, None, length=N)
         return qq
 
+    # One jitted probe reused across windows: a fresh lambda per sync
+    # would recompile inside the timed interval.
+    probe = jax.jit(lambda x: x.reshape(-1)[:1].astype(jnp.float32))
+
     def sync(o):
-        return np.asarray(jax.jit(
-            lambda x: x.reshape(-1)[:1].astype(jnp.float32))(o))
+        return np.asarray(probe(o))
 
     sync(looped(q, k, v))  # compile + warm
     best = float("inf")

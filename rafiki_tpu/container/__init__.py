@@ -12,9 +12,10 @@ deployments; a docker/K8s manager can implement the same interface
 unchanged.
 """
 
-from .manager import (ContainerManager, ProcessContainerManager,
-                      ThreadContainerManager)
+from .manager import (ContainerManager, DockerContainerManager,
+                      ProcessContainerManager, ThreadContainerManager)
 from .services import SystemContext, build_service
 
 __all__ = ["ContainerManager", "ThreadContainerManager",
-           "ProcessContainerManager", "SystemContext", "build_service"]
+           "ProcessContainerManager", "DockerContainerManager",
+           "SystemContext", "build_service"]

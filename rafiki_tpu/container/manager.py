@@ -113,3 +113,82 @@ class ProcessContainerManager(ContainerManager):
         with self._lock:
             proc = self._procs.get(container_id)
         return proc is not None and proc.poll() is None
+
+
+class DockerContainerManager(ContainerManager):
+    """Docker runtime: one container per service, via the docker CLI.
+
+    Parity: SURVEY.md §2 "Container manager" (upstream
+    ``DockerSwarmContainerManager`` schedules worker/predictor services
+    as swarm services with env + GPU reservations). Here each service
+    runs the node image (``dockerfiles/node.Dockerfile``) with the
+    service env injected and the generic service entrypoint
+    (``rafiki_tpu.container.services``); chip assignment rides the
+    ``RAFIKI_TPU_CHIPS`` env var exactly as in the other runtimes — no
+    nvidia-docker anywhere. Host networking by default so bus/admin
+    ports behave like the process runtime.
+
+    The docker CLI is invoked through an injectable ``runner`` (tests
+    use a fake; no docker SDK dependency).
+    """
+
+    def __init__(self, image: str = "rafiki-tpu", network: str = "host",
+                 extra_args: Optional[list] = None,
+                 volumes: Optional[list] = None, runner=None):
+        self.image = image
+        self.network = network
+        self.extra_args = list(extra_args or [])
+        self.volumes = list(volumes or [])
+        self._run = runner or self._run_docker
+
+    @staticmethod
+    def _run_docker(args: list) -> str:
+        out = subprocess.run(["docker", *args], check=True,
+                             capture_output=True, text=True)
+        return out.stdout.strip()
+
+    @staticmethod
+    def _auto_mounts(environ: Dict[str, str]) -> list:
+        """The file-backed stores the env URIs point at must exist
+        INSIDE the container: mount them host-path = container-path so
+        the env values stay valid verbatim."""
+        from ..constants import EnvVars
+
+        mounts = []
+        meta = environ.get(EnvVars.META_URI, "")
+        if meta and meta != ":memory:" and "://" not in meta:
+            parent = os.path.dirname(os.path.abspath(meta))
+            if parent and parent != "/":
+                mounts.append(parent)
+        params = environ.get(EnvVars.PARAMS_DIR, "")
+        if params:
+            mounts.append(os.path.abspath(params))
+        return mounts
+
+    def create_service(self, service_id: str, environ: Dict[str, str]) -> str:
+        args = ["run", "-d", "--name", f"rafiki-{service_id[:12]}",
+                "--network", self.network]
+        for key, value in environ.items():
+            args += ["-e", f"{key}={value}"]
+        for mount in self._auto_mounts(environ) + self.volumes:
+            spec = mount if ":" in mount else f"{mount}:{mount}"
+            args += ["-v", spec]
+        args += self.extra_args
+        args += [self.image, "python", "-m",
+                 "rafiki_tpu.container.services"]
+        return self._run(args)  # stdout = container id
+
+    def destroy_service(self, container_id: str) -> None:
+        try:
+            self._run(["rm", "-f", container_id])
+        except subprocess.CalledProcessError:
+            _log.warning("docker rm -f %s failed", container_id,
+                         exc_info=True)
+
+    def service_alive(self, container_id: str) -> bool:
+        try:
+            out = self._run(["inspect", "-f", "{{.State.Running}}",
+                             container_id])
+        except subprocess.CalledProcessError:
+            return False
+        return out.strip() == "true"

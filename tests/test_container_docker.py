@@ -1,0 +1,78 @@
+"""DockerContainerManager: CLI invocations via an injected fake runner."""
+
+import subprocess
+
+import pytest
+
+from rafiki_tpu.container import DockerContainerManager
+
+
+class FakeDocker:
+    def __init__(self):
+        self.calls = []
+        self.running = {}
+
+    def __call__(self, args):
+        self.calls.append(args)
+        if args[0] == "run":
+            cid = f"cid{len(self.running)}"
+            self.running[cid] = True
+            return cid
+        if args[0] == "rm":
+            self.running.pop(args[-1], None)
+            return ""
+        if args[0] == "inspect":
+            cid = args[-1]
+            if cid not in self.running:
+                raise subprocess.CalledProcessError(1, ["docker"])
+            return "true"
+        raise AssertionError(args)
+
+
+def test_service_lifecycle():
+    fake = FakeDocker()
+    mgr = DockerContainerManager(image="rafiki-tpu:test", runner=fake)
+    cid = mgr.create_service("svc0123456789abc", {
+        "RAFIKI_TPU_SERVICE_TYPE": "TRAIN", "RAFIKI_TPU_CHIPS": "0,1"})
+    run = fake.calls[0]
+    assert run[0] == "run" and "-d" in run
+    assert "--network" in run and "host" in run
+    assert "-e" in run
+    assert "RAFIKI_TPU_CHIPS=0,1" in run
+    assert run[-3:] == ["python", "-m", "rafiki_tpu.container.services"]
+    assert "rafiki-tpu:test" in run
+
+    assert mgr.service_alive(cid)
+    mgr.destroy_service(cid)
+    assert not mgr.service_alive(cid)
+
+
+def test_file_backed_stores_are_mounted():
+    fake = FakeDocker()
+    mgr = DockerContainerManager(runner=fake, volumes=["/data:/data:ro"])
+    mgr.create_service("s" * 16, {
+        "RAFIKI_TPU_META_URI": "/var/rafiki/meta.db",
+        "RAFIKI_TPU_PARAMS_DIR": "/var/rafiki/params"})
+    run = fake.calls[0]
+    # env paths stay valid inside the container: host-path = container-path
+    assert "-v" in run
+    assert "/var/rafiki:/var/rafiki" in run
+    assert "/var/rafiki/params:/var/rafiki/params" in run
+    assert "/data:/data:ro" in run
+
+    # :memory: / URI-style stores need no mount
+    fake2 = FakeDocker()
+    DockerContainerManager(runner=fake2).create_service("s" * 16, {
+        "RAFIKI_TPU_META_URI": ":memory:",
+        "RAFIKI_TPU_BUS_URI": "tcp://host:7777"})
+    assert "-v" not in fake2.calls[0]
+
+
+def test_extra_args_and_missing_container():
+    fake = FakeDocker()
+    mgr = DockerContainerManager(runner=fake,
+                                 extra_args=["--privileged"])
+    cid = mgr.create_service("s" * 16, {})
+    assert "--privileged" in fake.calls[0]
+    assert not mgr.service_alive("nope")
+    mgr.destroy_service("nope")  # logged, no raise
