@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..constants import ParamsType
 from ..model.knobs import CategoricalKnob, IntegerKnob, KnobConfig, Knobs
 from .base import BaseAdvisor, Proposal
 
@@ -108,6 +109,23 @@ class AshaAdvisor(BaseAdvisor):
                     self._promoted[rung].add(cid)
                     return cid, rung + 1
         return None
+
+    def _params_type(self, trial_no: int) -> str:
+        # Promotions warm-start from their OWN configuration's latest
+        # saved parameters (rung r's weights); new rung-0 configs cold
+        # start. The per-config isolation comes from params_scope below.
+        entry = self._pending.get(trial_no)
+        if entry is not None and entry[1] > 0:
+            return ParamsType.LOCAL_RECENT
+        return ParamsType.NONE
+
+    def _decorate(self, proposal: Proposal) -> None:
+        entry = self._pending.get(proposal.trial_no)
+        if entry is not None:
+            # The TrialRunner saves AND retrieves this trial's params
+            # under the config-scoped key, so LOCAL_RECENT means "this
+            # configuration's most recent weights", not "this worker's".
+            proposal.meta["params_scope"] = f"asha-cfg-{entry[0]}"
 
     def _observe(self, proposal: Proposal, score: float) -> None:
         entry = self._pending.pop(proposal.trial_no, None)

@@ -75,8 +75,11 @@ class BaseAdvisor:
             self._trial_no += 1
             knobs = self._propose_knobs(self._trial_no)
             knobs = self._fill_policies(knobs, self._trial_no)
-            return Proposal(trial_no=self._trial_no, knobs=knobs,
-                            params_type=self._params_type(self._trial_no))
+            proposal = Proposal(trial_no=self._trial_no, knobs=knobs,
+                                params_type=self._params_type(
+                                    self._trial_no))
+            self._decorate(proposal)
+            return proposal
 
     def feedback(self, proposal: Proposal, score: float) -> None:
         with self._lock:
@@ -115,6 +118,10 @@ class BaseAdvisor:
 
     def _params_type(self, trial_no: int) -> str:
         return ParamsType.NONE
+
+    def _decorate(self, proposal: Proposal) -> None:
+        """Attach strategy metadata to an outgoing proposal (e.g. a
+        ``params_scope`` for scoped warm-starts); called under the lock."""
 
     def _fill_policies(self, knobs: Knobs, trial_no: int) -> Knobs:
         """Default policy activation: all off. Strategies override."""

@@ -148,30 +148,52 @@ class DockerContainerManager(ContainerManager):
         return out.stdout.strip()
 
     @staticmethod
+    def _normalize_store_env(environ: Dict[str, str]) -> Dict[str, str]:
+        """Absolutise file-backed store paths: a relative META_URI /
+        PARAMS_DIR would resolve against the image's own workdir inside
+        the container and silently diverge from the host store."""
+        from ..constants import EnvVars
+
+        env = dict(environ)
+        meta = env.get(EnvVars.META_URI, "")
+        if meta and meta != ":memory:" and "://" not in meta:
+            env[EnvVars.META_URI] = os.path.abspath(meta)
+        params = env.get(EnvVars.PARAMS_DIR, "")
+        if params:
+            env[EnvVars.PARAMS_DIR] = os.path.abspath(params)
+        return env
+
+    @staticmethod
     def _auto_mounts(environ: Dict[str, str]) -> list:
         """The file-backed stores the env URIs point at must exist
         INSIDE the container: mount them host-path = container-path so
-        the env values stay valid verbatim."""
+        the (absolutised) env values stay valid verbatim."""
         from ..constants import EnvVars
 
         mounts = []
         meta = environ.get(EnvVars.META_URI, "")
         if meta and meta != ":memory:" and "://" not in meta:
-            parent = os.path.dirname(os.path.abspath(meta))
+            parent = os.path.dirname(meta)
             if parent and parent != "/":
                 mounts.append(parent)
         params = environ.get(EnvVars.PARAMS_DIR, "")
         if params:
-            mounts.append(os.path.abspath(params))
+            mounts.append(params)
         return mounts
 
     def create_service(self, service_id: str, environ: Dict[str, str]) -> str:
+        environ = self._normalize_store_env(environ)
         args = ["run", "-d", "--name", f"rafiki-{service_id[:12]}",
                 "--network", self.network]
         for key, value in environ.items():
             args += ["-e", f"{key}={value}"]
+        seen_targets = set()  # docker rejects duplicate mount points
         for mount in self._auto_mounts(environ) + self.volumes:
             spec = mount if ":" in mount else f"{mount}:{mount}"
+            target = spec.split(":")[1]
+            if target in seen_targets:
+                continue
+            seen_targets.add(target)
             args += ["-v", spec]
         args += self.extra_args
         args += [self.image, "python", "-m",
@@ -189,6 +211,15 @@ class DockerContainerManager(ContainerManager):
         try:
             out = self._run(["inspect", "-f", "{{.State.Running}}",
                              container_id])
-        except subprocess.CalledProcessError:
-            return False
+        except subprocess.CalledProcessError as e:
+            # Only a definitive "the container is gone" counts as dead.
+            # Any other CLI failure (daemon restarting, socket blip) must
+            # NOT read as death: the supervisor would tear down healthy
+            # services and double-schedule their chip ranges.
+            stderr = (e.stderr or "") if hasattr(e, "stderr") else ""
+            if "No such" in stderr:
+                return False
+            _log.warning("docker inspect %s failed transiently; assuming "
+                         "alive", container_id)
+            return True
         return out.strip() == "true"

@@ -128,9 +128,14 @@ class TrialRunner:
                         self.meta.add_trial_log(_tid, rec))
         t0 = time.time()
         try:
+            # A proposal may scope its params to a strategy-defined key
+            # (ASHA promotions: per-configuration warm-starts) instead
+            # of this worker's identity.
+            params_scope = proposal.meta.get("params_scope") \
+                or self.worker_id
             shared = self.params.retrieve(
                 proposal.params_type, session_id=self.sub_train_job_id,
-                worker_id=self.worker_id)
+                worker_id=params_scope)
             model = self.model_class(**knobs)
             # Opt-in mid-trial checkpointing (RAFIKI_TPU_CKPT=1): the dir
             # is keyed by (sub_train_job, knobs), not trial id, so the
@@ -149,7 +154,7 @@ class TrialRunner:
                 params_id = self.params.save(
                     model.dump_parameters(),
                     session_id=self.sub_train_job_id,
-                    worker_id=self.worker_id, score=score)
+                    worker_id=params_scope, score=score)
             finally:
                 model.destroy()
             self.meta.mark_trial_completed(trial_id, score, params_id)

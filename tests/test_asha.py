@@ -103,6 +103,61 @@ def test_registry_selects_asha():
     assert adv.propose() is None  # budget enforced
 
 
+def test_promotions_warm_start_from_own_config(tmp_path):
+    """A promoted trial must receive ITS configuration's rung-r weights
+    as shared params; rung-0 trials cold start."""
+    from rafiki_tpu.constants import BudgetOption
+    from rafiki_tpu.model.base import BaseModel
+    from rafiki_tpu.store import MetaStore, ParamStore
+    from rafiki_tpu.worker.runner import TrialRunner
+
+    received = []  # (width, shared-params marker or None)
+
+    class FakeModel(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return CONFIG
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._params = {}
+
+        def train(self, path, *, shared_params=None, **kw):
+            marker = (None if shared_params is None
+                      else float(np.asarray(
+                          shared_params["marker"]).reshape(-1)[0]))
+            received.append((self.knobs["width"], marker))
+            self._params = {"marker":
+                            np.asarray(float(self.knobs["width"]))}
+
+        def evaluate(self, path):
+            return self.knobs["width"] / 64.0  # wider = better
+
+        def predict(self, queries):
+            return [0 for _ in queries]
+
+        def dump_parameters(self):
+            return dict(self._params)
+
+        def load_parameters(self, params):
+            self._params = dict(params)
+
+    adv = AshaAdvisor(CONFIG, seed=3, eta=3, total_trials=10)
+    runner = TrialRunner(FakeModel, adv, "tr", "va", MetaStore(":memory:"),
+                         ParamStore(str(tmp_path / "p")),
+                         sub_train_job_id="asha-warm",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 10})
+    runner.run()
+
+    rung0 = [r for r in received if r[1] is None]
+    promotions = [r for r in received if r[1] is not None]
+    assert promotions, "no promotion ever warm-started"
+    for width, marker in promotions:
+        # the warm-start came from the SAME config's earlier params
+        assert marker == float(width)
+    assert len(rung0) + len(promotions) == len(received)
+
+
 def test_asha_through_platform(tmp_path, synth_image_data):
     """End-to-end: a train job with advisor_type=asha schedules rung-0
     budgets through real workers."""

@@ -11,6 +11,7 @@ class FakeDocker:
     def __init__(self):
         self.calls = []
         self.running = {}
+        self.daemon_down = False
 
     def __call__(self, args):
         self.calls.append(args)
@@ -23,8 +24,13 @@ class FakeDocker:
             return ""
         if args[0] == "inspect":
             cid = args[-1]
+            if self.daemon_down:
+                raise subprocess.CalledProcessError(
+                    1, ["docker"],
+                    stderr="Cannot connect to the Docker daemon")
             if cid not in self.running:
-                raise subprocess.CalledProcessError(1, ["docker"])
+                raise subprocess.CalledProcessError(
+                    1, ["docker"], stderr=f"No such object: {cid}")
             return "true"
         raise AssertionError(args)
 
@@ -66,6 +72,42 @@ def test_file_backed_stores_are_mounted():
         "RAFIKI_TPU_META_URI": ":memory:",
         "RAFIKI_TPU_BUS_URI": "tcp://host:7777"})
     assert "-v" not in fake2.calls[0]
+
+
+def test_transient_daemon_failure_is_not_death():
+    fake = FakeDocker()
+    mgr = DockerContainerManager(runner=fake)
+    cid = mgr.create_service("s" * 16, {})
+    fake.daemon_down = True
+    # A daemon blip must NOT read as container death (the supervisor
+    # would tear down healthy services).
+    assert mgr.service_alive(cid)
+    fake.daemon_down = False
+    assert mgr.service_alive(cid)
+
+
+def test_mounts_deduped_and_relative_paths_absolutised(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    fake = FakeDocker()
+    mgr = DockerContainerManager(runner=fake)
+    # params dir IS the meta db's parent: one mount, not two.
+    mgr.create_service("s" * 16, {
+        "RAFIKI_TPU_META_URI": "/data/rafiki/meta.db",
+        "RAFIKI_TPU_PARAMS_DIR": "/data/rafiki"})
+    run = fake.calls[0]
+    assert run.count("/data/rafiki:/data/rafiki") == 1
+
+    # relative store paths are rewritten to abspaths in the env.
+    fake2 = FakeDocker()
+    DockerContainerManager(runner=fake2).create_service("s" * 16, {
+        "RAFIKI_TPU_META_URI": "rafiki/meta.db",
+        "RAFIKI_TPU_PARAMS_DIR": "rafiki/params"})
+    run2 = fake2.calls[0]
+    meta_abs = str(tmp_path / "rafiki" / "meta.db")
+    params_abs = str(tmp_path / "rafiki" / "params")
+    assert f"RAFIKI_TPU_META_URI={meta_abs}" in run2
+    assert f"RAFIKI_TPU_PARAMS_DIR={params_abs}" in run2
+    assert f"{params_abs}:{params_abs}" in run2
 
 
 def test_extra_args_and_missing_container():
