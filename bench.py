@@ -35,6 +35,7 @@ import numpy as np
 # (vs_baseline = 1.0).
 BASELINE_TRIALS_PER_HOUR = 268.0
 BASELINE_SERVING_QPS = 1097.0
+BASELINE_OPENLOOP_QPS = None  # first TPU run establishes it
 BASELINE_MT_TRIALS_PER_HOUR = None  # needs >= 2 chips; no TPU figure yet
 BASELINE_DENSENET_IMAGES_PER_SEC = 1504.0
 BASELINE_ENAS_TRIALS_PER_HOUR = 254.1
@@ -46,6 +47,39 @@ N_TRIALS = 3
 N_TRAIN, N_VAL = 4096, 512
 IMAGE_SHAPE = (28, 28, 1)
 N_CLASSES = 10
+
+
+class _UtilProbe:
+    """Captures ``chip_util`` records the models log (the MfuMeter →
+    TrialLog path) so bench rows report the north-star utilization
+    (BASELINE.json: ≥90% during train) alongside throughput."""
+
+    def __init__(self):
+        self.values = []
+
+    def __enter__(self) -> "_UtilProbe":
+        from rafiki_tpu.model.logger import logger
+
+        self._logger = logger
+        logger.set_sink(self._collect)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._logger.set_sink(None)
+
+    def _collect(self, rec) -> None:
+        util = (rec.get("values") or {}).get("chip_util")
+        if util is not None:
+            self.values.append(float(util))
+
+    def fields(self) -> dict:
+        if not self.values:
+            return {}
+        # Mean over the run is the defensible sustained-utilization
+        # statistic (a single 90% epoch must not read as the north star
+        # met); the peak rides along for context.
+        return {"chip_util": round(float(np.mean(self.values)), 4),
+                "chip_util_peak": round(max(self.values), 4)}
 
 
 def main() -> None:
@@ -67,21 +101,17 @@ def main() -> None:
         _run_trial(JaxFeedForward, advisor, train_path, val_path)
 
         elapsed = float("inf")
-        for _ in range(2):  # best of two windows (see module docstring)
-            t0 = time.time()
-            for _ in range(N_TRIALS):
-                _run_trial(JaxFeedForward, advisor, train_path, val_path)
-            elapsed = min(elapsed, time.time() - t0)
+        with _UtilProbe() as probe:
+            for _ in range(2):  # best of two windows (module docstring)
+                t0 = time.time()
+                for _ in range(N_TRIALS):
+                    _run_trial(JaxFeedForward, advisor, train_path,
+                               val_path)
+                elapsed = min(elapsed, time.time() - t0)
 
     trials_per_hour = N_TRIALS / (elapsed / 3600.0)
-    vs = (1.0 if BASELINE_TRIALS_PER_HOUR is None
-          else trials_per_hour / BASELINE_TRIALS_PER_HOUR)
-    print(json.dumps({
-        "metric": "automl_trials_per_hour",
-        "value": round(trials_per_hour, 2),
-        "unit": "trials/hour",
-        "vs_baseline": round(vs, 3),
-    }))
+    _emit("automl_trials_per_hour", trials_per_hour, "trials/hour",
+          BASELINE_TRIALS_PER_HOUR, **probe.fields())
 
 
 def _run_trial(model_class, advisor, train_path: str, val_path: str) -> float:
@@ -94,10 +124,14 @@ def _run_trial(model_class, advisor, train_path: str, val_path: str) -> float:
     return score
 
 
-def _emit(metric: str, value: float, unit: str, baseline) -> None:
+def _emit(metric: str, value: float, unit: str, baseline,
+          **extra) -> None:
+    import jax
+
     vs = 1.0 if baseline is None else value / baseline
     print(json.dumps({"metric": metric, "value": round(value, 2),
-                      "unit": unit, "vs_baseline": round(vs, 3)}))
+                      "unit": unit, "vs_baseline": round(vs, 3),
+                      "platform": jax.default_backend(), **extra}))
 
 
 def main_serving() -> None:
@@ -190,6 +224,101 @@ def main_serving() -> None:
           BASELINE_SERVING_QPS)
 
 
+def main_serving_openloop() -> None:
+    """Open-loop serving: ensemble QPS at saturation with request
+    arrival decoupled from completion (VERDICT r1 item 5).
+
+    The closed-loop config[3] cannot show the worker's one-burst-in-
+    flight pipelining: each client waits for its own reply, so the
+    ~0.2-0.7 s per-burst device->host sync on the tunneled TPU gates
+    every client equally. Here ALL bursts are enqueued up front (the
+    queue never starves) and the total drain time is measured — the
+    overlap of burst N's readback with burst N+1's compute is directly
+    visible. Runs twice, pipelining on vs off, and reports both.
+    """
+    import tempfile
+
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.platform import LocalPlatform
+
+    n_bursts, burst = 40, 64
+
+    def measure(platform, user_id, job_id, val_path) -> float:
+        admin = platform.admin
+        inf = admin.create_inference_job(user_id, job_id, max_models=1)
+        cache = Cache(platform.bus)
+        try:
+            # Registration is async (worker loads params + warms the
+            # compile cache first) — poll until it appears.
+            deadline = time.time() + 600
+            workers = cache.running_workers(inf["id"])
+            while not workers and time.time() < deadline:
+                time.sleep(0.5)
+                workers = cache.running_workers(inf["id"])
+            assert workers, "no inference workers registered"
+            val = load_image_dataset(val_path)
+            queries = [encode_payload(val.images[i % val.size])
+                       for i in range(burst)]
+            # Warm-up burst (compile + registration waits).
+            for w in workers:
+                cache.send_query_batch(w, queries, batch_id="warm",
+                                       pre_encoded=True)
+            assert cache.gather_prediction_batches(
+                "warm", len(workers), timeout=600)
+            best = 0.0
+            for _ in range(2):  # best of two windows (module docstring)
+                t0 = time.time()
+                for i in range(n_bursts):  # arrival: all up front
+                    for w in workers:
+                        cache.send_query_batch(w, queries,
+                                               batch_id=f"ol{i}",
+                                               pre_encoded=True)
+                for i in range(n_bursts):
+                    got = cache.gather_prediction_batches(
+                        f"ol{i}", len(workers), timeout=300)
+                    assert len(got) == len(workers), \
+                        f"burst {i}: {len(got)}/{len(workers)} replies"
+                best = max(best, n_bursts * burst / (time.time() - t0))
+            return best
+        finally:
+            admin.stop_inference_job(inf["id"])
+
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256)
+        for mode in ("on", "off"):
+            import os as _os
+
+            _os.environ["RAFIKI_TPU_SERVING_PIPELINE"] = \
+                "1" if mode == "on" else "0"
+            platform = LocalPlatform(workdir=f"{tmp}/plat_{mode}")
+            try:
+                user = platform.admin.create_user(
+                    f"ol-{mode}@x.c", "pw", UserType.MODEL_DEVELOPER)
+                model = platform.admin.create_model(
+                    user["id"], f"ff-{mode}", TaskType.IMAGE_CLASSIFICATION,
+                    "rafiki_tpu.models.feedforward:JaxFeedForward")
+                job = platform.admin.create_train_job(
+                    user["id"], f"ol-{mode}", TaskType.IMAGE_CLASSIFICATION,
+                    [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 1},
+                    train_path, val_path)
+                assert platform.admin.wait_until_train_job_done(
+                    job["id"], timeout=1200)
+                results[mode] = measure(platform, user["id"],
+                                        job["id"], val_path)
+            finally:
+                platform.shutdown()
+            _os.environ.pop("RAFIKI_TPU_SERVING_PIPELINE", None)
+
+    _emit("serving_openloop_qps", results["on"], "queries/s",
+          BASELINE_OPENLOOP_QPS,
+          qps_no_pipeline=round(results["off"], 2),
+          pipeline_speedup=round(results["on"] / results["off"], 3))
+
+
 def main_multitenant() -> None:
     """Config[4]: aggregate trials/hour, two jobs contending for chips."""
     import tempfile
@@ -259,16 +388,17 @@ def main_densenet() -> None:
         warm.destroy()
 
         elapsed = float("inf")
-        for _ in range(2):  # best of two windows (see module docstring)
-            m = JaxDenseNet(**knobs)
-            t0 = time.time()
-            m.train(train_path)
-            elapsed = min(elapsed, time.time() - t0)
-            m.destroy()
+        with _UtilProbe() as probe:
+            for _ in range(2):  # best of two windows (module docstring)
+                m = JaxDenseNet(**knobs)
+                t0 = time.time()
+                m.train(train_path)
+                elapsed = min(elapsed, time.time() - t0)
+                m.destroy()
 
     images = (2048 // batch) * batch * epochs
     _emit("densenet_train_images_per_sec", images / elapsed, "images/s",
-          BASELINE_DENSENET_IMAGES_PER_SEC)
+          BASELINE_DENSENET_IMAGES_PER_SEC, **probe.fields())
 
 
 def main_enas() -> None:
@@ -365,24 +495,56 @@ def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
         n_classes=N_CLASSES)
 
 
+# Metric identity per config, used for the guaranteed-parseable error
+# record when a config cannot run (dead TPU tunnel, missing devices, a
+# crash): the driver must ALWAYS get its one JSON line and rc 0.
+_CONFIGS = {
+    "trials": (main, "automl_trials_per_hour", "trials/hour"),
+    "serving": (main_serving, "ensemble_inference_qps", "queries/s"),
+    "serving-openloop": (main_serving_openloop, "serving_openloop_qps",
+                         "queries/s"),
+    "multitenant": (main_multitenant, "multitenant_trials_per_hour",
+                    "trials/hour"),
+    "densenet": (main_densenet, "densenet_train_images_per_sec",
+                 "images/s"),
+    "enas": (main_enas, "enas_trials_per_hour", "trials/hour"),
+    "attention": (main_attention, "flash_attention_tflops", "TFLOP/s"),
+}
+
+
 if __name__ == "__main__":
     import argparse
-    import os
+    import sys
+    import traceback
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="trials",
-                        choices=["trials", "serving", "multitenant",
-                                 "densenet", "enas", "attention"])
+                        choices=sorted(_CONFIGS))
     args = parser.parse_args()
+    fn, metric, unit = _CONFIGS[args.config]
 
-    # The TPU sitecustomize imports jax at interpreter startup, latching
-    # JAX_PLATFORMS before this script runs; honor a cpu request (used to
-    # bench multi-chip configs on the virtual CPU mesh) via jax.config.
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        import jax
+    # Resolve the platform BEFORE any backend touch. The site hook
+    # latches jax_platforms to the accelerator regardless of
+    # JAX_PLATFORMS=cpu, and a dead tunnel hangs backend init — so this
+    # probes with a deadline and degrades to CPU (round-1 BENCH artifact
+    # was rc 1 for exactly this reason).
+    try:
+        from rafiki_tpu.jaxenv import ensure_platform
 
-        jax.config.update("jax_platforms", "cpu")
+        platform = ensure_platform()
+    except Exception:
+        platform = "unknown"
 
-    {"trials": main, "serving": main_serving,
-     "multitenant": main_multitenant, "densenet": main_densenet,
-     "enas": main_enas, "attention": main_attention}[args.config]()
+    try:
+        fn()
+    except SystemExit as e:
+        if e.code in (0, None):
+            raise
+        print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                          "vs_baseline": 0.0, "platform": platform,
+                          "error": str(e)}))
+    except Exception as e:
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                          "vs_baseline": 0.0, "platform": platform,
+                          "error": f"{type(e).__name__}: {e}"}))

@@ -64,4 +64,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    from rafiki_tpu.jaxenv import ensure_platform
+
+    # Resolve the JAX platform up front: honors JAX_PLATFORMS=cpu (the
+    # site hook's config latch otherwise ignores it) and falls back to
+    # CPU instead of hanging when the TPU tunnel is unreachable.
+    ensure_platform()
     main()

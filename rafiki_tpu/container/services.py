@@ -95,6 +95,12 @@ def main() -> None:
     import os
     import signal
 
+    from ..jaxenv import ensure_platform
+
+    # Honor the platform the parent node resolved (or JAX_PLATFORMS=cpu)
+    # before any backend touch — the site hook's latch would otherwise
+    # send this child to the accelerator even when it is unreachable.
+    ensure_platform()
     service = build_service(dict(os.environ))
     stop = getattr(service, "stop", None)
     if stop is not None:

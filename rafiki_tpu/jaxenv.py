@@ -1,0 +1,255 @@
+"""JAX platform resolution that survives the tunneled-TPU environment.
+
+Parity: SURVEY.md §1 L0 ("TPU rebuild mapping") — the reference assumes
+CUDA is either present or absent at process start; here the accelerator is
+a PJRT plugin reached through a network tunnel that can be *registered but
+unreachable*. Two environment facts drive this module's design (both
+verified against the deployed ``sitecustomize``/``axon.register`` pair):
+
+1. The interpreter's site hook calls ``axon.register.register()`` at
+   startup, which unconditionally runs
+   ``jax.config.update("jax_platforms", "axon,cpu")`` — the
+   ``JAX_PLATFORMS`` *environment variable* is latched before user code
+   runs and has NO further effect. A child process spawned with
+   ``JAX_PLATFORMS=cpu`` still tries the accelerator first.
+2. When the tunnel is down, accelerator backend initialization HANGS
+   (blocks on the dead link) rather than raising — so "try it and catch"
+   is not a viable fallback; the only safe probe is a subprocess with a
+   deadline.
+
+``ensure_platform()`` is therefore the mandatory first call of every
+entry point that may run as a subprocess (serve CLI, bench.py, example
+scripts, ``__graft_entry__``): it re-applies the caller's platform intent
+via ``jax.config.update`` *before* the first backend touch, probing the
+accelerator out-of-process when the intent is "use the TPU if it is
+actually alive".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+_log = logging.getLogger(__name__)
+
+# Resolved-platform marker, set in os.environ after the first
+# resolution. A "cpu" verdict IS inherited by children (``_pin_cpu``
+# also pins JAX_PLATFORMS=cpu, which they honor without probing); an
+# accelerator verdict is informational only — children that want the
+# accelerator re-probe, because the tunnel can die mid-session and a
+# stale positive would hang them at backend init.
+RESOLVED_ENV = "RAFIKI_TPU_PLATFORM"
+PROBE_TIMEOUT_ENV = "RAFIKI_TPU_PROBE_TIMEOUT"
+_DEFAULT_PROBE_TIMEOUT = 60.0
+
+_lock = threading.Lock()
+_probe_cache: Optional[bool] = None
+# Platform this PROCESS resolved via ensure_platform (None = never
+# called here). Unlike the inherited env marker, this is fresh evidence:
+# the probe (or pin) happened within this process's lifetime.
+_resolved_here: Optional[str] = None
+
+
+def resolved_platform() -> Optional[str]:
+    """The platform ensure_platform resolved in THIS process, if any."""
+    return _resolved_here
+
+
+def backend_initialized() -> bool:
+    """True once any XLA backend exists (platform can no longer change)."""
+    from jax._src import xla_bridge
+
+    try:
+        return xla_bridge.backends_are_initialized()
+    except AttributeError:  # older jax
+        return bool(xla_bridge._backends)
+
+
+def accel_platform() -> str:
+    """The accelerator PJRT platform name this environment registers."""
+    env = os.environ.get("JAX_PLATFORMS", "")
+    for name in env.split(","):
+        name = name.strip()
+        if name and name != "cpu":
+            return name
+    return "axon"
+
+
+def probe_accelerator(timeout: Optional[float] = None) -> bool:
+    """Can the accelerator backend actually initialize? Subprocess probe.
+
+    The probe child inherits the site hook (so the plugin registers the
+    same way), asks for the accelerator *alone* (no cpu fallback masking
+    a dead tunnel), and must enumerate devices within ``timeout``. A
+    hang, crash, or zero devices all mean "not usable". Result is cached
+    per-process only (see the RESOLVED_ENV note above for why children
+    re-probe).
+    """
+    global _probe_cache
+    with _lock:
+        if _probe_cache is not None:
+            return _probe_cache
+        # NOTE: an inherited RAFIKI_TPU_PLATFORM is deliberately NOT
+        # used as a probe verdict in either direction: "cpu" is an
+        # operator preference (ensure_platform honors it before ever
+        # probing), and a parent's accelerator sighting may be stale —
+        # the tunnel can die mid-session (it did in round 1), and a
+        # child trusting the old verdict would hang at backend init,
+        # defeating the deadline this probe exists to provide. Each
+        # process that actually wants the accelerator pays one probe.
+        if timeout is None:
+            timeout = float(os.environ.get(PROBE_TIMEOUT_ENV,
+                                           _DEFAULT_PROBE_TIMEOUT))
+        code = (
+            "import jax\n"
+            f"jax.config.update('jax_platforms', {accel_platform()!r})\n"
+            "ds = jax.devices()\n"
+            "print('RAFIKI_PROBE', len(ds))\n")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout, start_new_session=True)
+            # The child inherits the site hook, which may print its own
+            # lines — scan for the sentinel instead of trusting stdout
+            # to be clean.
+            n_devices = 0
+            for line in r.stdout.splitlines():
+                if line.startswith("RAFIKI_PROBE "):
+                    n_devices = int(line.split()[1])
+            ok = r.returncode == 0 and n_devices > 0
+        except (subprocess.TimeoutExpired, subprocess.SubprocessError,
+                OSError, ValueError):
+            ok = False
+        _probe_cache = ok
+        if not ok:
+            _log.warning("accelerator %r unreachable (probe timeout %.0fs);"
+                         " falling back to CPU", accel_platform(), timeout)
+        return ok
+
+
+def _ensure_virtual_devices(n: int) -> None:
+    """Make the CPU backend expose >= n devices (must precede init)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _pin_cpu(n_virtual_devices: Optional[int]) -> str:
+    import jax
+
+    if n_virtual_devices:
+        _ensure_virtual_devices(n_virtual_devices)
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ[RESOLVED_ENV] = "cpu"
+    return "cpu"
+
+
+def force_cpu_device_count(n: int) -> None:
+    """Re-initialize onto a CPU backend with exactly ``n`` devices.
+
+    Unlike :func:`ensure_platform`, this works even after a backend was
+    initialized (e.g. ``entry()`` ran on a 1-device backend and the
+    driver then wants an 8-device dry run in the same process): it
+    clears the live backends so the next ``jax.devices()`` re-reads the
+    updated ``XLA_FLAGS``. Arrays created on the old backend remain
+    readable but must not be mixed into new computations.
+    """
+    import re
+
+    import jax
+
+    # XLA_FLAGS is parsed once per process, so mutating it cannot resize
+    # a live backend — but keep it in sync for spawned children.
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    if backend_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    # jax_num_cpu_devices IS re-read on the next backend construction.
+    jax.config.update("jax_num_cpu_devices", n)
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ[RESOLVED_ENV] = "cpu"
+
+
+def ensure_platform(prefer: Optional[str] = None, *,
+                    n_virtual_devices: Optional[int] = None,
+                    probe_timeout: Optional[float] = None) -> str:
+    """Pin the JAX platform before backend init; returns the platform.
+
+    ``prefer``:
+      - ``"cpu"`` — force the CPU backend (beats the site hook's latch).
+      - ``"accel"`` / the accelerator name — require the accelerator;
+        raises RuntimeError if the probe says it is unreachable.
+      - ``None`` (default) — honor ``JAX_PLATFORMS`` if it asks for pure
+        cpu; otherwise use the accelerator when the probe succeeds and
+        fall back to cpu when it does not.
+
+    ``n_virtual_devices``: for cpu runs, size the virtual device pool
+    (sharding tests / multi-chip dry runs). No-op if ``XLA_FLAGS``
+    already pins a count or the backend is live.
+
+    Idempotent; safe to call from every entry point. If a backend is
+    already initialized the platform cannot change — the current backend
+    is returned (with a log line when it contradicts ``prefer``).
+    """
+    import jax
+
+    accel = accel_platform()
+    if prefer == "accel":
+        prefer = accel
+
+    global _resolved_here
+    if backend_initialized():
+        current = jax.default_backend()
+        want_cpu = prefer == "cpu" or (
+            prefer is None and os.environ.get("JAX_PLATFORMS") == "cpu")
+        if (want_cpu and current != "cpu") or (
+                prefer not in (None, "cpu", current)
+                and not (prefer == accel and current in ("tpu", accel))):
+            _log.warning("backend already initialized on %r; cannot switch "
+                         "to %r", current, prefer or "auto")
+        _resolved_here = current
+        return current
+
+    # An explicit pure-cpu JAX_PLATFORMS wins over an inherited
+    # RAFIKI_TPU_PLATFORM verdict: the operator's request is newer than
+    # the parent's resolution.
+    env_request = os.environ.get("JAX_PLATFORMS", "")
+    if prefer is None and env_request:
+        names = {p.strip() for p in env_request.split(",") if p.strip()}
+        if names == {"cpu"}:
+            prefer = "cpu"
+
+    if prefer == "cpu":
+        _resolved_here = "cpu"
+        return _pin_cpu(n_virtual_devices)
+
+    alive = probe_accelerator(timeout=probe_timeout)
+    if not alive:
+        if prefer == accel:
+            raise RuntimeError(
+                f"accelerator {accel!r} required but unreachable "
+                f"(probe timed out / failed)")
+        _resolved_here = "cpu"
+        return _pin_cpu(n_virtual_devices)
+
+    # Accelerator alive: keep the registered "<accel>,cpu" ordering the
+    # site hook latched (cpu stays available for host-side arrays).
+    jax.config.update("jax_platforms", f"{accel},cpu")
+    os.environ[RESOLVED_ENV] = accel
+    _resolved_here = accel
+    return accel

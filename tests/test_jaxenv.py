@@ -1,0 +1,142 @@
+"""Platform-resolution guard (rafiki_tpu.jaxenv).
+
+The environment's site hook latches ``jax_platforms`` to the accelerator
+at interpreter startup regardless of ``JAX_PLATFORMS`` — and a dead
+accelerator tunnel *hangs* backend init rather than raising. These tests
+pin the guard's contract: env intent is honored, the fallback never
+blocks, and the verdict is inherited by children.
+"""
+
+import os
+import subprocess
+import sys
+
+from rafiki_tpu import jaxenv
+
+TIMEOUT = 120
+
+
+def _child(code: str, **env_overrides) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop(jaxenv.RESOLVED_ENV, None)
+    env.update(env_overrides)
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=TIMEOUT)
+
+
+def test_accel_platform_parsing(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert jaxenv.accel_platform() == "axon"
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert jaxenv.accel_platform() == "axon"  # default accel name
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert jaxenv.accel_platform() == "tpu"
+
+
+def test_initialized_backend_wins():
+    import jax
+
+    jax.devices()  # force backend init (conftest pinned cpu config)
+    assert jaxenv.backend_initialized()
+    assert jaxenv.ensure_platform() == "cpu"
+    assert jaxenv.ensure_platform("cpu") == "cpu"
+
+
+def test_env_cpu_request_honored_despite_site_latch():
+    """JAX_PLATFORMS=cpu in the env must yield the CPU backend without
+    probing (fast) even though the site hook latched the accelerator."""
+    r = _child(
+        "from rafiki_tpu.jaxenv import ensure_platform\n"
+        "import jax\n"
+        "p = ensure_platform()\n"
+        "assert p == 'cpu', p\n"
+        "assert jax.devices()[0].platform == 'cpu'\n"
+        "print('OK')\n",
+        JAX_PLATFORMS="cpu")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_cpu_resolution_inherited_by_children():
+    """A parent that pinned cpu exports BOTH JAX_PLATFORMS=cpu and the
+    RESOLVED_ENV marker (what _pin_cpu does); the child resolves cpu
+    instantly — no probe subprocess, no accelerator attempt."""
+    r = _child(
+        "from rafiki_tpu.jaxenv import ensure_platform\n"
+        "import jax\n"
+        "assert ensure_platform() == 'cpu'\n"
+        "assert jax.default_backend() == 'cpu'\n"
+        "print('OK')\n",
+        JAX_PLATFORMS="cpu", **{jaxenv.RESOLVED_ENV: "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_virtual_device_pool_sizing():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from rafiki_tpu.jaxenv import ensure_platform\n"
+         "import jax\n"
+         "ensure_platform('cpu', n_virtual_devices=4)\n"
+         "assert len(jax.devices()) == 4, jax.devices()\n"
+         "print('OK')\n"],
+        env={**env, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=TIMEOUT)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_failed_probe_falls_back_to_cpu():
+    """With the probe forced to fail fast (tiny timeout and a bogus
+    accel), auto resolution lands on cpu instead of hanging."""
+    r = _child(
+        "from rafiki_tpu import jaxenv\n"
+        "import jax\n"
+        "p = jaxenv.ensure_platform(probe_timeout=3.0)\n"
+        "assert p == 'cpu', p\n"
+        "assert jax.default_backend() == 'cpu'\n"
+        "print('OK')\n",
+        JAX_PLATFORMS="nosuchplatform")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_explicit_cpu_env_beats_inherited_resolution():
+    """JAX_PLATFORMS=cpu (operator intent) wins over a leaked
+    RAFIKI_TPU_PLATFORM=accel verdict from a parent process."""
+    r = _child(
+        "from rafiki_tpu.jaxenv import ensure_platform\n"
+        "import jax\n"
+        "assert ensure_platform() == 'cpu'\n"
+        "assert jax.default_backend() == 'cpu'\n"
+        "print('OK')\n",
+        JAX_PLATFORMS="cpu", **{jaxenv.RESOLVED_ENV: "axon"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_force_cpu_device_count_after_init():
+    """entry()-then-dryrun in one process: a 1-device backend already
+    initialized must be replaceable by an 8-device virtual CPU pool."""
+    r = _child(
+        "import jax\n"
+        "from rafiki_tpu import jaxenv\n"
+        "jaxenv.ensure_platform('cpu')\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"
+        "jaxenv.force_cpu_device_count(8)\n"
+        "assert len(jax.devices()) == 8, jax.devices()\n"
+        "import numpy as np\n"
+        "x = jax.jit(lambda a: a * 2)(np.arange(4.0))\n"
+        "assert float(x.sum()) == 12.0\n"
+        "print('OK')\n",
+        JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_explicit_accel_raises_when_unreachable():
+    r = _child(
+        "from rafiki_tpu import jaxenv\n"
+        "try:\n"
+        "    jaxenv.ensure_platform('accel', probe_timeout=3.0)\n"
+        "except RuntimeError as e:\n"
+        "    print('RAISED', e)\n",
+        JAX_PLATFORMS="nosuchplatform")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RAISED" in r.stdout
