@@ -85,49 +85,186 @@ class ChipGroup:
         return group if group is not None else ChipGroup.from_env()
 
 
+def discover_topology(devices: Sequence) -> Optional[List[tuple]]:
+    """Per-device physical coords, or None when the backend has none.
+
+    TPU devices expose ``.coords`` — ``(x, y, z)`` position on the slice's
+    ICI torus (v5e: a 2-D torus, z == 0). Virtual CPU devices don't; the
+    allocator then falls back to linear index adjacency.
+    """
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None or len(c) < 2:
+            return None
+        coords.append(tuple(int(v) for v in c))
+    return coords if len(set(coords)) == len(coords) else None
+
+
+def _rect_shapes(n: int):
+    """(h, w) factorizations of n, squarest first (minimal ICI diameter)."""
+    shapes = [(h, n // h) for h in range(1, n + 1) if n % h == 0]
+    return sorted(shapes, key=lambda s: (max(s), abs(s[0] - s[1])))
+
+
 class ChipAllocator:
     """Carves a device list into non-overlapping chip groups.
 
-    The Admin-side resource manager: thread-safe, contiguous-first-fit so
-    groups stay physically adjacent (contiguous ranges on a v5e slice keep
-    intra-group ICI hops minimal). ``allocate`` returns None when the
-    request cannot be satisfied — callers queue and retry (scheduler
-    fairness is handled one level up, in the ServicesManager).
+    The Admin-side resource manager: thread-safe. Placement is
+    **topology-aware** when the backend exposes device coords (TPU): a
+    group of ``n`` chips is placed as the squarest free axis-aligned
+    rectangle on the slice's 2-D ICI torus, so every intra-group
+    collective rides single-hop ICI links (a linear index range can
+    straddle torus rows — adjacent indices, distant chips). Without
+    coords (virtual CPU meshes) placement is contiguous-first-fit on the
+    device index. ``allocate`` returns None when the request cannot be
+    satisfied — callers queue and retry (scheduler fairness is handled
+    one level up, in the ServicesManager).
     """
 
-    def __init__(self, n_chips: Optional[int] = None):
+    def __init__(self, n_chips: Optional[int] = None,
+                 topology: Optional[Sequence[tuple]] = None):
         if n_chips is None:
+            from ..jaxenv import (backend_initialized, ensure_platform,
+                                  resolved_platform)
+
+            # Sizing from jax.devices() requires a backend; resolve the
+            # platform first so a dead accelerator tunnel degrades to
+            # CPU behind a deadline instead of hanging construction.
+            if not backend_initialized() and resolved_platform() is None:
+                ensure_platform()
             import jax
 
-            n_chips = len(jax.devices())
+            devices = jax.devices()
+            n_chips = len(devices)
+            if topology is None:
+                topology = discover_topology(devices)
+        elif topology is None:
+            # Explicit chip limit (serve --chips): still discover — but
+            # ONLY when touching the backend is known-safe: a live
+            # backend, or a platform THIS process resolved through
+            # jaxenv.ensure_platform (an env marker inherited from a
+            # parent is not fresh enough — the tunnel can die between
+            # processes, and raw library construction must never be the
+            # call that hangs on backend init).
+            from ..jaxenv import backend_initialized, resolved_platform
+
+            if backend_initialized() or resolved_platform() is not None:
+                import jax
+
+                topology = discover_topology(jax.devices()[:n_chips])
         self.n_chips = n_chips
+        if topology is not None and len(topology) != n_chips:
+            raise ValueError(f"topology has {len(topology)} entries for "
+                             f"{n_chips} chips")
+        self._topology = [tuple(c) for c in topology] if topology else None
+        if self._topology and len({c[2:] for c in self._topology}) > 1:
+            # 3-D (z-varying) topologies have no 2-D rectangle story
+            # yet; fall back to linear placement rather than refusing
+            # every allocation.
+            self._topology = None
         self._lock = threading.Lock()
         self._owner: List[Optional[str]] = [None] * n_chips
         self._groups: Dict[str, ChipGroup] = {}
 
     def allocate(self, n: int, name: str) -> Optional[ChipGroup]:
-        """First-fit allocation of ``n`` contiguous chips; None if full."""
+        """Allocate ``n`` chips as an ICI-compact group; None if full."""
         if n <= 0:
             raise ValueError("n must be positive")
         with self._lock:
             if name in self._groups:
                 raise ValueError(
                     f"group {name!r} already holds chips; release it first")
-            run_start, run_len = None, 0
-            for i in range(self.n_chips):
-                if self._owner[i] is None:
-                    run_start = i if run_len == 0 else run_start
-                    run_len += 1
-                    if run_len == n:
-                        idx = tuple(range(run_start, run_start + n))
-                        for j in idx:
-                            self._owner[j] = name
-                        group = ChipGroup(indices=idx, name=name)
-                        self._groups[name] = group
-                        return group
-                else:
-                    run_len = 0
-            return None
+            # With a known topology, placements must be ICI-connected:
+            # a linear index run can straddle torus rows, putting one
+            # group's collectives on other groups' ICI links. Rectangles
+            # first (minimal diameter); sizes with no rectangle that
+            # can EVER fit the grid (5 or 7 on a 2x4) fall back to a
+            # connected blob. Otherwise None -> callers queue/retry.
+            if self._topology is not None:
+                idx = self._find_rectangle(n)
+                if idx is None and not self._rect_feasible(n):
+                    idx = self._find_blob(n)
+            else:
+                idx = self._find_linear(n)
+            if idx is None:
+                return None
+            for j in idx:
+                self._owner[j] = name
+            group = ChipGroup(indices=idx, name=name)
+            self._groups[name] = group
+            return group
+
+    def _find_rectangle(self, n: int) -> Optional[tuple]:
+        """Squarest free h×w rectangle on the (x, y) coord grid.
+
+        Returned indices are in BOUSTROPHEDON (snake) order — each row
+        reversed relative to the previous — so devices adjacent in
+        group order are physically adjacent on the torus at every hop
+        including the row turns; ``build_mesh``'s ring (``sp``) axis
+        ppermutes between group-order neighbours, and plain row-major
+        order would make the row boundaries 2-hop diagonals.
+        """
+        grid = {c[:2]: i for i, c in enumerate(self._topology)}
+        free = {xy for xy, i in grid.items() if self._owner[i] is None}
+        for h, w in _rect_shapes(n):
+            for (x0, y0) in sorted(free):
+                cells = []
+                for dy in range(h):
+                    xs = range(w) if dy % 2 == 0 else range(w - 1, -1, -1)
+                    cells.extend((x0 + dx, y0 + dy) for dx in xs)
+                if all(c in free for c in cells):
+                    return tuple(grid[c] for c in cells)
+        return None
+
+    def _rect_feasible(self, n: int) -> bool:
+        """Could SOME h×w factorization of n ever fit this grid?"""
+        xs = [c[0] for c in self._topology]
+        ys = [c[1] for c in self._topology]
+        gw = max(xs) - min(xs) + 1
+        gh = max(ys) - min(ys) + 1
+        return any(h <= gh and w <= gw for h, w in _rect_shapes(n))
+
+    def _find_blob(self, n: int) -> Optional[tuple]:
+        """Connected free region of n cells (BFS, 4-neighbour).
+
+        Fallback for sizes with no feasible rectangle: the group stays
+        ICI-connected (every member reachable through group-internal
+        links) even though its diameter is not minimal.
+        """
+        grid = {c[:2]: i for i, c in enumerate(self._topology)}
+        free = {xy for xy, i in grid.items() if self._owner[i] is None}
+        for anchor in sorted(free):
+            blob, frontier = [anchor], [anchor]
+            seen = {anchor}
+            while frontier and len(blob) < n:
+                x, y = frontier.pop(0)
+                for nxt in ((x + 1, y), (x - 1, y), (x, y + 1),
+                            (x, y - 1)):
+                    if nxt in free and nxt not in seen:
+                        seen.add(nxt)
+                        blob.append(nxt)
+                        frontier.append(nxt)
+                        if len(blob) == n:
+                            break
+            if len(blob) == n:
+                return tuple(grid[c] for c in sorted(blob,
+                                                     key=lambda c:
+                                                     (c[1], c[0])))
+        return None
+
+    def _find_linear(self, n: int) -> Optional[tuple]:
+        """First-fit contiguous index range (no-topology fallback)."""
+        run_start, run_len = None, 0
+        for i in range(self.n_chips):
+            if self._owner[i] is None:
+                run_start = i if run_len == 0 else run_start
+                run_len += 1
+                if run_len == n:
+                    return tuple(range(run_start, run_start + n))
+            else:
+                run_len = 0
+        return None
 
     def release(self, name: str) -> None:
         with self._lock:
