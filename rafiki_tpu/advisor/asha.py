@@ -11,9 +11,15 @@ so parallel TrainWorkers never block on a synchronous bracket barrier
 
 The budget rides the model's own ``max_epochs`` knob (IntegerKnob range
 or the sorted numeric values of a CategoricalKnob), so any zoo model is
-ASHA-compatible unmodified; promoted trials retrain at the larger budget
-(no mid-trial checkpoint dependency). With no tunable budget knob the
-strategy degenerates to random search at a fixed budget.
+ASHA-compatible unmodified. Promotions **warm-start**: the promoted
+trial loads its configuration's rung-r weights from the ParamStore
+(``LOCAL_RECENT`` under a per-config ``params_scope``) and trains only
+the *delta* epochs between rungs — prior epochs are not repaid. When the
+warm-start params are unavailable (expired store, first run after a
+crash) the TrialRunner falls back to the full rung budget carried in
+``meta["cold_start_knobs"]``, so scores stay comparable within a rung
+either way. With no tunable budget knob the strategy degenerates to
+random search at a fixed budget.
 """
 
 from __future__ import annotations
@@ -72,6 +78,12 @@ class AshaAdvisor(BaseAdvisor):
         self._next_config = 0
         # trial_no -> (config_id, rung); popped by _observe/_forget.
         self._pending: Dict[int, Tuple[int, int]] = {}
+        # trial_no -> knob overrides if the warm-start params are gone;
+        # attached to the proposal by _decorate (same propose() call).
+        self._pending_cold: Dict[int, Knobs] = {}
+        # trial_no -> knobs to RECORD (cumulative budget) in trial rows
+        # and best()-tracking, vs the delta actually executed.
+        self._pending_record: Dict[int, Knobs] = {}
 
     # --- Strategy hooks (called under the base lock) ---
 
@@ -80,7 +92,20 @@ class AshaAdvisor(BaseAdvisor):
         if promo is not None:
             cid, rung = promo
             knobs = dict(self._configs[cid])
-            knobs[self.budget_knob] = self._ladder[rung]
+            full = self._ladder[rung]
+            delta = full - self._ladder[rung - 1]
+            if self._legal_budget(delta):
+                # Warm-start: train only the epochs this rung adds. The
+                # full budget rides along as the cold-start fallback.
+                knobs[self.budget_knob] = delta
+                self._pending_cold[trial_no] = {self.budget_knob: full}
+            else:
+                knobs[self.budget_knob] = full
+            # Reproducibility: the trial's RECORDED budget is the
+            # cumulative rung budget — retraining with the recorded
+            # knobs from scratch reproduces the scored model; the delta
+            # is an execution detail of the warm start.
+            self._pending_record[trial_no] = {self.budget_knob: full}
             self._pending[trial_no] = (cid, rung)
             return knobs
         # New configuration at rung 0.
@@ -119,6 +144,17 @@ class AshaAdvisor(BaseAdvisor):
             return ParamsType.LOCAL_RECENT
         return ParamsType.NONE
 
+    def _legal_budget(self, value: int) -> bool:
+        """Can the budget knob legally take ``value``? (The rung delta
+        may fall outside an IntegerKnob's range or between a
+        CategoricalKnob's values.)"""
+        knob = self.knob_config.get(self.budget_knob)
+        if isinstance(knob, IntegerKnob):
+            return knob.value_min <= value <= knob.value_max
+        if isinstance(knob, CategoricalKnob):
+            return value in knob.values
+        return False
+
     def _decorate(self, proposal: Proposal) -> None:
         entry = self._pending.get(proposal.trial_no)
         if entry is not None:
@@ -126,6 +162,12 @@ class AshaAdvisor(BaseAdvisor):
             # under the config-scoped key, so LOCAL_RECENT means "this
             # configuration's most recent weights", not "this worker's".
             proposal.meta["params_scope"] = f"asha-cfg-{entry[0]}"
+            cold = self._pending_cold.pop(proposal.trial_no, None)
+            if cold:
+                proposal.meta["cold_start_knobs"] = cold
+            rec = self._pending_record.pop(proposal.trial_no, None)
+            if rec:
+                proposal.meta["record_knobs"] = rec
 
     def _observe(self, proposal: Proposal, score: float) -> None:
         entry = self._pending.pop(proposal.trial_no, None)

@@ -83,9 +83,15 @@ class BaseAdvisor:
 
     def feedback(self, proposal: Proposal, score: float) -> None:
         with self._lock:
-            self._history.append((proposal.knobs, float(score)))
+            # ``record_knobs``: a strategy may execute reduced knobs
+            # (ASHA trains the rung DELTA on a warm start) while the
+            # reproducible configuration — what best() must hand back —
+            # carries the cumulative values.
+            knobs = {**proposal.knobs,
+                     **(proposal.meta.get("record_knobs") or {})}
+            self._history.append((knobs, float(score)))
             if self._best is None or score > self._best[1]:
-                self._best = (dict(proposal.knobs), float(score))
+                self._best = (dict(knobs), float(score))
             self._observe(proposal, float(score))
 
     def forget(self, proposal: Proposal) -> None:

@@ -118,7 +118,12 @@ class ParamStore:
             row = self._db.execute(sql, tuple(args)).fetchone()
         if row is None:
             return None
-        return self.load(row[0])
+        try:
+            return self.load(row[0])
+        except FileNotFoundError:
+            # Indexed but evicted (GC, cleanup): absence, not an error —
+            # the caller cold-starts, exactly as if nothing was saved.
+            return None
 
     def session_params_ids(self, session_id: str) -> list:
         with self._lock:

@@ -118,24 +118,52 @@ class TrialRunner:
             proposal = self.advisor.propose()
         if proposal is None:  # advisor side says: search is over
             return None
-        knobs = self.model_class.validate_knobs(proposal.knobs)
+        # Warm-start params are resolved BEFORE knob validation: a
+        # proposal may carry reduced knobs that are only valid with the
+        # warm start (ASHA promotions train delta epochs) plus
+        # ``cold_start_knobs`` overrides to apply when the shared params
+        # are legitimately absent (expired store, fresh node). A
+        # retrieval ERROR is different from absence: silently cold-
+        # starting would feed an artificially poor score back into the
+        # search (e.g. the ENAS controller), so it errs the trial and
+        # refunds the proposal like any other trial failure.
+        params_scope = proposal.meta.get("params_scope") or self.worker_id
+        try:
+            shared = self.params.retrieve(
+                proposal.params_type, session_id=self.sub_train_job_id,
+                worker_id=params_scope)
+        except Exception:
+            err = traceback.format_exc()
+            trial = self.meta.create_trial(
+                self.sub_train_job_id, self.model_id,
+                no=proposal.trial_no, status=TrialStatus.RUNNING,
+                worker_id=self.worker_id,
+                knobs=_jsonable_knobs(proposal.knobs),
+                proposal=proposal.to_json())
+            self.meta.mark_trial_errored(trial["id"], err)
+            forget = getattr(self.advisor, "forget", None)
+            if forget is not None:
+                forget(proposal)
+            _log.warning("trial #%d: shared-params retrieval failed:\n%s",
+                         proposal.trial_no, err)
+            return self.meta.get_trial(trial["id"])
+        raw_knobs = dict(proposal.knobs)
+        if shared is None:
+            raw_knobs.update(proposal.meta.get("cold_start_knobs") or {})
+        knobs = self.model_class.validate_knobs(raw_knobs)
+        # The RECORDED knobs are the reproducible configuration
+        # (``record_knobs`` overlays e.g. ASHA's cumulative budget over
+        # the executed delta).
+        recorded = {**knobs, **(proposal.meta.get("record_knobs") or {})}
         trial = self.meta.create_trial(
             self.sub_train_job_id, self.model_id, no=proposal.trial_no,
             status=TrialStatus.RUNNING, worker_id=self.worker_id,
-            knobs=_jsonable_knobs(knobs), proposal=proposal.to_json())
+            knobs=_jsonable_knobs(recorded), proposal=proposal.to_json())
         trial_id = trial["id"]
         logger.set_sink(lambda rec, _tid=trial_id:
                         self.meta.add_trial_log(_tid, rec))
         t0 = time.time()
         try:
-            # A proposal may scope its params to a strategy-defined key
-            # (ASHA promotions: per-configuration warm-starts) instead
-            # of this worker's identity.
-            params_scope = proposal.meta.get("params_scope") \
-                or self.worker_id
-            shared = self.params.retrieve(
-                proposal.params_type, session_id=self.sub_train_job_id,
-                worker_id=params_scope)
             model = self.model_class(**knobs)
             # Opt-in mid-trial checkpointing (RAFIKI_TPU_CKPT=1): the dir
             # is keyed by (sub_train_job, knobs), not trial id, so the

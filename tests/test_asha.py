@@ -42,12 +42,15 @@ def test_promotion_reuses_config_at_higher_budget():
     for p, s in zip(proposals, scores):
         adv.feedback(p, s)
     # 6 completed at rung 0 -> floor(6/3)=2 promotable; the next two
-    # proposals must be the two best configs at the rung-1 budget.
+    # proposals must be the two best configs, warm-starting with the
+    # rung-1 DELTA budget (3-1=2) and a full-budget cold-start fallback.
     p7 = adv.propose()
     p8 = adv.propose()
-    promoted = sorted([p7, p8], key=lambda p: -p.knobs["width"] * 0)
+    promoted = [p7, p8]
     budgets = {p.knobs["max_epochs"] for p in promoted}
-    assert budgets == {3}
+    assert budgets == {2}
+    assert all(p.meta["cold_start_knobs"] == {"max_epochs": 3}
+               for p in promoted)
     promoted_widths = {p.knobs["width"] for p in promoted}
     best_widths = {proposals[1].knobs["width"], proposals[3].knobs["width"]}
     assert promoted_widths == best_widths
@@ -69,7 +72,9 @@ def test_promotions_climb_to_top_rung():
         # Score correlated with width: halving should drive the widest
         # configs upward through every rung.
         adv.feedback(p, p.knobs["width"] / 64 + rng.normal(0, 0.01))
-    assert seen_budgets == {1, 3, 9, 27}
+    # Proposals carry rung DELTAS (warm-start): ladder 1/3/9/27 ->
+    # deltas 1, 2, 6, 18.
+    assert seen_budgets == {1, 2, 6, 18}
     best_knobs, _ = adv.best()
     assert best_knobs["width"] >= 40
 
@@ -80,11 +85,13 @@ def test_forget_refunds_promotion():
     adv.feedback(proposals[0], 0.9)
     adv.feedback(proposals[1], 0.1)
     promo = adv.propose()
-    assert promo.knobs["max_epochs"] == 2  # IntegerKnob(1,27), eta=2
+    # IntegerKnob(1,27), eta=2: rung-1 full budget 2, delta 2-1=1.
+    assert promo.knobs["max_epochs"] == 1
+    assert promo.meta["cold_start_knobs"] == {"max_epochs": 2}
     adv.forget(promo)
     # The promotion slot is refunded: the same config is re-promotable.
     promo2 = adv.propose()
-    assert promo2.knobs["max_epochs"] == 2
+    assert promo2.knobs["max_epochs"] == 1
     assert promo2.knobs["width"] == promo.knobs["width"]
 
 
@@ -126,7 +133,8 @@ def test_promotions_warm_start_from_own_config(tmp_path):
             marker = (None if shared_params is None
                       else float(np.asarray(
                           shared_params["marker"]).reshape(-1)[0]))
-            received.append((self.knobs["width"], marker))
+            received.append((self.knobs["width"], marker,
+                             self.knobs["max_epochs"]))
             self._params = {"marker":
                             np.asarray(float(self.knobs["width"]))}
 
@@ -152,10 +160,117 @@ def test_promotions_warm_start_from_own_config(tmp_path):
     rung0 = [r for r in received if r[1] is None]
     promotions = [r for r in received if r[1] is not None]
     assert promotions, "no promotion ever warm-started"
-    for width, marker in promotions:
+    for width, marker, _ in promotions:
         # the warm-start came from the SAME config's earlier params
         assert marker == float(width)
     assert len(rung0) + len(promotions) == len(received)
+    # Promotions trained only the rung DELTA (ladder 1/3/9/27 under
+    # eta=3 -> deltas 2/6/18), never a full rung budget from scratch.
+    assert {e for _, _, e in promotions} <= {2, 6, 18}
+    assert all(e == 1 for _, _, e in rung0)
+
+
+def test_promotion_records_cumulative_budget(tmp_path):
+    """Review finding r2: a promotion EXECUTES the rung delta but must
+    RECORD the cumulative budget — retraining from scratch with the
+    recorded knobs (advisor.best(), trial rows) reproduces the scored
+    model."""
+    from rafiki_tpu.constants import BudgetOption
+    from rafiki_tpu.store import MetaStore, ParamStore
+    from rafiki_tpu.worker.runner import TrialRunner
+
+    adv = AshaAdvisor(CONFIG, seed=0, eta=3)
+    proposals = [adv.propose() for _ in range(3)]
+    for p, s in zip(proposals, [0.9, 0.1, 0.2]):
+        adv.feedback(p, s)
+    promo = adv.propose()
+    assert promo.knobs["max_epochs"] == 2            # executed delta
+    assert promo.meta["record_knobs"] == {"max_epochs": 3}
+    adv.feedback(promo, 0.95)
+    best_knobs, _ = adv.best()
+    assert best_knobs["max_epochs"] == 3             # reproducible
+
+    # And through the TrialRunner: trial rows carry ladder budgets
+    # (1/3/9/27), never the executed deltas (2/6/18).
+    log = []
+    meta = MetaStore(":memory:")
+    adv2 = AshaAdvisor(CONFIG, seed=3, eta=3, total_trials=8)
+    runner = TrialRunner(_make_fake_model(log), adv2, "tr", "va", meta,
+                         ParamStore(str(tmp_path / "p")),
+                         sub_train_job_id="asha-rec",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 8})
+    runner.run()
+    trials = meta.get_trials("asha-rec")
+    recorded = {t["knobs"]["max_epochs"] for t in trials
+                if t["status"] == "COMPLETED"}
+    assert recorded <= {1, 3, 9, 27}, recorded
+    executed = {e for e, _ in log}
+    assert executed & {2, 6, 18}, (
+        f"no promotion ever executed a delta: {executed}")
+
+
+def test_promotion_cold_start_pays_full_budget(tmp_path):
+    """If the warm-start params vanished, the runner applies the
+    proposal's cold_start_knobs so the promoted trial retrains the FULL
+    rung budget (scores stay rung-comparable)."""
+    from rafiki_tpu.constants import BudgetOption
+    from rafiki_tpu.store import MetaStore, ParamStore
+    from rafiki_tpu.worker.runner import TrialRunner
+
+    epochs_seen = []
+
+    class FakeModel(_make_fake_model(epochs_seen)):
+        pass
+
+    adv = AshaAdvisor(CONFIG, seed=3, eta=3, total_trials=4)
+    store = ParamStore(str(tmp_path / "p"))
+    runner = TrialRunner(FakeModel, adv, "tr", "va", MetaStore(":memory:"),
+                         store, sub_train_job_id="asha-cold",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 4})
+    # Run rung-0 trials until a promotion is pending, then clear the
+    # param store to simulate expiry.
+    for _ in range(3):
+        runner.run_one()
+    promo = adv.propose()
+    assert promo.meta.get("cold_start_knobs"), "expected a promotion"
+    import shutil
+
+    shutil.rmtree(str(tmp_path / "p"), ignore_errors=True)
+    runner.run_one(promo)
+    # The last trial ran with the FULL rung budget (3), not the delta.
+    assert epochs_seen[-1][1] is None  # no shared params arrived
+    assert epochs_seen[-1][0] == 3
+
+
+def _make_fake_model(log):
+    from rafiki_tpu.model.base import BaseModel
+
+    class _Fake(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return CONFIG
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._params = {}
+
+        def train(self, path, *, shared_params=None, **kw):
+            log.append((self.knobs["max_epochs"], shared_params))
+            self._params = {"w": np.asarray(1.0)}
+
+        def evaluate(self, path):
+            return self.knobs["width"] / 64.0
+
+        def predict(self, queries):
+            return [0 for _ in queries]
+
+        def dump_parameters(self):
+            return dict(self._params)
+
+        def load_parameters(self, params):
+            self._params = dict(params)
+
+    return _Fake
 
 
 def test_asha_through_platform(tmp_path, synth_image_data):
