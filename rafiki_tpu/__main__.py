@@ -23,26 +23,44 @@ import threading
 
 
 def _serve(args: argparse.Namespace) -> None:
+    # One validated config object per node (SURVEY.md §5 config plan):
+    # CLI args override RAFIKI_TPU_* env vars override defaults; the
+    # resolved tunables are exported back to env so workers (threads or
+    # subprocess services) inherit exactly what was validated.
+    from .config import NodeConfig
+
+    cfg = NodeConfig.from_env(
+        workdir=args.workdir, port=args.port, n_chips=args.chips,
+        bus_uri=args.bus, log_level=args.log_level,
+        coordinator=args.coordinator or None,
+        num_processes=args.num_processes, process_id=args.process_id)
+    cfg.apply_env()
     logging.basicConfig(
-        level=getattr(logging, args.log_level.upper()),
+        level=getattr(logging, cfg.log_level.upper()),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    # Resolve the JAX platform before anything touches a backend: honors
+    # JAX_PLATFORMS=cpu (which the site hook's config latch otherwise
+    # ignores) and probes the accelerator with a deadline so a dead
+    # tunnel degrades to CPU instead of hanging the node.
+    from .jaxenv import ensure_platform
+    platform = ensure_platform(probe_timeout=cfg.probe_timeout)
+    print(f"rafiki-tpu platform: {platform}", flush=True)
 
     # Multi-host slice membership (SURVEY.md §2.10): every host of a pod
     # slice runs serve with the same coordinator address; JAX wires the
     # ICI/DCN topology and jax.devices() becomes the global device list,
     # which the chip allocator then partitions into per-trial groups.
-    if args.coordinator:
+    if cfg.coordinator:
         import jax
 
         jax.distributed.initialize(
-            coordinator_address=args.coordinator,
-            num_processes=args.num_processes,
-            process_id=args.process_id)
+            coordinator_address=cfg.coordinator,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id)
 
     from .platform import LocalPlatform
-    platform = LocalPlatform(workdir=args.workdir, http=True,
-                             admin_port=args.port,
-                             n_chips=args.chips, bus_uri=args.bus)
+    platform = LocalPlatform.from_config(cfg, http=True)
     app = platform.app
     print(f"rafiki-tpu admin on http://{app.host}:{app.port} "
           f"(workdir={platform.workdir})", flush=True)
@@ -54,6 +72,56 @@ def _serve(args: argparse.Namespace) -> None:
         stop.wait()
     finally:
         print("shutting down...", flush=True)
+        platform.shutdown()
+
+
+def _join(args: argparse.Namespace) -> None:
+    """Worker node: attach elastic capacity to a running train job.
+
+    Shares the primary node's meta store (``--workdir`` on a shared
+    filesystem), params dir and TCP bus; its workers pull proposals
+    from the job's existing advisor so the search stays one search
+    (SURVEY.md §2.10 multi-host plan).
+    """
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    if not args.bus:
+        raise SystemExit("join needs --bus tcp://host:port (the primary "
+                         "node's broker) — an in-process bus cannot span "
+                         "nodes")
+
+    from .jaxenv import ensure_platform
+    print(f"rafiki-tpu platform: {ensure_platform()}", flush=True)
+
+    from .platform import LocalPlatform
+
+    # A join node shares the primary's workdir, so it needs its OWN
+    # node identity (the workdir-stable default would collide with the
+    # primary's); shutdown stops this node's services either way, so a
+    # departing joiner leaves no RUNNING rows behind.
+    import os
+    import socket
+
+    platform = LocalPlatform(workdir=args.workdir, http=False,
+                             n_chips=args.chips, bus_uri=args.bus,
+                             stop_jobs_on_shutdown=False,
+                             node_id=f"{socket.gethostname()}"
+                                     f"/join-{os.getpid()}",
+                             adopt_unowned=False)
+    try:
+        attached = platform.admin.attach_workers(
+            args.train_job, chips_per_trial=args.chips_per_trial)
+        if not attached:
+            raise SystemExit("no chips available on this node")
+        print(f"attached {len(attached)} worker(s) to {args.train_job}",
+              flush=True)
+        ok = platform.admin.wait_until_train_job_done(args.train_job,
+                                                      timeout=args.timeout)
+        print("train job done" if ok else "timed out waiting", flush=True)
+        if not ok:
+            raise SystemExit(1)
+    finally:
         platform.shutdown()
 
 
@@ -82,16 +150,19 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="rafiki_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
+    # Defaults are None = "not given on the CLI": NodeConfig.from_env
+    # then falls through to RAFIKI_TPU_* env vars, then its dataclass
+    # defaults (CLI > env > default precedence).
     serve = sub.add_parser("serve", help="run an Admin + worker node")
-    serve.add_argument("--workdir", default="./rafiki_workdir",
+    serve.add_argument("--workdir", default=None,
                        help="state directory (sqlite meta + params)")
-    serve.add_argument("--port", type=int, default=3000)
+    serve.add_argument("--port", type=int, default=None)
     serve.add_argument("--chips", type=int, default=None,
                        help="limit to the first N chips (default: all)")
-    serve.add_argument("--bus", default="",
+    serve.add_argument("--bus", default=None,
                        help="bus URI ('' = in-process; 'tcp://host:port')")
-    serve.add_argument("--log-level", default="info")
-    serve.add_argument("--coordinator", default="",
+    serve.add_argument("--log-level", default=None)
+    serve.add_argument("--coordinator", default=None,
                        help="jax.distributed coordinator host:port "
                             "(multi-host slices; empty = single host)")
     serve.add_argument("--num-processes", type=int, default=None,
@@ -99,6 +170,22 @@ def main(argv=None) -> None:
     serve.add_argument("--process-id", type=int, default=None,
                        help="this process's rank in the slice")
     serve.set_defaults(fn=_serve)
+
+    join = sub.add_parser(
+        "join", help="attach this node's chips to a running train job "
+                     "(shared workdir + tcp bus)")
+    join.add_argument("--workdir", required=True,
+                      help="the PRIMARY node's state directory "
+                           "(shared filesystem)")
+    join.add_argument("--bus", required=True,
+                      help="primary node's bus URI (tcp://host:port)")
+    join.add_argument("--train-job", required=True)
+    join.add_argument("--chips", type=int, default=None,
+                      help="limit to the first N local chips")
+    join.add_argument("--chips-per-trial", type=int, default=1)
+    join.add_argument("--timeout", type=float, default=3600.0)
+    join.add_argument("--log-level", default="info")
+    join.set_defaults(fn=_join)
 
     broker = sub.add_parser(
         "broker", help="run a standalone bus broker (multi-process / "
@@ -112,7 +199,8 @@ def main(argv=None) -> None:
 
     args = parser.parse_args(argv)
     if args.cmd == "serve":
-        n_set = sum([args.coordinator != "", args.num_processes is not None,
+        n_set = sum([args.coordinator is not None,
+                     args.num_processes is not None,
                      args.process_id is not None])
         if n_set not in (0, 3):
             parser.error(

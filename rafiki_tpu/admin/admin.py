@@ -246,6 +246,25 @@ class Admin:
             time.sleep(poll)
         return False
 
+    def attach_workers(self, train_job_id: str, chips_per_trial: int = 1,
+                       ) -> List[Dict[str, Any]]:
+        """Elastic scale-out (SURVEY.md §2.10 multi-host plan): attach
+        one extra train worker per sub-job of a RUNNING job on THIS
+        node's chips. Called on a secondary node sharing the meta store,
+        params dir and bus (the ``join`` CLI); the new workers pull
+        proposals from the job's existing bus-hosted advisor."""
+        job = self.meta.get_train_job(train_job_id)
+        if job is None:
+            raise ValueError(f"unknown train job {train_job_id}")
+        if job["status"] != TrainJobStatus.RUNNING:
+            raise ValueError(f"train job {train_job_id} is not RUNNING")
+        attached = []
+        for sub in self.meta.get_sub_train_jobs(train_job_id):
+            svc = self.services.add_train_worker(sub["id"], chips_per_trial)
+            if svc is not None:
+                attached.append(svc)
+        return attached
+
     # --- Inference jobs (§3.2) ---
 
     def create_inference_job(self, user_id: str, train_job_id: str,

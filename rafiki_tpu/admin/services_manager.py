@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 from typing import Any, Dict, List, Optional
 
 from ..constants import (BudgetOption, EnvVars, ServiceStatus, ServiceType)
@@ -56,7 +57,8 @@ class ServicesManager:
     def __init__(self, meta: MetaStore, container: ContainerManager,
                  allocator: Optional[ChipAllocator] = None,
                  meta_uri: str = ":memory:", params_dir: str = "",
-                 bus_uri: str = ""):
+                 bus_uri: str = "", node_id: str = "",
+                 adopt_unowned: bool = True):
         self.meta = meta
         self.container = container
         self.allocator = allocator or ChipAllocator()
@@ -65,13 +67,27 @@ class ServicesManager:
         self.meta_uri = meta_uri
         self.params_dir = params_dir
         self.bus_uri = bus_uri
+        # Node identity: services are stamped with their launching node
+        # so, with several nodes sharing one meta store (multi-host
+        # scale-out), each node supervises/restarts only what IT runs —
+        # another node's healthy worker must not look "dead" here.
+        if not node_id:
+            import socket
+
+            node_id = f"{socket.gethostname()}:{os.getpid()}"
+        self.node_id = node_id
+        # Only the workdir-owning (primary) node adopts pre-upgrade
+        # rows whose node_id is NULL; a join node stopping/sweeping the
+        # primary's legacy services would disrupt its running jobs.
+        self.adopt_unowned = adopt_unowned
 
     # --- Launch plumbing ---
 
     def _launch(self, service_type: str, extra_env: Dict[str, str],
                 chips: Optional[List[int]] = None) -> Dict[str, Any]:
         svc = self.meta.create_service(service_type,
-                                       ServiceStatus.DEPLOYING, chips=chips)
+                                       ServiceStatus.DEPLOYING, chips=chips,
+                                       node_id=self.node_id)
         env = {
             EnvVars.META_URI: self.meta_uri,
             EnvVars.PARAMS_DIR: self.params_dir,
@@ -122,7 +138,7 @@ class ServicesManager:
             services.append(advisor_svc)
             launched = 0
             for _ in range(n_workers):
-                svc = self._launch_train_worker(sub["id"], chips_per_trial)
+                svc = self.add_train_worker(sub["id"], chips_per_trial)
                 if svc is None:
                     # Slice is full: run with what we got (≥1); trials
                     # queue behind fewer workers rather than failing.
@@ -138,10 +154,20 @@ class ServicesManager:
                     f"no chips available for train job {train_job_id}")
         return services
 
-    def _launch_train_worker(self, sub_id: str, chips_per_trial: int,
-                             ) -> Optional[Dict[str, Any]]:
+    def add_train_worker(self, sub_id: str, chips_per_trial: int = 1,
+                         ) -> Optional[Dict[str, Any]]:
+        """Attach one train worker for ``sub_id`` on THIS node's chips.
+
+        Public scale-out seam: a second node sharing the meta store /
+        params dir / bus calls this (via ``Admin.attach_workers`` or the
+        ``join`` CLI) to add elastic capacity to a running job — its
+        worker pulls proposals from the same bus-hosted advisor, so the
+        search stays coordinated across nodes. Returns None when this
+        node's chips are exhausted.
+        """
         svc_row = self.meta.create_service(ServiceType.TRAIN,
-                                           ServiceStatus.DEPLOYING)
+                                           ServiceStatus.DEPLOYING,
+                                           node_id=self.node_id)
         group = self.allocator.allocate(chips_per_trial,
                                         name=self._alloc_name(svc_row["id"]))
         if group is None:
@@ -175,14 +201,70 @@ class ServicesManager:
             for w in self.meta.get_train_job_workers(sub["id"]):
                 self._stop_service(w["service_id"])
 
+    # How long a foreign node's RUNNING row stays credible without a
+    # heartbeat. Must comfortably exceed the heartbeat cadence
+    # (NODE_LEASE/4 in LocalPlatform) PLUS worst-case heartbeat delays:
+    # sqlite busy waits (up to 30 s), long GIL-holding XLA traces, and
+    # cross-host clock skew (heartbeat_at is the writer's clock, this
+    # check is the reader's — nodes sharing a meta store are assumed
+    # NTP-synced to within a few seconds). Expiry is detection of a
+    # node presumed DEAD, not fencing of a live one: a worker that was
+    # merely stalled finishes its trial and writes its rows normally
+    # (trial results are idempotent), it just stops counting toward
+    # job liveness. Override via RAFIKI_TPU_NODE_LEASE.
+    NODE_LEASE = float(os.environ.get("RAFIKI_TPU_NODE_LEASE", 120.0))
+
+    def _ownership(self, svc: Dict[str, Any]) -> str:
+        """'local' | 'foreign' | 'unowned-skip'.
+
+        NULL node_id rows (pre-upgrade databases) are adopted as local
+        by the primary node only; secondary (join) nodes must neither
+        stop nor judge them.
+        """
+        nid = svc.get("node_id")
+        if nid == self.node_id:
+            return "local"
+        if nid is None:
+            return "local" if self.adopt_unowned else "unowned-skip"
+        return "foreign"
+
+    def _lease_fresh(self, svc: Dict[str, Any]) -> bool:
+        import time
+
+        hb = svc.get("heartbeat_at") or svc.get("created_at") or 0.0
+        return (time.time() - hb) <= self.NODE_LEASE
+
+    def heartbeat(self) -> None:
+        """Refresh this node's liveness lease (called by the platform's
+        supervisor loop)."""
+        self.meta.touch_node_services(self.node_id)
+
     def train_services_active(self, train_job_id: str) -> bool:
-        """True while any TRAIN worker of the job is alive."""
+        """True while any TRAIN worker of the job is alive.
+
+        Local services are liveness-checked against this node's
+        container manager; services another node attached (elastic
+        scale-out) are judged by their meta-store status, credible only
+        while the owning node's heartbeat lease is fresh — a join node
+        that died ungracefully stops blocking completion once its lease
+        expires.
+        """
         for sub in self.meta.get_sub_train_jobs(train_job_id):
             for w in self.meta.get_train_job_workers(sub["id"]):
                 svc = self.meta.get_service(w["service_id"])
                 if svc["service_type"] != ServiceType.TRAIN:
                     continue
-                if svc["status"] in _ACTIVE and self.container.service_alive(
+                if svc["status"] not in _ACTIVE:
+                    continue
+                own = self._ownership(svc)
+                if own == "foreign" or own == "unowned-skip":
+                    # Not ours to liveness-check; credible while the
+                    # lease (or, for unowned legacy rows, creation
+                    # time) is fresh.
+                    if self._lease_fresh(svc):
+                        return True
+                    continue
+                if self.container.service_alive(
                         svc["container_id"] or svc["id"]):
                     return True
         return False
@@ -203,7 +285,8 @@ class ServicesManager:
         grabbed: List[Dict[str, Any]] = []  # service rows with a group
         for _ in trial_ids:
             svc_row = self.meta.create_service(ServiceType.INFERENCE,
-                                               ServiceStatus.DEPLOYING)
+                                               ServiceStatus.DEPLOYING,
+                                               node_id=self.node_id)
             group = self.allocator.allocate(
                 chips_per_worker, name=self._alloc_name(svc_row["id"]))
             if group is None:
@@ -274,6 +357,17 @@ class ServicesManager:
         for w in self.meta.get_inference_job_workers(inference_job_id):
             self._stop_service(w["service_id"])
 
+    def stop_own_services(self) -> None:
+        """Stop every still-active service THIS node launched (shutdown
+        hygiene: a node leaving a shared meta store must not leak rows
+        that read as live remote workers forever). NULL-node rows from
+        pre-upgrade databases are stopped only by the adopting
+        (primary) node."""
+        for svc in self.meta.get_services():
+            if svc["status"] in _ACTIVE and \
+                    self._ownership(svc) == "local":
+                self._stop_service(svc["id"])
+
     # --- Supervision (SURVEY.md §5: failure detection / recovery) ---
 
     def supervise(self) -> List[str]:
@@ -284,7 +378,21 @@ class ServicesManager:
         chip range. Returns the ids of restarted services.
         """
         restarted = []
+        # Node-scoped: this node's container manager can only judge what
+        # IT launched. Foreign rows are swept by lease expiry instead;
+        # NULL-node rows (pre-upgrade databases) are adopted as local.
         for svc in self.meta.get_services(status=ServiceStatus.RUNNING):
+            own = self._ownership(svc)
+            if own == "unowned-skip":
+                continue
+            if own == "foreign":
+                if not self._lease_fresh(svc):
+                    self.meta.update_service(svc["id"],
+                                             status=ServiceStatus.ERRORED)
+                    _log.warning("lease expired on %s from node %s; "
+                                 "marked errored", svc["id"][:8],
+                                 svc["node_id"])
+                continue
             if self.container.service_alive(svc["container_id"] or svc["id"]):
                 continue
             self.meta.update_service(svc["id"], status=ServiceStatus.ERRORED)
@@ -297,7 +405,7 @@ class ServicesManager:
             if not rows:
                 continue
             sub_id = rows[0]["sub_train_job_id"]
-            new_svc = self._launch_train_worker(
+            new_svc = self.add_train_worker(
                 sub_id, chips_per_trial=len(svc.get("chips") or [1]))
             if new_svc is not None:
                 restarted.append(new_svc["id"])

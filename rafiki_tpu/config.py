@@ -1,0 +1,136 @@
+"""Typed node configuration: one validated object per serve process.
+
+Parity: SURVEY.md §5 "Config / flag system" — the reference configures
+every service through ``.env.sh`` exports and env vars injected by the
+ServicesManager; the rebuild keeps that transport (env vars are how
+container/subprocess children inherit settings) but fronts it with a
+dataclass so a node constructs from ONE validated object instead of
+scattered ``os.environ`` reads.
+
+Precedence: explicit constructor/CLI overrides > ``RAFIKI_TPU_*`` env
+vars > defaults. ``apply_env()`` writes the tunables back into
+``os.environ`` so both in-process workers (threads reading env at
+construction) and spawned service children see the same resolved values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Mapping, Optional
+
+_PREFIX = "RAFIKI_TPU_"
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    """Everything a ``python -m rafiki_tpu serve`` node needs.
+
+    Env var for field ``x``: ``RAFIKI_TPU_<X>`` (see ``_ENV_MAP`` for
+    the exceptions that predate this layer).
+    """
+
+    # --- Node identity / state ---
+    workdir: str = "./rafiki_workdir"
+    port: int = 3000
+    n_chips: Optional[int] = None          # None = all visible chips
+    bus_uri: str = ""                      # "" = in-process bus
+    supervise_interval: float = 10.0       # 0 disables the sweep
+    log_level: str = "info"
+
+    # --- Multi-host slice membership (jax.distributed) ---
+    coordinator: str = ""                  # host:port; "" = single host
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    # --- Service tunables (inherited by workers) ---
+    serving_pipeline: bool = True          # one-burst-in-flight overlap
+    checkpoint_trials: bool = False        # mid-trial epoch snapshots
+    trace_dir: str = ""                    # per-trial profiler traces
+    probe_timeout: float = 60.0            # accelerator liveness probe
+
+    # Fields whose env names predate this layer (back-compat).
+    _ENV_MAP = {
+        "serving_pipeline": "RAFIKI_TPU_SERVING_PIPELINE",
+        "checkpoint_trials": "RAFIKI_TPU_CKPT",
+        "trace_dir": "RAFIKI_TPU_TRACE_DIR",
+        "probe_timeout": "RAFIKI_TPU_PROBE_TIMEOUT",
+    }
+
+    @classmethod
+    def env_name(cls, field: str) -> str:
+        return cls._ENV_MAP.get(field, _PREFIX + field.upper())
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None,
+                 **overrides: Any) -> "NodeConfig":
+        """Build from env vars; ``overrides`` (CLI args) win. An
+        override of ``None`` means "not given" and is dropped."""
+        env = os.environ if env is None else env
+        values: Dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            raw = env.get(cls.env_name(f.name))
+            if raw is None:
+                continue
+            values[f.name] = cls._coerce(f.name, raw)
+        values.update({k: v for k, v in overrides.items()
+                       if v is not None})
+        cfg = cls(**values)
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def _coerce(cls, name: str, raw: str) -> Any:
+        hints = {f.name: f.type for f in dataclasses.fields(cls)}
+        hint = str(hints[name])
+        try:
+            if "bool" in hint:
+                return _parse_bool(raw)
+            if "int" in hint:
+                return int(raw)
+            if "float" in hint:
+                return float(raw)
+        except ValueError as e:
+            raise ValueError(
+                f"{cls.env_name(name)}={raw!r}: {e}") from None
+        return raw
+
+    def validate(self) -> "NodeConfig":
+        if not (0 <= self.port <= 65535):
+            raise ValueError(f"port {self.port} out of range")
+        if self.n_chips is not None and self.n_chips <= 0:
+            raise ValueError("n_chips must be positive (or unset)")
+        if self.supervise_interval < 0:
+            raise ValueError("supervise_interval must be >= 0")
+        if self.probe_timeout <= 0:
+            raise ValueError("probe_timeout must be positive")
+        if self.log_level.upper() not in (
+                "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
+            raise ValueError(f"unknown log_level {self.log_level!r}")
+        multi = [self.coordinator != "", self.num_processes is not None,
+                 self.process_id is not None]
+        if any(multi) and not all(multi):
+            raise ValueError("coordinator, num_processes and process_id "
+                             "must be given together")
+        if self.bus_uri and not (self.bus_uri.startswith("tcp://")
+                                 or self.bus_uri.startswith("memory://")):
+            raise ValueError(f"unsupported bus_uri {self.bus_uri!r}")
+        return self
+
+    def apply_env(self) -> None:
+        """Export the service tunables so in-process workers and spawned
+        children resolve the same values this node validated."""
+        os.environ[self.env_name("serving_pipeline")] = \
+            "1" if self.serving_pipeline else "0"
+        if self.checkpoint_trials:
+            os.environ[self.env_name("checkpoint_trials")] = "1"
+        else:
+            os.environ.pop(self.env_name("checkpoint_trials"), None)
+        if self.trace_dir:
+            os.environ[self.env_name("trace_dir")] = self.trace_dir
+        os.environ[self.env_name("probe_timeout")] = str(self.probe_timeout)

@@ -38,7 +38,12 @@ class LocalPlatform:
     def __init__(self, workdir: Optional[str] = None,
                  n_chips: Optional[int] = None, http: bool = False,
                  admin_port: int = 0, bus_uri: str = "",
-                 supervise_interval: float = 10.0):
+                 supervise_interval: float = 10.0,
+                 stop_jobs_on_shutdown: bool = True,
+                 node_id: str = "", adopt_unowned: bool = True):
+        # A secondary (join) node sharing another node's meta store must
+        # not stop the cluster's jobs when it leaves.
+        self.stop_jobs_on_shutdown = stop_jobs_on_shutdown
         self._tmp = None
         if workdir is None:
             self._tmp = tempfile.TemporaryDirectory(prefix="rafiki_tpu_")
@@ -55,9 +60,23 @@ class LocalPlatform:
                                  bus=self.bus)
         self.container = ThreadContainerManager(self.ctx)
         self.allocator = ChipAllocator(n_chips)
+        # Node identity must be STABLE across restarts of the same node
+        # (host + workdir), or a crashed node's RUNNING service rows
+        # would be orphaned forever: the pid-scoped supervise sweep of a
+        # restarted process would never match them. Secondary (join)
+        # nodes pass an explicit unique node_id instead — they share the
+        # primary's workdir and must not collide with it.
+        if not node_id:
+            import hashlib
+            import socket
+
+            wd = hashlib.sha1(
+                os.path.abspath(workdir).encode()).hexdigest()[:8]
+            node_id = f"{socket.gethostname()}/{wd}"
         self.services = ServicesManager(
             self.meta, self.container, self.allocator,
-            meta_uri=meta_uri, params_dir=params_dir, bus_uri=bus_uri)
+            meta_uri=meta_uri, params_dir=params_dir, bus_uri=bus_uri,
+            node_id=node_id, adopt_unowned=adopt_unowned)
         self.admin = Admin(self.meta, self.params, self.services)
         self.app: Optional[AdminApp] = None
         if http:
@@ -80,6 +99,30 @@ class LocalPlatform:
                 target=_loop, name="supervisor", daemon=True)
             self._supervisor.start()
 
+        # Liveness heartbeat: ALWAYS on (independent of the supervise
+        # interval — disabling the sweep must not silently let this
+        # node's lease lapse and make peers judge its live workers
+        # dead). Cadence well inside ServicesManager.NODE_LEASE.
+        def _beat() -> None:
+            interval = self.services.NODE_LEASE / 4.0
+            while not self._stop_supervisor.wait(interval):
+                try:
+                    self.services.heartbeat()
+                except Exception:
+                    _log.exception("heartbeat failed")
+
+        self._heartbeat = threading.Thread(
+            target=_beat, name="heartbeat", daemon=True)
+        self._heartbeat.start()
+
+    @classmethod
+    def from_config(cls, cfg, http: bool = False) -> "LocalPlatform":
+        """Construct from one validated ``NodeConfig`` (SURVEY.md §5
+        config plan) — the serve CLI's composition path."""
+        return cls(workdir=cfg.workdir, n_chips=cfg.n_chips, http=http,
+                   admin_port=cfg.port, bus_uri=cfg.bus_uri,
+                   supervise_interval=cfg.supervise_interval)
+
     @property
     def admin_port(self) -> int:
         assert self.app is not None, "platform started without http=True"
@@ -89,12 +132,19 @@ class LocalPlatform:
         self._stop_supervisor.set()
         if self._supervisor is not None:
             self._supervisor.join(timeout=5)
+        self._heartbeat.join(timeout=5)
         if self.app is not None:
             self.app.stop()
-        for job in self.meta.get_train_jobs(status="RUNNING"):
-            self.services.stop_train_services(job["id"])
-        for job in self.meta.get_inference_jobs(status="RUNNING"):
-            self.services.stop_inference_services(job["id"])
+        if self.stop_jobs_on_shutdown:
+            for job in self.meta.get_train_jobs(status="RUNNING"):
+                self.services.stop_train_services(job["id"])
+            for job in self.meta.get_inference_jobs(status="RUNNING"):
+                self.services.stop_inference_services(job["id"])
+        # Either way, stop what THIS node launched: a leaving join node
+        # must not leak RUNNING rows into the shared meta store (they
+        # would read as a live remote worker forever and block the
+        # primary's job-completion detection).
+        self.services.stop_own_services()
         self.meta.close()
         self.params.close()
         if isinstance(self.bus, MemoryBus):

@@ -105,6 +105,8 @@ CREATE TABLE IF NOT EXISTS services (
     chips TEXT,
     host TEXT,
     port INTEGER,
+    node_id TEXT,
+    heartbeat_at REAL,
     created_at REAL NOT NULL,
     stopped_at REAL
 );
@@ -154,6 +156,15 @@ class MetaStore:
                 self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute("PRAGMA busy_timeout=30000")
             self._conn.executescript(_SCHEMA)
+            # Migrations for pre-existing databases (CREATE IF NOT
+            # EXISTS leaves an existing services table unchanged).
+            for ddl in ("ALTER TABLE services ADD COLUMN node_id TEXT",
+                        "ALTER TABLE services ADD COLUMN heartbeat_at "
+                        "REAL"):
+                try:
+                    self._conn.execute(ddl)
+                except sqlite3.OperationalError:
+                    pass  # column already exists
             self._conn.commit()
 
     def close(self) -> None:
@@ -428,24 +439,54 @@ class MetaStore:
                        container_id: Optional[str] = None,
                        chips: Optional[List[int]] = None,
                        host: Optional[str] = None,
-                       port: Optional[int] = None) -> Row:
+                       port: Optional[int] = None,
+                       node_id: Optional[str] = None) -> Row:
         return self._insert("services", {
             "id": _new_id(), "service_type": service_type, "status": status,
             "container_id": container_id, "chips": chips, "host": host,
-            "port": port, "created_at": _now(), "stopped_at": None})
+            "port": port, "node_id": node_id, "heartbeat_at": _now(),
+            "created_at": _now(), "stopped_at": None})
 
     def get_service(self, service_id: str) -> Optional[Row]:
         return self._one("SELECT * FROM services WHERE id = ?", (service_id,))
 
-    def get_services(self, status: Optional[str] = None) -> List[Row]:
-        if status is None:
-            return self._select("SELECT * FROM services ORDER BY created_at")
+    def get_services(self, status: Optional[str] = None,
+                     node_id: Optional[str] = None) -> List[Row]:
+        """``node_id`` scopes to one node's services (multi-node shared
+        meta: each node supervises only what IT launched)."""
+        clauses, args = [], []
+        if status is not None:
+            clauses.append("status = ?")
+            args.append(status)
+        if node_id is not None:
+            clauses.append("node_id = ?")
+            args.append(node_id)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
         return self._select(
-            "SELECT * FROM services WHERE status = ? ORDER BY created_at",
-            (status,))
+            f"SELECT * FROM services{where} ORDER BY created_at",
+            tuple(args))
 
     def update_service(self, service_id: str, **fields: Any) -> None:
         self._update("services", service_id, **fields)
+
+    def touch_node_services(self, node_id: str) -> None:
+        """Refresh the liveness lease on a node's active services.
+
+        Multi-node shared meta: other nodes treat a RUNNING row from a
+        foreign node as live only while its heartbeat is fresh, so a
+        node that dies ungracefully (SIGKILL, power loss) stops blocking
+        job-completion detection once its lease expires.
+        """
+        from ..constants import ServiceStatus
+
+        active = (ServiceStatus.STARTED, ServiceStatus.DEPLOYING,
+                  ServiceStatus.RUNNING)
+        with self._lock:
+            self._conn.execute(
+                f"UPDATE services SET heartbeat_at = ? WHERE node_id = ? "
+                f"AND status IN ({', '.join('?' * len(active))})",
+                (_now(), node_id, *active))
+            self._conn.commit()
 
     def add_train_job_worker(self, service_id: str,
                              sub_train_job_id: str) -> None:

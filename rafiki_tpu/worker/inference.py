@@ -10,6 +10,7 @@ the query's reply queue.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Any, Optional
 
@@ -97,7 +98,8 @@ class InferenceWorker:
     def __init__(self, service_id: str, inference_job_id: str, trial_id: str,
                  meta: MetaStore, params: ParamStore, bus: BaseBus,
                  chips: Optional[ChipGroup] = None,
-                 batch_timeout: float = 0.5, max_batch: int = 512):
+                 batch_timeout: float = 0.5, max_batch: int = 512,
+                 pipeline: Optional[bool] = None):
         self.service_id = service_id
         self.inference_job_id = inference_job_id
         self.trial_id = trial_id
@@ -107,6 +109,16 @@ class InferenceWorker:
         self.chips = chips
         self.batch_timeout = batch_timeout
         self.max_batch = max_batch
+        # One-burst-in-flight pipelining (overlap burst N's readback
+        # with burst N+1's device compute). Env-togglable so the bench
+        # can measure the win: RAFIKI_TPU_SERVING_PIPELINE=0 disables.
+        # Same falsy spellings as NodeConfig ("0"/"false"/"no"/"off").
+        if pipeline is None:
+            from ..config import _parse_bool
+
+            pipeline = _parse_bool(os.environ.get(
+                "RAFIKI_TPU_SERVING_PIPELINE", "1"))
+        self.pipeline = pipeline
         self.stop_flag = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._model: Optional[Any] = None
@@ -182,6 +194,9 @@ class InferenceWorker:
                     timeout=0.0 if pending is not None
                     else self.batch_timeout)
                 handle = self._dispatch_batch(items) if items else None
+                if not self.pipeline and handle is not None:
+                    self._complete_batch(*handle)
+                    handle = None
                 if pending is not None:
                     self._complete_batch(*pending)
                 pending = handle

@@ -1,0 +1,93 @@
+"""Typed node config (SURVEY.md §5 "Config / flag system" rebuild)."""
+
+import pytest
+
+from rafiki_tpu.config import NodeConfig
+
+
+def test_defaults_validate():
+    cfg = NodeConfig.from_env(env={})
+    assert cfg.port == 3000 and cfg.workdir == "./rafiki_workdir"
+    assert cfg.serving_pipeline and not cfg.checkpoint_trials
+    assert cfg.n_chips is None and cfg.bus_uri == ""
+
+
+def test_env_parsing_and_types():
+    cfg = NodeConfig.from_env(env={
+        "RAFIKI_TPU_PORT": "8080",
+        "RAFIKI_TPU_N_CHIPS": "4",
+        "RAFIKI_TPU_BUS_URI": "tcp://10.0.0.1:6380",
+        "RAFIKI_TPU_SUPERVISE_INTERVAL": "2.5",
+        "RAFIKI_TPU_SERVING_PIPELINE": "0",
+        "RAFIKI_TPU_CKPT": "1",
+        "RAFIKI_TPU_TRACE_DIR": "/tmp/traces",
+        "RAFIKI_TPU_PROBE_TIMEOUT": "15",
+    })
+    assert cfg.port == 8080 and cfg.n_chips == 4
+    assert cfg.bus_uri == "tcp://10.0.0.1:6380"
+    assert cfg.supervise_interval == 2.5
+    assert cfg.serving_pipeline is False
+    assert cfg.checkpoint_trials is True
+    assert cfg.trace_dir == "/tmp/traces"
+    assert cfg.probe_timeout == 15.0
+
+
+def test_cli_overrides_beat_env():
+    cfg = NodeConfig.from_env(env={"RAFIKI_TPU_PORT": "8080"},
+                              port=9090, workdir=None)
+    assert cfg.port == 9090                   # explicit override wins
+    assert cfg.workdir == "./rafiki_workdir"  # None = not given
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        NodeConfig.from_env(env={}, port=-1)
+    with pytest.raises(ValueError):
+        NodeConfig.from_env(env={}, n_chips=0)
+    with pytest.raises(ValueError):
+        NodeConfig.from_env(env={}, log_level="loud")
+    with pytest.raises(ValueError):
+        NodeConfig.from_env(env={}, bus_uri="redis://x")
+    with pytest.raises(ValueError):
+        NodeConfig.from_env(env={}, coordinator="h:1")  # partial triple
+    with pytest.raises(ValueError):
+        NodeConfig.from_env(env={"RAFIKI_TPU_PORT": "not-a-number"})
+
+
+def test_multihost_triple_accepted():
+    cfg = NodeConfig.from_env(env={}, coordinator="h:1234",
+                              num_processes=2, process_id=0)
+    assert cfg.coordinator == "h:1234"
+
+
+def test_apply_env_round_trip(monkeypatch):
+    # setenv (not delenv) so monkeypatch restores the pre-test state
+    # even though apply_env() mutates os.environ during the test.
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_PIPELINE", "1")
+    monkeypatch.setenv("RAFIKI_TPU_CKPT", "")
+    cfg = NodeConfig.from_env(env={}, serving_pipeline=False,
+                              checkpoint_trials=True)
+    cfg.apply_env()
+    import os
+
+    assert os.environ["RAFIKI_TPU_SERVING_PIPELINE"] == "0"
+    assert os.environ["RAFIKI_TPU_CKPT"] == "1"
+    # Workers constructed now resolve the node's validated values.
+    from rafiki_tpu.bus import MemoryBus
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    w = InferenceWorker("s", "j", "t", None, None, MemoryBus())
+    assert w.pipeline is False
+
+
+def test_from_config_platform(tmp_path):
+    from rafiki_tpu.platform import LocalPlatform
+
+    cfg = NodeConfig.from_env(env={}, workdir=str(tmp_path / "n"),
+                              supervise_interval=0.0)
+    p = LocalPlatform.from_config(cfg)
+    try:
+        assert p.workdir == str(tmp_path / "n")
+        assert p.app is None
+    finally:
+        p.shutdown()

@@ -1,0 +1,251 @@
+"""Two-node scale-out rehearsal (VERDICT r1 item 9; SURVEY.md §2.10).
+
+Node A (primary) runs the admin + advisor + one train worker; node B is
+a real ``python -m rafiki_tpu join`` subprocess sharing A's meta store
+(sqlite file), params dir and TCP bus across a socket boundary. One
+train job's trials land on BOTH nodes' workers, coordinated by the one
+bus-hosted advisor.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from rafiki_tpu.bus import serve_broker
+from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+from rafiki_tpu.platform import LocalPlatform
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FF_CLASS = "rafiki_tpu.models.feedforward:JaxFeedForward"
+
+
+@pytest.fixture()
+def broker():
+    server = serve_broker("127.0.0.1", 0, native=False)
+    yield server
+    server.stop()
+
+
+@pytest.mark.slow
+def test_one_job_split_across_two_nodes(tmp_path, synth_image_data,
+                                        broker):
+    train_path, val_path = synth_image_data
+    shared = str(tmp_path / "shared")
+
+    node_a = LocalPlatform(workdir=shared, bus_uri=broker.uri,
+                           supervise_interval=0)
+    proc = None
+    try:
+        dev = node_a.admin.create_user("dev@x.c", "pw",
+                                       UserType.MODEL_DEVELOPER)
+        model = node_a.admin.create_model(
+            dev["id"], "ff", TaskType.IMAGE_CLASSIFICATION, FF_CLASS)
+        job = node_a.admin.create_train_job(
+            dev["id"], "app", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 10},
+            train_path, val_path)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("RAFIKI_TPU_PLATFORM", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "rafiki_tpu", "join",
+             "--workdir", shared, "--bus", broker.uri,
+             "--train-job", job["id"], "--timeout", "540"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+        assert node_a.admin.wait_until_train_job_done(job["id"],
+                                                      timeout=600)
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, out.decode()
+        assert b"attached 1 worker" in out, out.decode()
+
+        sub = node_a.meta.get_sub_train_jobs(job["id"])[0]
+        trials = node_a.meta.get_trials(sub["id"])
+        done = [t for t in trials if t["status"] == "COMPLETED"]
+        assert len(done) == 10
+
+        # Trials ran on BOTH nodes: the worker ids behind the completed
+        # trials must span services from two distinct node_ids.
+        node_ids = set()
+        for t in done:
+            svc = node_a.meta.get_service(t["worker_id"])
+            if svc is not None:
+                node_ids.add(svc["node_id"])
+        assert len(node_ids) >= 2, (
+            f"all trials ran on one node: {node_ids}")
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        node_a.shutdown()
+
+
+def test_secondary_shutdown_leaves_no_running_rows(tmp_path,
+                                                   synth_image_data,
+                                                   broker):
+    """Review finding r2: a join node leaving mid-job (timeout, crash
+    path through shutdown) must stop ITS services — leaked RUNNING rows
+    would read as a live remote worker forever and block the primary's
+    job-completion detection."""
+    train_path, val_path = synth_image_data
+    shared = str(tmp_path / "shared")
+    node_a = LocalPlatform(workdir=shared, bus_uri=broker.uri,
+                           supervise_interval=0)
+    node_b = None
+    try:
+        dev = node_a.admin.create_user("dev@x.c", "pw",
+                                       UserType.MODEL_DEVELOPER)
+        model = node_a.admin.create_model(
+            dev["id"], "ff", TaskType.IMAGE_CLASSIFICATION, FF_CLASS)
+        job = node_a.admin.create_train_job(
+            dev["id"], "app", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 6},
+            train_path, val_path)
+
+        node_b = LocalPlatform(workdir=shared, bus_uri=broker.uri,
+                               supervise_interval=0,
+                               stop_jobs_on_shutdown=False,
+                               node_id="vm/join-test")
+        attached = node_b.admin.attach_workers(job["id"])
+        assert attached
+        node_b.shutdown()  # leaves mid-job
+        node_b = None
+
+        rows = node_a.meta.get_services(node_id="vm/join-test")
+        assert rows and all(r["status"] not in
+                            ("RUNNING", "DEPLOYING", "STARTED")
+                            for r in rows), rows
+        # And the primary still completes the job on its own workers.
+        assert node_a.admin.wait_until_train_job_done(job["id"],
+                                                      timeout=600)
+    finally:
+        if node_b is not None:
+            node_b.shutdown()
+        node_a.shutdown()
+
+
+def test_restarted_node_sweeps_its_stale_rows(tmp_path):
+    """Review finding r2: node identity is stable across restarts of
+    the same host+workdir, so a crashed node's RUNNING rows are swept
+    (not orphaned) by the restarted process's supervise."""
+    from rafiki_tpu.constants import ServiceStatus, ServiceType
+
+    from rafiki_tpu.store import MetaStore
+
+    shared = str(tmp_path / "node")
+    p1 = LocalPlatform(workdir=shared, supervise_interval=0)
+    node_id = p1.services.node_id
+    p1.shutdown()
+    # Simulate a crash's aftermath: a RUNNING row (written before the
+    # crash) whose container no restarted process knows.
+    meta = MetaStore(shared + "/meta.db")
+    stale = meta.create_service(ServiceType.ADVISOR,
+                                ServiceStatus.RUNNING,
+                                container_id="gone", node_id=node_id)
+    meta.close()
+
+    p2 = LocalPlatform(workdir=shared, supervise_interval=0)
+    try:
+        assert p2.services.node_id == node_id  # stable identity
+        p2.services.supervise()
+        assert p2.meta.get_service(stale["id"])["status"] == \
+            ServiceStatus.ERRORED
+    finally:
+        p2.shutdown()
+
+
+def test_dead_foreign_node_lease_expires(tmp_path):
+    """Review finding r2: a join node that dies WITHOUT shutdown
+    (SIGKILL, power loss) must not block the primary forever — its
+    RUNNING rows are credible only while its heartbeat lease is fresh;
+    expiry makes train_services_active False and supervise marks the
+    rows ERRORED."""
+    import time as _time
+
+    from rafiki_tpu.constants import ServiceStatus, ServiceType
+
+    p = LocalPlatform(workdir=str(tmp_path / "n"), supervise_interval=0)
+    try:
+        job = p.meta.create_train_job("u", "app", "IMAGE_CLASSIFICATION",
+                                      {}, "tr", "va", status="RUNNING")
+        sub = p.meta.create_sub_train_job(job["id"], "m",
+                                          status="RUNNING")
+        svc = p.meta.create_service(ServiceType.TRAIN,
+                                    ServiceStatus.RUNNING,
+                                    container_id="gone",
+                                    node_id="otherhost/deadbeef")
+        p.meta.add_train_job_worker(svc["id"], sub["id"])
+
+        # Fresh lease (set at creation): trusted as live.
+        assert p.services.train_services_active(job["id"])
+        p.services.supervise()
+        assert p.meta.get_service(svc["id"])["status"] == \
+            ServiceStatus.RUNNING
+
+        # Lease expires: no longer live; sweep marks it errored.
+        p.meta.update_service(
+            svc["id"],
+            heartbeat_at=_time.time() - p.services.NODE_LEASE - 1)
+        assert not p.services.train_services_active(job["id"])
+        p.services.supervise()
+        assert p.meta.get_service(svc["id"])["status"] == \
+            ServiceStatus.ERRORED
+
+        # A heartbeat refreshes the lease for a node's own rows.
+        svc2 = p.meta.create_service(ServiceType.TRAIN,
+                                     ServiceStatus.RUNNING,
+                                     node_id="otherhost/deadbeef")
+        p.meta.update_service(
+            svc2["id"],
+            heartbeat_at=_time.time() - p.services.NODE_LEASE - 1)
+        p.meta.touch_node_services("otherhost/deadbeef")
+        fresh = p.meta.get_service(svc2["id"])["heartbeat_at"]
+        assert _time.time() - fresh < 5
+    finally:
+        p.shutdown()
+
+
+def test_jax_distributed_cpu_pair(tmp_path):
+    """The multi-host wiring (jax.distributed.initialize, the flags the
+    serve CLI passes) on a CPU pair: two processes, one coordinator,
+    global device count = 2."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    code = (
+        "import sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.distributed.initialize(\n"
+        "    coordinator_address='127.0.0.1:%d',\n"
+        "    num_processes=2, process_id=int(sys.argv[1]))\n"
+        "print('GLOBAL', jax.device_count(), 'LOCAL',\n"
+        "      jax.local_device_count())\n" % port)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1 local CPU device per process
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(i)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for i in range(2)]
+    outs = []
+    deadline = time.time() + 180
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(5.0,
+                                               deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode())
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "GLOBAL 2 LOCAL 1" in out, out

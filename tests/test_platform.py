@@ -367,3 +367,19 @@ def test_supervisor_thread_sweeps_automatically(tmp_path, synth_image_data):
         assert p.admin.wait_until_train_job_done(job["id"], timeout=600)
     finally:
         p.shutdown()
+
+
+def test_inference_pipeline_env_toggle(monkeypatch):
+    """RAFIKI_TPU_SERVING_PIPELINE=0 disables the one-burst-in-flight
+    overlap (the bench's on-vs-off comparison rides this)."""
+    from rafiki_tpu.bus import MemoryBus
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    bus = MemoryBus()
+    w = InferenceWorker("s", "j", "t", None, None, bus)
+    assert w.pipeline  # default: pipelined
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_PIPELINE", "0")
+    assert not InferenceWorker("s", "j", "t", None, None, bus).pipeline
+    # An explicit constructor arg beats the env var.
+    assert InferenceWorker("s", "j", "t", None, None, bus,
+                           pipeline=True).pipeline
