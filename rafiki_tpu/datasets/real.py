@@ -1,0 +1,74 @@
+"""Real-data converters from datasets bundled inside scikit-learn.
+
+SURVEY.md §7 flags "accuracy parity is demonstrable" as a hard part and
+the build environment has **zero egress**: fashion-MNIST / CIFAR-10
+cannot be downloaded (their converters in ``prep.py`` run whenever the
+standard distribution files are provided). scikit-learn, however, ships
+real datasets inside the package — the UCI handwritten digits (1,797
+real 8×8 grayscale scans), breast-cancer (Wisconsin diagnostic) and wine
+(UCI) tables — so accuracy parity is demonstrated on genuinely real data
+that every environment has.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from ..model.dataset import write_image_dataset_npz, write_tabular_dataset
+
+
+def _split(n: int, val_frac: float, seed: int) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    n_val = max(1, int(n * val_frac))
+    return order[n_val:], order[:n_val]
+
+
+def prepare_sklearn_digits(out_dir: str, val_frac: float = 0.2,
+                           seed: int = 0) -> Tuple[str, str]:
+    """UCI digits → platform image-dataset npz pair (train, val)."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    # 0..16 integer pixel values → uint8 0..255 image convention.
+    images = (d.images / 16.0 * 255).astype(np.uint8)[..., None]
+    labels = d.target.astype(np.int64)
+    tr, va = _split(len(labels), val_frac, seed)
+    os.makedirs(out_dir, exist_ok=True)
+    train = write_image_dataset_npz(
+        images[tr], labels[tr], os.path.join(out_dir, "digits_train.npz"),
+        10)
+    val = write_image_dataset_npz(
+        images[va], labels[va], os.path.join(out_dir, "digits_val.npz"), 10)
+    return train, val
+
+
+def prepare_sklearn_tabular(name: str, out_dir: str, val_frac: float = 0.2,
+                            seed: int = 0) -> Tuple[str, str]:
+    """A bundled sklearn table → platform CSV pair (train, val).
+
+    ``name``: ``breast_cancer`` (binary), ``wine`` (3-class), or
+    ``diabetes`` (regression).
+    """
+    import sklearn.datasets as skd
+
+    loaders = {"breast_cancer": skd.load_breast_cancer,
+               "wine": skd.load_wine, "diabetes": skd.load_diabetes}
+    d = loaders[name]()
+    features = np.asarray(d.data, dtype=np.float32)
+    targets = np.asarray(d.target)
+    tr, va = _split(len(targets), val_frac, seed)
+    os.makedirs(out_dir, exist_ok=True)
+    names = [str(n).replace(" ", "_") for n in
+             getattr(d, "feature_names", range(features.shape[1]))]
+    train = write_tabular_dataset(
+        features[tr], targets[tr],
+        os.path.join(out_dir, f"{name}_train.csv"), names)
+    val = write_tabular_dataset(
+        features[va], targets[va],
+        os.path.join(out_dir, f"{name}_val.csv"), names)
+    return train, val

@@ -31,6 +31,7 @@ def test_quickstart_local_synthetic():
     assert "QUICKSTART OK" in r.stdout
 
 
+@pytest.mark.slow
 def test_model_developer_upload_flow():
     r = _run("examples/scripts/model_developer.py", "--local", "--synthetic")
     assert r.returncode == 0, r.stdout + r.stderr
@@ -89,6 +90,7 @@ def test_dataset_prep_converters(tmp_path):
     assert load_image_dataset(val).size == 10
 
 
+@pytest.mark.slow
 def test_dataset_prep_cli_synthetic(tmp_path):
     r = _run("examples/datasets/cifar10.py", "--out-dir", str(tmp_path),
              "--synthetic", timeout=120)
@@ -98,7 +100,36 @@ def test_dataset_prep_cli_synthetic(tmp_path):
     assert tuple(ds.image_shape) == (32, 32, 3)
 
 
+@pytest.mark.slow
 def test_tasks_tour():
     r = _run("examples/scripts/tasks_tour.py", timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "TASKS TOUR OK" in r.stdout
+
+
+def test_sklearn_real_dataset_converters(tmp_path):
+    """Real-data path (zero-egress sandbox): the bundled-sklearn
+    converters produce valid platform datasets from genuinely real
+    scans/tables."""
+    from rafiki_tpu.datasets import (prepare_sklearn_digits,
+                                     prepare_sklearn_tabular)
+    from rafiki_tpu.model import load_image_dataset, load_tabular_dataset
+
+    train, val = prepare_sklearn_digits(str(tmp_path / "d"))
+    tr, va = load_image_dataset(train), load_image_dataset(val)
+    assert tuple(tr.image_shape) == (8, 8, 1)
+    assert tr.size + va.size == 1797 and va.size == 359
+    assert set(tr.labels) == set(range(10))
+
+    train, val = prepare_sklearn_tabular("wine", str(tmp_path / "w"))
+    ds = load_tabular_dataset(train)
+    assert ds.n_classes == 3 and ds.features.shape[1] == 13
+
+
+@pytest.mark.slow
+def test_accuracy_parity_script():
+    """The one-script accuracy-parity check (BASELINE.md table) stays
+    reproducible: every model lands in its published band."""
+    r = _run("examples/scripts/accuracy_parity.py", timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ACCURACY PARITY OK" in r.stdout
