@@ -1,5 +1,7 @@
 """JaxCnn: VGG-style zoo model with traced width mask."""
 
+import pytest
+
 import numpy as np
 
 from rafiki_tpu.constants import TaskType
@@ -10,6 +12,7 @@ KNOBS = {"width_16ths": 8, "learning_rate": 3e-3, "batch_size": 64,
          "weight_decay": 1e-4, "max_epochs": 10, "early_stop_epochs": 5}
 
 
+@pytest.mark.slow
 def test_cnn_end_to_end(synth_image_data):
     train_path, val_path = synth_image_data
     ds = load_image_dataset(val_path)
@@ -23,6 +26,7 @@ def test_cnn_end_to_end(synth_image_data):
         assert abs(sum(pred) - 1.0) < 1e-3
 
 
+@pytest.mark.slow
 def test_cnn_width_mask_shares_one_executable(synth_image_data):
     """Different width knobs must reuse the SAME compiled train step
     (that's the point of routing width through extra_apply_inputs)."""

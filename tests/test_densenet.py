@@ -4,6 +4,8 @@ Uses the tiny preset + small growth rate so a full end-to-end trial runs in
 seconds on the CPU mesh; the 121 preset is exercised shape-only.
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,6 +21,7 @@ TINY_KNOBS = {"arch": "densenet_tiny", "growth_rate": 8,
               "early_stop_epochs": 5, "quick_train": False}
 
 
+@pytest.mark.slow
 def test_densenet_end_to_end(synth_image_data):
     train_path, val_path = synth_image_data
     ds = load_image_dataset(val_path)
@@ -49,6 +52,7 @@ def test_densenet_121_shapes():
     assert logits.shape == (1, 10)
 
 
+@pytest.mark.slow
 def test_densenet_batchnorm_updates(synth_image_data):
     """batch_stats must exist, update during train, and round-trip."""
     train_path, _ = synth_image_data

@@ -7,6 +7,8 @@ architecture-independent so ParamStore weight sharing overlays every
 tensor. A tiny subclass keeps CPU runtime small.
 """
 
+import pytest
+
 import numpy as np
 
 from rafiki_tpu.advisor import EnasAdvisor
@@ -45,6 +47,7 @@ def _search_knobs(arch):
         "quick_train": True, "downscale": True})
 
 
+@pytest.mark.slow
 def test_supernet_one_compile_many_archs(synth_image_data):
     """Two different architectures must share one compiled train step."""
     train_path, val_path = synth_image_data
@@ -75,6 +78,7 @@ def test_supernet_one_compile_many_archs(synth_image_data):
     assert all(0.0 <= s <= 1.0 for s in scores)
 
 
+@pytest.mark.slow
 def test_supernet_param_tree_architecture_independent(synth_image_data):
     """Weight-sharing invariant: same tree for every architecture, and a
     dump from one arch warm-starts a trial of another."""
@@ -96,6 +100,7 @@ def test_supernet_param_tree_architecture_independent(synth_image_data):
     assert any("_sep5/" in k for k in dump1)
 
 
+@pytest.mark.slow
 def test_enas_fixed_arch_end_to_end(synth_image_data):
     """Final-phase mode: single-path net via test_model_class, incl.
     dump/load round-trip and predict."""
@@ -141,6 +146,7 @@ def test_enas_fixed_path_params_subset_of_supernet():
     assert fixed_keys <= sup_keys, fixed_keys - sup_keys
 
 
+@pytest.mark.slow
 def test_enas_search_loop_with_advisor_and_sharing(synth_image_data,
                                                    tmp_path):
     """End-to-end miniature of §3.5: EnasAdvisor proposes, TrialRunner

@@ -30,6 +30,7 @@ def _tenant(platform, i):
     return user, model
 
 
+@pytest.mark.slow
 def test_two_tenants_contend_and_complete(platform, synth_image_data):
     """Two jobs each claim half the slice; both run concurrently at full
     utilization and both finish with all trials completed."""
@@ -70,6 +71,7 @@ def test_two_tenants_contend_and_complete(platform, synth_image_data):
     assert platform.allocator.free_chips == platform.allocator.n_chips
 
 
+@pytest.mark.slow
 def test_oversubscribed_job_degrades_gracefully(platform, synth_image_data):
     """A job asking for more chips than the slice holds runs with fewer
     workers instead of failing (trials queue behind the smaller pool)."""

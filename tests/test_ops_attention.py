@@ -106,6 +106,7 @@ def test_flash_grads_match_naive(rng):
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_kv_mask_all_tiers(rng):
     # Key-padding mask: ragged batch of real lengths; every tier must
     # equal the naive oracle with the same mask.
@@ -141,6 +142,7 @@ def test_ring_attention_matches_full(rng, causal):
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads(rng):
     mesh = build_mesh(jax.devices(), sp=4)
     q, k, v = _qkv(rng, b=1, h=1, t=32, d=8)

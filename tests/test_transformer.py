@@ -23,6 +23,7 @@ def synth_corpus_data(tmp_path_factory):
                                          vocab=80, n_tags=5, max_len=10)
 
 
+@pytest.mark.slow
 def test_transformer_tagger_end_to_end(synth_corpus_data):
     train_path, val_path = synth_corpus_data
     ds = load_corpus_dataset(val_path)
@@ -39,6 +40,7 @@ def test_transformer_tagger_end_to_end(synth_corpus_data):
             assert abs(sum(dist) - 1.0) < 1e-3
 
 
+@pytest.mark.slow
 def test_transformer_tagger_sequence_parallel(synth_corpus_data):
     # sp=4 on the 8-device mesh: sequence dim sharded, ring attention
     # over ppermute; must train and score like the sp=1 model.
