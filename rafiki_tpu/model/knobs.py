@@ -342,6 +342,12 @@ def validate_knobs(knob_config: KnobConfig, knobs: Knobs) -> Knobs:
     out = {}
     for name, knob in knob_config.items():
         if name not in knobs:
+            if isinstance(knob, FixedKnob):
+                # Fixed (deployment) knobs default to their pinned
+                # value, so trial rows recorded before a model gained a
+                # new FixedKnob stay loadable.
+                out[name] = knob.value
+                continue
             raise ValueError(f"Missing knob: {name}")
         out[name] = knob.validate(knobs[name])
     return out

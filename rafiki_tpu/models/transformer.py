@@ -9,8 +9,11 @@ sequences are first-class:
   XLA fallback elsewhere) — O(block) memory, so ``max_len`` can grow far
   past what a materialised T×T score matrix allows;
 - ``sequence_parallel`` knob > 1: the sequence dimension shards over the
-  ``sp`` mesh axis and attention runs as a ``ppermute`` ring over ICI
-  (``ring_attention``), scaling context length with the chip group.
+  ``sp`` mesh axis and attention runs context-parallel over ICI —
+  a ``ppermute`` ring (``ring_attention``, the default) or the Ulysses
+  all-to-all head re-sharding (``sp_schedule="alltoall"``, needs
+  ``n_heads % sequence_parallel == 0``) — scaling context length with
+  the chip group.
 
 Same corpus-dataset contract, hashed vocabulary, and per-token
 probability output as ``JaxPosTagger``, so the Advisor, TrainWorker, and
@@ -127,8 +130,13 @@ class JaxTransformerTagger(BaseModel):
             "max_len": CategoricalKnob([32, 64, 128, 256, 512]),
             "dropout": FloatKnob(0.0, 0.3),
             "vocab_size": FixedKnob(16384),
-            # > 1 shards the sequence dim over sp chips (ring attention).
+            # > 1 shards the sequence dim over sp chips.
             "sequence_parallel": FixedKnob(1),
+            # Context-parallel schedule when sequence_parallel > 1:
+            # "ring" (ppermute K/V rotation, T/n working set) or
+            # "alltoall" (Ulysses head re-sharding, two collectives;
+            # needs n_heads % sequence_parallel == 0).
+            "sp_schedule": FixedKnob("ring"),
         }
 
     def __init__(self, **knobs: Any):
@@ -157,8 +165,9 @@ class JaxTransformerTagger(BaseModel):
         """
         mesh = self.mesh
         if mesh.shape[SP_AXIS] > 1:
+            mode = str(self.knobs.get("sp_schedule", "ring"))
             return lambda q, k, v, kv_mask: sequence_sharded_attention(
-                q, k, v, mesh, causal=False, kv_mask=kv_mask)
+                q, k, v, mesh, causal=False, kv_mask=kv_mask, mode=mode)
         if jax.default_backend() in ("tpu", "axon"):
             return lambda q, k, v, kv_mask: flash_attention(
                 q, k, v, causal=False, kv_mask=kv_mask)

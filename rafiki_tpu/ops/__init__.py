@@ -2,15 +2,17 @@
 
 Hot ops the zoo models call into: Pallas TPU kernels where a hand
 schedule beats XLA fusion, pure-XLA blockwise formulations everywhere
-else, and shard_map ring collectives for sequence parallelism over the
-``sp`` mesh axis (SURVEY.md §5 — absent upstream, first-class here).
+else, and both context-parallel schedules — ring (ppermute K/V
+rotation) and Ulysses (all-to-all head re-sharding) — for sequence
+parallelism over the ``sp`` mesh axis (SURVEY.md §5 — absent upstream,
+first-class here).
 """
 
 from .attention import (blockwise_attention, flash_attention,
                         naive_attention, ring_attention,
-                        sequence_sharded_attention)
+                        sequence_sharded_attention, ulysses_attention)
 
 __all__ = [
     "blockwise_attention", "flash_attention", "naive_attention",
-    "ring_attention", "sequence_sharded_attention",
+    "ring_attention", "sequence_sharded_attention", "ulysses_attention",
 ]

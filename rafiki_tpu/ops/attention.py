@@ -19,6 +19,10 @@ Three tiers, one numerical scheme (the online-softmax merge):
   each chip holds a sequence shard, K/V shards rotate around the ICI ring
   via ``lax.ppermute`` while the online-softmax accumulator absorbs one
   shard per step; compute and the next hop overlap inside one XLA program.
+- ``ulysses_attention`` — the all-to-all schedule: one ``all_to_all``
+  re-shards sequence-split inputs to head-split, full-T attention runs
+  locally per head subset, a second ``all_to_all`` restores sequence
+  sharding (needs ``heads % sp == 0``).
 
 All take ``(batch, heads, seq, head_dim)`` arrays.
 """
@@ -397,22 +401,87 @@ def ring_attention(q, k, v, *, axis_name: str = SP_AXIS,
     return _finish(o, l).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Ulysses attention (all-to-all sequence parallelism over the sp axis)
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = SP_AXIS,
+                      causal: bool = False,
+                      axis_size: Optional[int] = None, kv_mask=None,
+                      interpret: Optional[bool] = None):
+    """All-to-all sequence parallelism inside ``shard_map``.
+
+    The complement to :func:`ring_attention` (the two standard
+    context-parallel schedules): instead of rotating K/V shards n times
+    around the ICI ring, ONE ``all_to_all`` re-shards the inputs from
+    sequence-split ``(B, H, T/n, D)`` to head-split ``(B, H/n, T, D)``,
+    each chip runs ordinary full-sequence attention over its head
+    subset (the Pallas flash kernel on TPU), and a second ``all_to_all``
+    restores sequence sharding. Two collectives total — cheaper than
+    the ring's n hops when heads divide evenly and the full-T score
+    working set fits one chip's attention tier; the ring remains the
+    choice for extreme T (its K/V working set stays T/n per chip).
+
+    Requires ``H % n == 0``. ``kv_mask`` is the local ``(B, T/n)``
+    shard; it is all-gathered (tiny, bool) to mask the full sequence.
+    """
+    if axis_size is None:
+        axis_size = jax.lax.psum(1, axis_name)
+        if not isinstance(axis_size, int):
+            axis_size = int(axis_size)
+    n = axis_size
+    b, h, t_local, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"ulysses needs heads % sp == 0; got {h} % {n}")
+
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    mask_full = None
+    if kv_mask is not None:
+        mask_full = jax.lax.all_gather(kv_mask, axis_name, axis=1,
+                                       tiled=True)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    if interpret:
+        # Pure-XLA tier: the Pallas interpreter inside shard_map on the
+        # CPU mesh is needlessly slow for tests.
+        out = blockwise_attention(qh, kh, vh, causal=causal,
+                                  kv_mask=mask_full)
+    else:
+        out = flash_attention(qh, kh, vh, causal=causal,
+                              kv_mask=mask_full, interpret=False)
+    return jax.lax.all_to_all(out, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+
 def sequence_sharded_attention(q, k, v, mesh, *, causal: bool = False,
                                batch_axis: Optional[str] = DP_AXIS,
-                               kv_mask=None):
+                               kv_mask=None, mode: str = "ring"):
     """Convenience wrapper: shard q/k/v ``(B, H, T, D)`` with batch over
-    ``dp`` and sequence over ``sp``, and run ``ring_attention`` under
-    ``shard_map`` on ``mesh``. ``kv_mask`` (B, T) bool shards with k."""
+    ``dp`` and sequence over ``sp``, and run the chosen schedule under
+    ``shard_map`` on ``mesh``. ``kv_mask`` (B, T) bool shards with k.
+
+    ``mode``: ``"ring"`` (ppermute K/V rotation; T/n working set per
+    chip) or ``"alltoall"`` (Ulysses head re-sharding; two collectives,
+    needs heads % sp == 0).
+    """
     sp = mesh.shape[SP_AXIS]
     spec = P(batch_axis, None, SP_AXIS, None)
     mask_spec = P(batch_axis, SP_AXIS)
+    if mode not in ("ring", "alltoall"):
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}")
+    inner = ring_attention if mode == "ring" else ulysses_attention
 
     if kv_mask is None:
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
         def run(q_, k_, v_):
-            return ring_attention(q_, k_, v_, causal=causal, axis_size=sp)
+            return inner(q_, k_, v_, causal=causal, axis_size=sp)
 
         return run(q, k, v)
 
@@ -420,7 +489,7 @@ def sequence_sharded_attention(q, k, v, mesh, *, causal: bool = False,
                        in_specs=(spec, spec, spec, mask_spec),
                        out_specs=spec, check_vma=False)
     def run_masked(q_, k_, v_, mask_):
-        return ring_attention(q_, k_, v_, causal=causal, axis_size=sp,
-                              kv_mask=mask_)
+        return inner(q_, k_, v_, causal=causal, axis_size=sp,
+                     kv_mask=mask_)
 
     return run_masked(q, k, v, kv_mask)

@@ -76,3 +76,17 @@ def test_arch_knob(rng):
         knob.validate([0, 5, 0])
     with pytest.raises(ValueError):
         knob.validate([0, 1])
+
+
+def test_validate_defaults_missing_fixed_knobs():
+    """Trial rows recorded before a model gained a new FixedKnob stay
+    loadable: missing fixed (deployment) knobs default to their pinned
+    value; searchable knobs stay required."""
+    from rafiki_tpu.model.knobs import (FixedKnob, IntegerKnob,
+                                        validate_knobs)
+
+    config = {"width": IntegerKnob(1, 8), "mode": FixedKnob("ring")}
+    out = validate_knobs(config, {"width": 4})
+    assert out == {"width": 4, "mode": "ring"}
+    with pytest.raises(ValueError, match="Missing knob: width"):
+        validate_knobs(config, {"mode": "ring"})

@@ -41,14 +41,20 @@ def test_transformer_tagger_end_to_end(synth_corpus_data):
 
 
 @pytest.mark.slow
-def test_transformer_tagger_sequence_parallel(synth_corpus_data):
-    # sp=4 on the 8-device mesh: sequence dim sharded, ring attention
-    # over ppermute; must train and score like the sp=1 model.
+@pytest.mark.parametrize("sp_schedule", ["ring", "alltoall"])
+def test_transformer_tagger_sequence_parallel(synth_corpus_data,
+                                               sp_schedule):
+    # sp=4 on the 8-device mesh: sequence dim sharded over either
+    # context-parallel schedule (ring ppermute / Ulysses all-to-all);
+    # must train and score like the sp=1 model.
     train_path, val_path = synth_corpus_data
     # sequence_parallel is a deployment knob (FixedKnob(1) in the search
     # space); operators override it at construction, bypassing the
     # advisor-facing validation.
-    knobs = dict(KNOBS, sequence_parallel=4)
+    # Ulysses re-shards heads over sp, so it needs n_heads % sp == 0.
+    knobs = dict(KNOBS, sequence_parallel=4, sp_schedule=sp_schedule,
+                 n_heads=4 if sp_schedule == "alltoall"
+                 else KNOBS["n_heads"])
     model = JaxTransformerTagger(**knobs)
     assert model.mesh.shape["sp"] == 4
     assert model.mesh.shape["dp"] == len(jax.devices()) // 4
