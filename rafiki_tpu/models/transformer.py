@@ -42,9 +42,9 @@ from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
 from ..ops import (blockwise_attention, flash_attention,
-                   sequence_sharded_attention)
+                   sequence_sharded_attention, switch_moe)
 from ..parallel import (DP_AXIS, SP_AXIS, batch_sharding, build_mesh,
-                        replicated)
+                        replicated, shard_variables)
 from ..parallel.chips import ChipGroup
 
 def _sinusoidal(max_len: int, dim: int) -> np.ndarray:
@@ -58,10 +58,18 @@ def _sinusoidal(max_len: int, dim: int) -> np.ndarray:
 
 class _EncoderBlock(nn.Module):
     """Pre-LN encoder block; attention is injected so the same module
-    serves flash (single group) and ring (sequence-parallel) execution."""
+    serves flash (single group) and sequence-parallel execution.
+
+    ``moe_experts > 0`` replaces the dense FFN with a Switch-routed
+    expert FFN (``rafiki_tpu.ops.switch_moe``); the expert-stacked
+    parameters' names contain ``expert`` so the sharding rules place
+    them over the ``ep`` mesh axis. The router's load-balance loss is
+    sown into the ``losses`` collection for the train step to collect.
+    """
     n_heads: int
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, x, attn_fn, kv_mask, *, deterministic: bool):
@@ -81,6 +89,26 @@ class _EncoderBlock(nn.Module):
         x = x + nn.Dense(d_model, use_bias=False, dtype=self.dtype)(o)
 
         h = nn.LayerNorm(dtype=jnp.float32)(x)
+        if self.moe_experts > 0:
+            e, f = self.moe_experts, 4 * d_model
+            init = nn.initializers.lecun_normal()
+            gate_w = self.param("moe_gate", init, (d_model, e),
+                                jnp.float32)
+            w1 = self.param("expert_w1", init, (e, d_model, f),
+                            self.dtype)
+            b1 = self.param("expert_b1", nn.initializers.zeros, (e, f),
+                            self.dtype)
+            w2 = self.param("expert_w2", init, (e, f, d_model),
+                            self.dtype)
+            b2 = self.param("expert_b2", nn.initializers.zeros,
+                            (e, d_model), self.dtype)
+            tokens = h.astype(self.dtype).reshape(b * t, d_model)
+            out, aux = switch_moe(tokens, gate_w, w1, b1, w2, b2,
+                                  token_mask=kv_mask.reshape(b * t))
+            self.sow("losses", "moe_aux", aux)
+            out = nn.Dropout(self.dropout,
+                             deterministic=deterministic)(out)
+            return x + out.reshape(b, t, d_model)
         h = nn.Dense(4 * d_model, dtype=self.dtype)(h)
         h = nn.gelu(h)
         h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
@@ -96,6 +124,7 @@ class _TransformerTagger(nn.Module):
     max_len: int
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, ids, attn_fn, *, train: bool = False):
@@ -106,7 +135,8 @@ class _TransformerTagger(nn.Module):
         x = x + pe[None, :ids.shape[1]].astype(x.dtype)
         for _ in range(self.n_layers):
             x = _EncoderBlock(self.n_heads, dropout=self.dropout,
-                              dtype=self.dtype)(
+                              dtype=self.dtype,
+                              moe_experts=self.moe_experts)(
                 x, attn_fn, kv_mask, deterministic=not train)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.n_tags, dtype=jnp.float32)(x)
@@ -137,6 +167,11 @@ class JaxTransformerTagger(BaseModel):
             # "alltoall" (Ulysses head re-sharding, two collectives;
             # needs n_heads % sequence_parallel == 0).
             "sp_schedule": FixedKnob("ring"),
+            # > 0 replaces each block's dense FFN with a Switch-routed
+            # mixture of experts (top-1, capacity-dropped); experts
+            # shard over the ep mesh axis set by expert_parallel.
+            "moe_experts": FixedKnob(0),
+            "expert_parallel": FixedKnob(1),
         }
 
     def __init__(self, **knobs: Any):
@@ -154,7 +189,17 @@ class JaxTransformerTagger(BaseModel):
     def mesh(self):
         if self._mesh is None:
             sp = int(self.knobs.get("sequence_parallel", 1))
-            self._mesh = build_mesh(ChipGroup.current().devices(), sp=sp)
+            ep = int(self.knobs.get("expert_parallel", 1))
+            experts = int(self.knobs.get("moe_experts", 0))
+            if ep > 1 and (experts == 0 or experts % ep != 0):
+                # Silent fallback would pay the smaller dp axis while
+                # the ep axis idles (dense model) or every expert
+                # replicates (indivisible stack) — reject loudly.
+                raise ValueError(
+                    f"expert_parallel ({ep}) needs moe_experts set and "
+                    f"divisible by it (got moe_experts={experts})")
+            self._mesh = build_mesh(ChipGroup.current().devices(), sp=sp,
+                                    ep=ep)
         return self._mesh
 
     def _attn_fn(self):
@@ -183,7 +228,8 @@ class JaxTransformerTagger(BaseModel):
                 n_layers=int(self.knobs.get("n_layers", 2)),
                 n_tags=n_tags,
                 max_len=int(self.knobs.get("max_len", 128)),
-                dropout=float(self.knobs.get("dropout", 0.0)))
+                dropout=float(self.knobs.get("dropout", 0.0)),
+                moe_experts=int(self.knobs.get("moe_experts", 0)))
 
     def _encode(self, sentences: List[List[str]]):
         max_len = int(self.knobs.get("max_len", 128))
@@ -231,7 +277,10 @@ class JaxTransformerTagger(BaseModel):
                 if kk in flat and tuple(flat[kk].shape) == tuple(vv.shape):
                     flat[kk] = jnp.asarray(vv)
             variables = traverse_util.unflatten_dict(flat, sep="/")
-        params = jax.device_put(variables["params"], replicated(mesh))
+        # Expert-stacked leaves shard over ep, everything else
+        # replicates (shard_variables' rules; with ep == 1 this is the
+        # plain replicated placement).
+        params = shard_variables(variables, mesh)["params"]
 
         cache_key = step_cache_key(self, "train", mesh, steps, max_epochs)
         cached = _step_cache_get(cache_key)
@@ -250,16 +299,22 @@ class JaxTransformerTagger(BaseModel):
             @jax.jit
             def train_step(params, opt_state, ids, lengths, tags, step_i):
                 def loss_fn(p):
-                    logits = module.apply(
+                    logits, mods = module.apply(
                         {"params": p}, ids, attn, train=True,
                         rngs={"dropout": jax.random.fold_in(drop_key,
-                                                            step_i)})
+                                                            step_i)},
+                        mutable=["losses"])
                     mask = (jnp.arange(logits.shape[1])[None, :]
                             < lengths[:, None]).astype(jnp.float32)
                     losses = optax.softmax_cross_entropy_with_integer_labels(
                         logits, tags)
                     loss = (losses * mask).sum() / jnp.maximum(mask.sum(),
                                                                1)
+                    # Router load-balance terms sown by MoE blocks
+                    # (empty collection for dense models).
+                    aux = sum(jax.tree_util.tree_leaves(
+                        mods.get("losses", {})))
+                    loss = loss + 0.01 * aux
                     correct = ((logits.argmax(-1) == tags) * mask).sum() \
                         / jnp.maximum(mask.sum(), 1)
                     return loss, correct
@@ -328,8 +383,10 @@ class JaxTransformerTagger(BaseModel):
         self._ensure_module(len(self._meta["tag_names"]))
         dp = self.mesh.shape[DP_AXIS]
         if self._vars_dev is None:
-            self._vars_dev = jax.device_put(
-                self._variables, replicated(self.mesh))
+            # Same placement rules as training: expert stacks shard
+            # over ep (replicating them would cost ep× HBM at
+            # inference), everything else replicates.
+            self._vars_dev = shard_variables(self._variables, self.mesh)
         if self._predict_fn is None:
             module, attn = self._module, self._attn_fn()
             self._predict_fn = jax.jit(

@@ -11,8 +11,11 @@ first-class here).
 from .attention import (blockwise_attention, flash_attention,
                         naive_attention, ring_attention,
                         sequence_sharded_attention, ulysses_attention)
+from .moe import switch_moe
+from .pipeline import pipeline_apply, pipelined
 
 __all__ = [
     "blockwise_attention", "flash_attention", "naive_attention",
-    "ring_attention", "sequence_sharded_attention", "ulysses_attention",
+    "pipeline_apply", "pipelined", "ring_attention",
+    "sequence_sharded_attention", "switch_moe", "ulysses_attention",
 ]

@@ -1,0 +1,114 @@
+"""Switch-style mixture-of-experts FFN with expert parallelism.
+
+Beyond-parity op (SURVEY.md §2.9: expert parallelism absent upstream):
+a top-1 (Switch) routed expert feed-forward expressed entirely as
+einsums over a leading expert axis, so sharding that axis over the
+``ep`` mesh axis (``rafiki_tpu.parallel.build_mesh(..., ep=n)``; expert
+parameters get ``PartitionSpec("ep", ...)``) makes XLA partition the
+expert compute across chips and insert the dispatch/combine
+all-to-alls + psum itself — the annotate-and-let-XLA-partition recipe,
+no hand-written collectives.
+
+Routing is **group-local** (the GShard/Switch formulation): tokens are
+processed in fixed-size groups, each with its own per-expert capacity
+``ceil(capacity_factor · group / E)``. This bounds the dispatch one-hot
+at O(capacity_factor · group²) per group — linear in total tokens —
+where a single global dispatch would be quadratic in N. Tokens over
+capacity are dropped (their FFN output is zero — the caller's residual
+connection passes them through unchanged), keeping every shape static
+for XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _switch_group(x, mask, gate_w, w1, b1, w2, b2, *, capacity: int):
+    """Route one token group. x (G, D); mask (G,) True = real token."""
+    e = gate_w.shape[1]
+
+    logits = jnp.einsum("nd,de->ne", x.astype(jnp.float32),
+                        gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)              # (G, E)
+    expert = jnp.argmax(probs, axis=-1)                  # (G,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+    # Padding tokens neither claim capacity slots nor influence the
+    # router statistics.
+    onehot = onehot * mask[:, None]
+
+    # Slot index of each token within its expert (first-come order);
+    # tokens past the expert's capacity are dropped.
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (G, E)
+    in_cap = (position >= 0) & (position < capacity)
+    dispatch = onehot * in_cap                            # (G, E)
+    slots = jax.nn.one_hot(jnp.clip(position, 0, capacity - 1).astype(
+        jnp.int32), capacity, dtype=jnp.float32)          # (G, E, C)
+    disp = slots * dispatch[..., None]                    # (G, E, C)
+
+    xe = jnp.einsum("nec,nd->ecd", disp, x.astype(jnp.float32))
+    xe = xe.astype(x.dtype)
+    h = jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None]
+    h = jax.nn.gelu(h)
+    ye = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None]  # (E, C, D)
+    combine = disp * gate[:, None, None]
+    out = jnp.einsum("nec,ecd->nd", combine,
+                     ye.astype(jnp.float32)).astype(x.dtype)
+
+    # Switch aux loss over REAL tokens: E · Σ_e (token fraction)·(prob
+    # mass fraction); ≈1 at near-uniform routing (not a hard bound).
+    denom = jnp.maximum(mask.sum(), 1.0)
+    frac_tokens = onehot.sum(axis=0) / denom
+    frac_probs = (probs * mask[:, None]).sum(axis=0) / denom
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return out, aux
+
+
+def switch_moe(x, gate_w, w1, b1, w2, b2, *,
+               capacity_factor: float = 1.25,
+               token_mask: Optional[jnp.ndarray] = None,
+               group_size: int = 1024,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 routed expert FFN over flattened tokens.
+
+    Args:
+      x: (N, D) tokens (callers flatten batch × seq).
+      gate_w: (D, E) router weights (compute runs in f32).
+      w1, b1: (E, D, F), (E, F) first expert layer.
+      w2, b2: (E, F, D), (E, D) second expert layer.
+      capacity_factor: per-expert slot head-room over the uniform share.
+      token_mask: (N,) bool, True = real token. Padding tokens are
+        never routed: they claim no capacity, contribute nothing to the
+        router statistics, and get zero output.
+      group_size: routing-group length (capacity is per group).
+
+    Returns ``(out, aux)``: ``out`` (N, D) combined expert outputs
+    (zero rows for dropped/masked tokens), ``aux`` the mean Switch
+    load-balancing loss across groups (add a small multiple to the
+    training loss).
+    """
+    n, d = x.shape
+    e = gate_w.shape[1]
+    if token_mask is None:
+        token_mask = jnp.ones((n,), bool)
+    g = min(group_size, n)
+    n_groups = -(-n // g)
+    pad = n_groups * g - n
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        token_mask = jnp.pad(token_mask, (0, pad))
+    capacity = max(1, math.ceil(capacity_factor * g / e))
+
+    run = functools.partial(_switch_group, capacity=capacity)
+    out, aux = jax.vmap(run, in_axes=(0, 0, None, None, None, None,
+                                      None))(
+        x.reshape(n_groups, g, d),
+        token_mask.reshape(n_groups, g).astype(jnp.float32),
+        gate_w, w1, b1, w2, b2)
+    return out.reshape(n_groups * g, d)[:n], aux.mean()

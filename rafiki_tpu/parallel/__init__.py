@@ -1,12 +1,15 @@
 """Parallel execution layer: chip groups, meshes, sharding rules."""
 
 from .chips import ChipAllocator, ChipGroup
-from .mesh import (DP_AXIS, SP_AXIS, TP_AXIS, batch_sharding, build_mesh,
+from .mesh import (DP_AXIS, EP_AXIS, PP_AXIS, SP_AXIS, TP_AXIS,
+                   batch_sharding,
+                   build_mesh,
                    param_spec, replicated, shard_variables,
                    variables_shardings)
 
 __all__ = [
     "ChipAllocator", "ChipGroup",
-    "DP_AXIS", "SP_AXIS", "TP_AXIS", "build_mesh", "batch_sharding",
+    "DP_AXIS", "EP_AXIS", "PP_AXIS", "SP_AXIS", "TP_AXIS", "build_mesh",
+    "batch_sharding",
     "replicated", "param_spec", "shard_variables", "variables_shardings",
 ]

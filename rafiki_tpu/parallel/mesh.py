@@ -1,14 +1,19 @@
 """Mesh construction and sharding rules for trial execution.
 
-The platform's intra-trial parallelism (SURVEY.md §2.9): each trial trains
-under ``jax.jit`` over a 3-D ``Mesh`` with axes ``("dp", "sp", "tp")``
-built from its chip group — batch data-parallel over ``dp``, sequence /
-context parallelism over ``sp`` (long sequences split across chips; the
-ring-attention op in ``rafiki_tpu.ops`` rotates K/V shards over ICI), and
-optional tensor-parallel sharding of large kernels over ``tp``. XLA
-inserts the ICI collectives (psum for grads on ``dp``, all-gather /
-reduce-scatter on ``tp``); only the ring schedule issues a collective
-(``ppermute``) by hand.
+The platform's intra-trial parallelism (SURVEY.md §2.9): each trial
+trains under ``jax.jit`` over a ``Mesh`` with axes
+``("dp", "pp", "ep", "sp", "tp")`` built from its chip group — batch
+data-parallel over ``dp``, GPipe pipeline stages over ``pp``
+(``rafiki_tpu.ops.pipeline``), mixture-of-experts expert parallelism
+over ``ep`` (each chip subset holds a slice of the expert stack; XLA
+turns the routing einsums into all-to-alls), sequence / context
+parallelism over ``sp`` (long sequences split across chips; the ring /
+all-to-all attention schedules in ``rafiki_tpu.ops`` move K/V or heads
+over ICI), and optional tensor-parallel sharding of large kernels over
+``tp``. XLA inserts the ICI collectives (psum for grads on ``dp``,
+all-gather / reduce-scatter on ``tp``, all-to-all + psum on ``ep``);
+only the ring and pipeline schedules issue collectives (``ppermute``)
+by hand.
 """
 
 from __future__ import annotations
@@ -20,6 +25,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DP_AXIS = "dp"
+PP_AXIS = "pp"
+EP_AXIS = "ep"
 SP_AXIS = "sp"
 TP_AXIS = "tp"
 
@@ -35,27 +42,30 @@ _MESH_CACHE: dict = {}
 
 
 def build_mesh(devices: Optional[Sequence[Any]] = None, tp: int = 1,
-               sp: int = 1) -> Mesh:
-    """Arrange ``devices`` into a (dp, sp, tp) mesh; dp = n / (sp * tp).
+               sp: int = 1, ep: int = 1, pp: int = 1) -> Mesh:
+    """Arrange ``devices`` into a (dp, pp, ep, sp, tp) mesh;
+    dp = n / (pp * ep * sp * tp).
 
     Axis order puts ``tp`` fastest-varying (adjacent devices — its
-    all-gathers are the most latency-sensitive collectives), then ``sp``:
-    with ``tp == 1`` (the common case) ring-attention's ``ppermute``
-    hops between devices adjacent in device order; with ``tp > 1`` the
-    sp ring hops stride ``tp``.
+    all-gathers are the most latency-sensitive collectives), then ``sp``
+    (with ``tp == 1``, the common case, ring-attention's ``ppermute``
+    hops between devices adjacent in device order), then ``ep``/``pp``
+    (expert all-to-alls and per-tick pipeline hops tolerate longer hops
+    than the per-layer tp/sp traffic).
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
     n = len(devices)
-    if n % (tp * sp) != 0:
-        raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-    key = (tuple(devices), tp, sp)
+    if n % (tp * sp * ep * pp) != 0:
+        raise ValueError(f"{n} devices not divisible by pp*ep*sp*tp="
+                         f"{pp * ep * sp * tp}")
+    key = (tuple(devices), tp, sp, ep, pp)
     mesh = _MESH_CACHE.get(key)
     if mesh is None:
         arr = np.asarray(devices, dtype=object).reshape(
-            n // (sp * tp), sp, tp)
-        mesh = Mesh(arr, (DP_AXIS, SP_AXIS, TP_AXIS))
+            n // (pp * ep * sp * tp), pp, ep, sp, tp)
+        mesh = Mesh(arr, (DP_AXIS, PP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS))
         _MESH_CACHE[key] = mesh
     return mesh
 
@@ -69,15 +79,26 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def param_spec(arr: Any, tp: int) -> P:
+def param_spec(arr: Any, tp: int, ep: int = 1, name: str = "",
+               pp: int = 1) -> P:
     """Partition rule for one parameter.
 
+    Stage-stacked parameters — leaves whose tree path contains
+    ``stage`` with a leading axis of length ``pp`` — shard that axis
+    over ``pp`` (each pipeline stage holds its layer span's params).
+    Expert-stacked parameters — leaves whose tree path contains
+    ``expert`` with a leading axis divisible by ``ep`` — shard that
+    axis over ``ep`` (each ep group holds a slice of the expert stack).
     Dense/conv kernels with a large output-feature axis shard that axis
-    over ``tp`` (column parallelism — each tp shard computes a slice of the
-    output features; XLA all-gathers activations where needed). Biases,
-    norms, and small kernels replicate.
+    over ``tp`` (column parallelism — each tp shard computes a slice of
+    the output features; XLA all-gathers activations where needed).
+    Biases, norms, and small kernels replicate.
     """
     shape = getattr(arr, "shape", ())
+    if pp > 1 and "stage" in name and shape and shape[0] == pp:
+        return P(PP_AXIS, *([None] * (len(shape) - 1)))
+    if ep > 1 and "expert" in name and shape and shape[0] % ep == 0:
+        return P(EP_AXIS, *([None] * (len(shape) - 1)))
     if tp <= 1 or len(shape) < 2:
         return P()
     out_features = shape[-1]
@@ -86,17 +107,30 @@ def param_spec(arr: Any, tp: int) -> P:
     return P()
 
 
+def _path_name(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path).lower()
+
+
+def _mesh_axis(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.axis_names else 1
+
+
 def shard_variables(variables: Any, mesh: Mesh) -> Any:
     """Device-put a variables pytree with per-leaf NamedShardings."""
     tp = mesh.shape[TP_AXIS]
-    return jax.tree_util.tree_map(
-        lambda leaf: jax.device_put(
-            leaf, NamedSharding(mesh, param_spec(leaf, tp))),
+    ep, pp = _mesh_axis(mesh, EP_AXIS), _mesh_axis(mesh, PP_AXIS)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jax.device_put(
+            leaf, NamedSharding(mesh, param_spec(
+                leaf, tp, ep=ep, pp=pp, name=_path_name(path)))),
         variables)
 
 
 def variables_shardings(variables: Any, mesh: Mesh) -> Any:
     """The NamedSharding pytree matching ``shard_variables``' placement."""
     tp = mesh.shape[TP_AXIS]
-    return jax.tree_util.tree_map(
-        lambda leaf: NamedSharding(mesh, param_spec(leaf, tp)), variables)
+    ep, pp = _mesh_axis(mesh, EP_AXIS), _mesh_axis(mesh, PP_AXIS)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, param_spec(
+            leaf, tp, ep=ep, pp=pp, name=_path_name(path))), variables)

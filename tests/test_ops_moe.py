@@ -1,0 +1,190 @@
+"""Switch-MoE op: routing exactness, capacity, aux loss, ep sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rafiki_tpu.ops import switch_moe
+from rafiki_tpu.parallel import build_mesh, shard_variables
+
+
+def _params(rng, e=4, d=8, f=16, dtype=jnp.float32):
+    def r(*shape):
+        return jnp.asarray(rng.standard_normal(shape) * 0.3, dtype)
+
+    return {"gate_w": r(d, e), "w1": r(e, d, f), "b1": r(e, f),
+            "w2": r(e, f, d), "b2": r(e, d)}
+
+
+def _manual(x, p):
+    """Per-token reference: gate prob × its top-1 expert's FFN."""
+    logits = np.asarray(x, np.float32) @ np.asarray(p["gate_w"],
+                                                    np.float32)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.zeros_like(np.asarray(x, np.float32))
+    for i in range(x.shape[0]):
+        e = int(np.argmax(probs[i]))
+        h = np.asarray(x[i], np.float32) @ np.asarray(p["w1"][e],
+                                                      np.float32) \
+            + np.asarray(p["b1"][e], np.float32)
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+        y = h @ np.asarray(p["w2"][e], np.float32) \
+            + np.asarray(p["b2"][e], np.float32)
+        out[i] = probs[i, e] * y
+    return out
+
+
+def test_moe_matches_per_token_reference(rng):
+    x = jnp.asarray(rng.standard_normal((24, 8)), jnp.float32)
+    p = _params(rng)
+    # Ample capacity: no token is dropped, output must equal the
+    # per-token reference exactly.
+    out, aux = switch_moe(x, **p, capacity_factor=4.0)
+    np.testing.assert_allclose(np.asarray(out), _manual(x, p),
+                               atol=1e-5, rtol=1e-4)
+    assert float(aux) > 0.0  # aux ~1 at uniform routing (not a bound)
+
+
+def test_moe_capacity_drops_to_zero_rows(rng):
+    x = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+    p = _params(rng)
+    # Capacity 1 slot per expert: at most E tokens survive; dropped
+    # tokens are exact zero rows (callers' residual passes them
+    # through).
+    out, _ = switch_moe(x, **p, capacity_factor=1.0 / 8)
+    nonzero = np.abs(np.asarray(out)).sum(axis=1) > 0
+    assert nonzero.sum() <= p["gate_w"].shape[1]
+    full, _ = switch_moe(x, **p, capacity_factor=4.0)
+    surviving = np.where(nonzero)[0]
+    np.testing.assert_allclose(np.asarray(out)[surviving],
+                               np.asarray(full)[surviving], atol=1e-5)
+
+
+def test_moe_aux_penalizes_skew(rng):
+    # Positive features so adding a large weight to expert 0's gate
+    # column guarantees every token routes there.
+    x = jnp.asarray(np.abs(rng.standard_normal((64, 8))) + 0.1,
+                    jnp.float32)
+    p = _params(rng)
+    _, aux_rand = switch_moe(x, **p)
+    p_skew = dict(p, gate_w=p["gate_w"].at[:, 0].add(100.0))
+    _, aux_skew = switch_moe(x, **p_skew)
+    assert float(aux_skew) > float(aux_rand)
+    assert float(aux_skew) > 3.5  # all mass on one of E=4 experts
+
+
+def test_moe_ep_sharded_matches_replicated(rng):
+    """Experts sharded over an ep=4 mesh produce the same output as the
+    single-device run — XLA inserts the dispatch/combine collectives."""
+    mesh = build_mesh(jax.devices(), ep=4)
+    x = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+    p = _params(rng)
+    ref, _ = switch_moe(x, **p, capacity_factor=4.0)
+
+    named = {"expert_" + k if k != "gate_w" else k: v
+             for k, v in p.items()}
+    placed = shard_variables(named, mesh)
+    assert "ep" in str(placed["expert_w1"].sharding.spec)
+
+    @jax.jit
+    def run(x, prm):
+        return switch_moe(
+            x, prm["gate_w"], prm["expert_w1"], prm["expert_b1"],
+            prm["expert_w2"], prm["expert_b2"], capacity_factor=4.0)[0]
+
+    out = run(x, placed)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_moe_grads_finite(rng):
+    x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    p = _params(rng)
+
+    def loss(prm):
+        out, aux = switch_moe(x, **prm)
+        return out.sum() + 0.01 * aux
+
+    grads = jax.grad(loss)(p)
+    for k, g in grads.items():
+        assert np.isfinite(np.asarray(g)).all(), k
+    # The router receives gradient through both the gate value and aux.
+    assert np.abs(np.asarray(grads["gate_w"])).sum() > 0
+
+
+def test_moe_masked_tokens_never_claim_capacity(rng):
+    """Padding tokens must not consume expert slots or router stats:
+    with capacity for exactly the real tokens, every real token
+    survives no matter how much padding follows it in cumsum order."""
+    d = 8
+    real = jnp.asarray(rng.standard_normal((8, d)), jnp.float32)
+    padding = jnp.zeros((56, d), jnp.float32)
+    x = jnp.concatenate([padding, real])     # pads FIRST in cumsum order
+    mask = jnp.concatenate([jnp.zeros(56, bool), jnp.ones(8, bool)])
+    p = _params(rng, e=4, d=d)
+    # capacity_factor 2/4 * 64/4 = 8 slots/expert: enough for all 8 real
+    # tokens even if they all pick one expert.
+    out, aux = switch_moe(x, **p, capacity_factor=0.5, token_mask=mask)
+    out = np.asarray(out)
+    assert (np.abs(out[:56]).sum(axis=1) == 0).all()  # pads: zero rows
+    ref = _manual(real, p)
+    np.testing.assert_allclose(out[56:], ref, atol=1e-5, rtol=1e-4)
+    assert np.isfinite(float(aux))
+
+
+def test_moe_group_local_routing_bounds_memory(rng):
+    """Groups route independently (the O(N·group) memory form): output
+    equals running each group alone."""
+    x = jnp.asarray(rng.standard_normal((64, 8)), jnp.float32)
+    p = _params(rng)
+    out, _ = switch_moe(x, **p, capacity_factor=4.0, group_size=16)
+    per_group = [switch_moe(x[i:i + 16], **p, capacity_factor=4.0,
+                            group_size=16)[0] for i in range(0, 64, 16)]
+    np.testing.assert_allclose(np.asarray(out),
+                               np.concatenate([np.asarray(o)
+                                               for o in per_group]),
+                               atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_moe_transformer_model_trains(tmp_path):
+    """Zoo integration: moe_experts > 0 trains, beats chance, and
+    round-trips its expert params."""
+    from rafiki_tpu.datasets import make_synthetic_corpus_dataset
+    from rafiki_tpu.models import JaxTransformerTagger
+
+    train, val = make_synthetic_corpus_dataset(
+        str(tmp_path), n_train=96, n_val=24, vocab=64, n_tags=4,
+        max_len=24)
+    kw = dict(d_model=64, n_heads=4, n_layers=1, learning_rate=1e-2,
+              batch_size=16, max_epochs=6, max_len=32, dropout=0.0,
+              vocab_size=1024, moe_experts=4, expert_parallel=2)
+    m = JaxTransformerTagger(**kw)
+    assert m.mesh.shape["ep"] == 2
+    m.train(train)
+    assert float(m.evaluate(val)) > 0.5
+    params = m.dump_parameters()
+    assert any("expert_w1" in k for k in params)
+    m2 = JaxTransformerTagger(**kw)
+    m2.load_parameters(params)
+    from rafiki_tpu.model import load_corpus_dataset
+
+    s = load_corpus_dataset(val).sentences[:2]
+    np.testing.assert_allclose(np.asarray(m.predict(s)[0]),
+                               np.asarray(m2.predict(s)[0]), atol=1e-5)
+    m.destroy()
+    m2.destroy()
+
+
+def test_moe_rejects_indivisible_expert_parallel():
+    from rafiki_tpu.models import JaxTransformerTagger
+
+    m = JaxTransformerTagger(d_model=64, n_heads=4, n_layers=1,
+                             learning_rate=1e-2, batch_size=16,
+                             max_epochs=1, max_len=32, dropout=0.0,
+                             vocab_size=1024, moe_experts=4,
+                             expert_parallel=8)
+    with pytest.raises(ValueError, match="divisible"):
+        m.mesh
