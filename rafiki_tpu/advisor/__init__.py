@@ -14,12 +14,16 @@ across the slice. Strategies:
   (upstream ENAS controller advisor). Lives in ``enas.py``.
 - ``AshaAdvisor`` — asynchronous successive halving over the model's
   epoch-budget knob (beyond parity; ``advisor_type="asha"``).
+- ``PbtAdvisor`` — population-based training: rounds of short trials
+  with weight inheritance (ParamStore warm starts) plus hyperparameter
+  exploit/explore between rounds (beyond parity; ``advisor_type="pbt"``).
 
 ``make_advisor`` picks the right strategy from the knob config, like the
 upstream factory.
 """
 
 from .asha import AshaAdvisor
+from .pbt import PbtAdvisor
 from .base import BaseAdvisor, Proposal
 from .bayes import BayesOptAdvisor
 from .enas import EnasAdvisor
@@ -28,5 +32,5 @@ from .registry import make_advisor
 
 __all__ = [
     "BaseAdvisor", "Proposal", "RandomAdvisor", "BayesOptAdvisor",
-    "EnasAdvisor", "AshaAdvisor", "make_advisor",
+    "EnasAdvisor", "AshaAdvisor", "PbtAdvisor", "make_advisor",
 ]

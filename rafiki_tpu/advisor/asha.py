@@ -148,12 +148,10 @@ class AshaAdvisor(BaseAdvisor):
         """Can the budget knob legally take ``value``? (The rung delta
         may fall outside an IntegerKnob's range or between a
         CategoricalKnob's values.)"""
-        knob = self.knob_config.get(self.budget_knob)
-        if isinstance(knob, IntegerKnob):
-            return knob.value_min <= value <= knob.value_max
-        if isinstance(knob, CategoricalKnob):
-            return value in knob.values
-        return False
+        from .base import budget_value_legal
+
+        return budget_value_legal(self.knob_config.get(self.budget_knob),
+                                  value)
 
     def _decorate(self, proposal: Proposal) -> None:
         entry = self._pending.get(proposal.trial_no)

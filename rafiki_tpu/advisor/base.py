@@ -47,6 +47,19 @@ class Proposal:
                         meta=d.get("meta", {}))
 
 
+def budget_value_legal(knob, value: int) -> bool:
+    """Can a budget knob legally take ``value``? Shared by the
+    budget-laddering strategies (ASHA rung deltas, PBT cumulative
+    rounds)."""
+    from ..model.knobs import CategoricalKnob, IntegerKnob
+
+    if isinstance(knob, IntegerKnob):
+        return knob.value_min <= value <= knob.value_max
+    if isinstance(knob, CategoricalKnob):
+        return value in knob.values
+    return False
+
+
 class BaseAdvisor:
     """Base search strategy. Thread-safe: one advisor serves many workers."""
 

@@ -13,6 +13,7 @@ from .asha import AshaAdvisor
 from .base import BaseAdvisor
 from .bayes import BayesOptAdvisor
 from .enas import EnasAdvisor
+from .pbt import PbtAdvisor
 from .random_advisor import RandomAdvisor
 from ..model.knobs import ArchKnob, KnobConfig, searchable_dims
 
@@ -21,6 +22,7 @@ ADVISOR_TYPES = {
     "bayes": BayesOptAdvisor,
     "enas": EnasAdvisor,
     "asha": AshaAdvisor,
+    "pbt": PbtAdvisor,
 }
 
 

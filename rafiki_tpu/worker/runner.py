@@ -179,10 +179,15 @@ class TrialRunner:
                     model.train(self.train_dataset_path,
                                 shared_params=shared, **train_kwargs)
                 score = float(model.evaluate(self.val_dataset_path))
+                # A proposal may retrieve from one scope and save under
+                # another (PBT exploitation inherits the winner's
+                # weights but keeps writing its own lineage).
+                save_scope = proposal.meta.get("params_save_scope") \
+                    or params_scope
                 params_id = self.params.save(
                     model.dump_parameters(),
                     session_id=self.sub_train_job_id,
-                    worker_id=params_scope, score=score)
+                    worker_id=save_scope, score=score)
             finally:
                 model.destroy()
             self.meta.mark_trial_completed(trial_id, score, params_id)
