@@ -172,6 +172,13 @@ class JaxTransformerTagger(BaseModel):
             # shard over the ep mesh axis set by expert_parallel.
             "moe_experts": FixedKnob(0),
             "expert_parallel": FixedKnob(1),
+            # > 1 pipelines the encoder blocks over a pp mesh axis
+            # (GPipe microbatch schedule; needs n_layers % pp == 0;
+            # exclusive with sequence_parallel / moe for now; dropout
+            # runs deterministic inside the pipeline).
+            "pipeline_parallel": FixedKnob(1),
+            # Microbatches per pipeline step; 0 = auto (~4·pp).
+            "pp_microbatches": FixedKnob(0),
         }
 
     def __init__(self, **knobs: Any):
@@ -190,6 +197,7 @@ class JaxTransformerTagger(BaseModel):
         if self._mesh is None:
             sp = int(self.knobs.get("sequence_parallel", 1))
             ep = int(self.knobs.get("expert_parallel", 1))
+            pp = int(self.knobs.get("pipeline_parallel", 1))
             experts = int(self.knobs.get("moe_experts", 0))
             if ep > 1 and (experts == 0 or experts % ep != 0):
                 # Silent fallback would pay the smaller dp axis while
@@ -198,8 +206,24 @@ class JaxTransformerTagger(BaseModel):
                 raise ValueError(
                     f"expert_parallel ({ep}) needs moe_experts set and "
                     f"divisible by it (got moe_experts={experts})")
+            if pp > 1:
+                n_layers = int(self.knobs.get("n_layers", 2))
+                if n_layers % pp != 0:
+                    raise ValueError(f"pipeline_parallel ({pp}) must "
+                                     f"divide n_layers ({n_layers})")
+                if sp > 1 or experts > 0:
+                    raise ValueError(
+                        "pipeline_parallel is exclusive with "
+                        "sequence_parallel / moe_experts for now")
+                if float(self.knobs.get("dropout", 0.0)) > 0.0:
+                    # Dropout inside the pipelined stages would need
+                    # per-tick rng threading; silently training
+                    # unregularized would differ from the same knobs
+                    # without pp — reject loudly.
+                    raise ValueError(
+                        "pipeline_parallel requires dropout=0.0")
             self._mesh = build_mesh(ChipGroup.current().devices(), sp=sp,
-                                    ep=ep)
+                                    ep=ep, pp=pp)
         return self._mesh
 
     def _attn_fn(self):
@@ -218,6 +242,88 @@ class JaxTransformerTagger(BaseModel):
                 q, k, v, causal=False, kv_mask=kv_mask)
         return lambda q, k, v, kv_mask: blockwise_attention(
             q, k, v, causal=False, kv_mask=kv_mask)
+
+    def _pp_logits_fn(self, n_tags: int):
+        """Assembled forward for ``pipeline_parallel > 1``: embed →
+        GPipe-pipelined encoder blocks (``ops.pipeline_apply`` inside
+        ``shard_map`` over pp, batch sharded over dp) → head, all from
+        the module's ORDINARY parameter tree (init/dump/load are
+        unchanged; stage stacking happens inside the traced step).
+        Compute is pipelined; parameter storage stays replicated —
+        stage-sharded storage is the op-level API's job
+        (``ops.pipelined`` + ``P("pp", ...)`` placement).
+        Dropout runs deterministic inside the pipeline.
+        """
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops import pipeline_apply
+        from ..parallel import PP_AXIS
+
+        mesh = self.mesh
+        pp = int(self.knobs.get("pipeline_parallel", 1))
+        n_layers = int(self.knobs.get("n_layers", 2))
+        span = n_layers // pp
+        d_model = int(self.knobs.get("d_model", 128))
+        vocab = int(self.knobs.get("vocab_size", 16384))
+        max_len = int(self.knobs.get("max_len", 128))
+        micro = int(self.knobs.get("pp_microbatches", 0))
+        block = _EncoderBlock(int(self.knobs.get("n_heads", 4)),
+                              dropout=0.0, dtype=jnp.bfloat16)
+        # pp > 1 guarantees sp == 1 (mesh validation), so _attn_fn is
+        # the single-group flash/blockwise dispatch — one copy of the
+        # backend branch.
+        attn = self._attn_fn()
+
+        def stage_fn(prm, xm):
+            x, mask = xm
+            for j in range(span):
+                x = block.apply({"params": prm[f"stage{j}"]}, x, attn,
+                                mask, deterministic=True)
+            return (x, mask)
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(PP_AXIS), P(DP_AXIS), P(DP_AXIS)),
+            out_specs=P(DP_AXIS), check_vma=False)
+        def run_blocks(stacked, x, mask):
+            local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+            b = x.shape[0]
+            if micro > 0:
+                if b % micro:
+                    raise ValueError(
+                        f"pp_microbatches ({micro}) must divide the "
+                        f"per-dp-shard batch ({b})")
+                m = micro
+            else:
+                m = min(b, 4 * pp)
+                while b % m:  # auto: largest divisor <= 4·pp
+                    m -= 1
+            xs = x.reshape(m, b // m, *x.shape[1:])
+            ms = mask.reshape(m, b // m, *mask.shape[1:])
+            out, _ = pipeline_apply(stage_fn, local, (xs, ms),
+                                    axis_size=pp)
+            return out.reshape(b, *out.shape[2:])
+
+        def logits_fn(params, ids):
+            mask = ids != PAD_ID
+            x = nn.Embed(vocab, d_model, dtype=jnp.bfloat16).apply(
+                {"params": params["Embed_0"]}, ids)
+            pe = jnp.asarray(_sinusoidal(max_len, d_model))
+            x = x + pe[None, :ids.shape[1]].astype(x.dtype)
+            stacked = {
+                f"stage{j}": jax.tree_util.tree_map(
+                    lambda *a: jnp.stack(a),
+                    *[params[f"_EncoderBlock_{s * span + j}"]
+                      for s in range(pp)])
+                for j in range(span)}
+            x = run_blocks(stacked, x, mask)
+            x = nn.LayerNorm(dtype=jnp.float32).apply(
+                {"params": params["LayerNorm_0"]}, x)
+            return nn.Dense(n_tags, dtype=jnp.float32).apply(
+                {"params": params["Dense_0"]}, x)
+
+        return logits_fn
 
     def _ensure_module(self, n_tags: int) -> None:
         if self._module is None:
@@ -295,15 +401,20 @@ class JaxTransformerTagger(BaseModel):
                 end_value=lr * 0.02)
             tx = optax.adamw(sched, weight_decay=1e-3)
             drop_key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
+            pp_logits = (self._pp_logits_fn(n_tags)
+                         if mesh.shape["pp"] > 1 else None)
 
             @jax.jit
             def train_step(params, opt_state, ids, lengths, tags, step_i):
                 def loss_fn(p):
-                    logits, mods = module.apply(
-                        {"params": p}, ids, attn, train=True,
-                        rngs={"dropout": jax.random.fold_in(drop_key,
-                                                            step_i)},
-                        mutable=["losses"])
+                    if pp_logits is not None:
+                        logits, mods = pp_logits(p, ids), {}
+                    else:
+                        logits, mods = module.apply(
+                            {"params": p}, ids, attn, train=True,
+                            rngs={"dropout": jax.random.fold_in(
+                                drop_key, step_i)},
+                            mutable=["losses"])
                     mask = (jnp.arange(logits.shape[1])[None, :]
                             < lengths[:, None]).astype(jnp.float32)
                     losses = optax.softmax_cross_entropy_with_integer_labels(
@@ -388,10 +499,17 @@ class JaxTransformerTagger(BaseModel):
             # inference), everything else replicates.
             self._vars_dev = shard_variables(self._variables, self.mesh)
         if self._predict_fn is None:
-            module, attn = self._module, self._attn_fn()
-            self._predict_fn = jax.jit(
-                lambda v, ids: jax.nn.softmax(
-                    module.apply(v, ids, attn, train=False), -1))
+            if self.mesh.shape["pp"] > 1:
+                pp_logits = self._pp_logits_fn(
+                    len(self._meta["tag_names"]))
+                self._predict_fn = jax.jit(
+                    lambda v, ids: jax.nn.softmax(
+                        pp_logits(v["params"], ids), -1))
+            else:
+                module, attn = self._module, self._attn_fn()
+                self._predict_fn = jax.jit(
+                    lambda v, ids: jax.nn.softmax(
+                        module.apply(v, ids, attn, train=False), -1))
         ids, _ = self._encode(sentences)
         n = len(sentences)
         bucket = dp

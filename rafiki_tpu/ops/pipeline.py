@@ -34,48 +34,65 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     """Run ``x`` through ``axis_size`` pipeline stages inside shard_map.
 
     Args:
-      stage_fn: ``(params_slice, mb) -> mb`` — one stage's computation;
-        every stage must map the same activation shape to itself (equal
-        layer spans).
+      stage_fn: ``(params_slice, mb) -> mb`` — one stage's computation.
+        ``mb`` may be a single array or a PYTREE of arrays (e.g.
+        ``(activations, kv_mask)``); the stage must return the SAME
+        tree structure with the same shapes (equal layer spans), since
+        its output is the next stage's input.
       stage_params: THIS stage's parameter pytree (the caller shard_maps
         a stacked pytree with ``P("pp", ...)`` so each device receives
         its own slice with the leading stage axis already squeezed).
-      x: (M, mb, ...) microbatched input, replicated across ``pp``.
+      x: microbatched input — an array or pytree whose leaves are
+        (M, mb, ...), replicated across ``pp``.
 
-    Returns (M, mb, ...) outputs (replicated across ``pp``; the last
-    stage's results are broadcast back so every stage returns the same
-    value — convenient for loss computation under ``out_specs=P()``).
+    Returns outputs matching ``x``'s tree structure, leaves (M, mb,
+    ...) (replicated across ``pp``; the last stage's results are
+    broadcast back so every stage returns the same value — convenient
+    for loss computation under ``out_specs=P()``). Bool leaves ride
+    through a numeric cast for the collection scatter.
     """
     s = axis_size
-    m = x.shape[0]
+    leaves = jax.tree_util.tree_leaves(x)
+    m = leaves[0].shape[0]
     stage = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % s) for i in range(s)]
+    tmap = jax.tree_util.tree_map
 
     def tick(carry, t):
         state = carry  # activation arriving from the previous stage
         # Stage 0 injects microbatch t (garbage once t >= m: masked by
         # the collection window below); later stages consume the hop.
-        mb_in = jnp.where(stage == 0,
-                          x[jnp.clip(t, 0, m - 1)], state)
+        mb_in = tmap(lambda xs, st: jnp.where(
+            stage == 0, xs[jnp.clip(t, 0, m - 1)], st), x, state)
         out = stage_fn(stage_params, mb_in)
         # The last stage's tick-t output is microbatch t - (s - 1);
         # collect it only inside the valid window.
         idx = t - (s - 1)
         collect = (stage == s - 1) & (idx >= 0) & (idx < m)
-        state_next = jax.lax.ppermute(out, axis_name, perm)
+        state_next = tmap(
+            lambda o: jax.lax.ppermute(o, axis_name, perm), out)
         return state_next, (jnp.where(collect, 1.0, 0.0), idx, out)
 
-    init = jnp.zeros_like(x[0])
+    init = tmap(lambda xs: jnp.zeros_like(xs[0]), x)
     _, (collect, idxs, outs) = jax.lax.scan(
         tick, init, jnp.arange(m + s - 1, dtype=jnp.int32))
 
     # Scatter collected ticks into microbatch order. Only the last
     # stage has real data; psum broadcasts it to every stage (each
     # other stage contributes zeros).
-    weights = collect.reshape(-1, *([1] * (outs.ndim - 1)))
-    gathered = jnp.zeros_like(x).at[jnp.clip(idxs, 0, m - 1)].add(
-        outs * weights.astype(outs.dtype))
-    return jax.lax.psum(gathered, axis_name)
+    idx_safe = jnp.clip(idxs, 0, m - 1)
+
+    def scatter(xs, o):
+        w = collect.reshape(-1, *([1] * (o.ndim - 1)))
+        dt = o.dtype
+        if dt == jnp.bool_:  # scatter-add needs a numeric dtype
+            o = o.astype(jnp.int8)
+        z = jnp.zeros((m, *o.shape[1:]), o.dtype)
+        g = jax.lax.psum(z.at[idx_safe].add(o * w.astype(o.dtype)),
+                         axis_name)
+        return g.astype(dt) if dt == jnp.bool_ else g
+
+    return tmap(scatter, x, outs)
 
 
 def pipelined(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],

@@ -73,3 +73,45 @@ def test_transformer_tagger_sequence_parallel(synth_corpus_data,
                                atol=1e-5)
     model.destroy()
     m2.destroy()
+
+
+@pytest.mark.slow
+def test_transformer_tagger_pipeline_parallel(synth_corpus_data):
+    """pp=2 on the 8-device mesh (dp=4 x pp=2): encoder blocks run as a
+    GPipe pipeline; scores match the non-pipelined model and the
+    dump/load round-trip preserves predictions."""
+    train_path, val_path = synth_corpus_data
+    knobs = dict(KNOBS, pipeline_parallel=2, dropout=0.0)
+    model = JaxTransformerTagger(**knobs)
+    assert model.mesh.shape["pp"] == 2
+    assert model.mesh.shape["dp"] == len(jax.devices()) // 2
+    model.train(train_path)
+    score = model.evaluate(val_path)
+
+    base = JaxTransformerTagger(**dict(KNOBS, dropout=0.0))
+    base.train(train_path)
+    assert abs(score - base.evaluate(val_path)) < 0.05
+
+    params = model.dump_parameters()
+    m2 = JaxTransformerTagger(**knobs)
+    m2.load_parameters(params)
+    ds = load_corpus_dataset(val_path)
+    p1 = model.predict(ds.sentences[:2])
+    p2 = m2.predict(ds.sentences[:2])
+    np.testing.assert_allclose(np.asarray(p1[0]), np.asarray(p2[0]),
+                               atol=1e-5)
+    model.destroy()
+    base.destroy()
+    m2.destroy()
+
+
+def test_pipeline_parallel_knob_validation():
+    with pytest.raises(ValueError, match="divide n_layers"):
+        JaxTransformerTagger(**dict(KNOBS, n_layers=3,
+                                    pipeline_parallel=2)).mesh
+    with pytest.raises(ValueError, match="exclusive"):
+        JaxTransformerTagger(**dict(KNOBS, sequence_parallel=2,
+                                    pipeline_parallel=2)).mesh
+    with pytest.raises(ValueError, match="dropout"):
+        JaxTransformerTagger(**dict(KNOBS, dropout=0.2,
+                                    pipeline_parallel=2)).mesh
