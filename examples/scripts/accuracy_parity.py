@@ -40,7 +40,7 @@ def main() -> None:
     from rafiki_tpu.datasets import (prepare_sklearn_digits,
                                      prepare_sklearn_tabular)
     from rafiki_tpu.models import (JaxCnn, JaxFeedForward, JaxTabMlpClf,
-                                   SkDt, SkSvm)
+                                   JaxViT, SkDt, SkSvm)
 
     with tempfile.TemporaryDirectory() as tmp:
         train, val = prepare_sklearn_digits(tmp + "/digits")
@@ -57,6 +57,10 @@ def main() -> None:
                   {"width_16ths": 16, "learning_rate": 3e-3,
                    "batch_size": 64, "weight_decay": 1e-4,
                    "max_epochs": 12, "early_stop_epochs": 5},
+                  train, val, "digits", 0.90)
+        run_image(JaxViT,
+                  {"depth": 4, "learning_rate": 1e-3, "batch_size": 64,
+                   "weight_decay": 1e-4, "max_epochs": 25},
                   train, val, "digits", 0.90)
 
         for dataset, band in (("breast_cancer", 0.90), ("wine", 0.90)):

@@ -8,7 +8,8 @@ from .pos_tagger import JaxPosTagger
 from .sk import SkDt, SkSvm
 from .tabular import JaxTabMlpClf, JaxTabMlpReg
 from .transformer import JaxTransformerTagger
+from .vit import JaxViT
 
-__all__ = ["JaxFeedForward", "JaxCnn", "JaxDenseNet", "JaxEnas",
+__all__ = ["JaxFeedForward", "JaxCnn", "JaxDenseNet", "JaxEnas", "JaxViT",
            "JaxPosTagger", "SkDt", "SkSvm", "JaxTabMlpClf",
            "JaxTabMlpReg", "JaxTransformerTagger"]

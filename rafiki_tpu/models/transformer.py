@@ -41,8 +41,8 @@ from ..model.dataset import (PAD_ID, hash_token_ids,
 from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
-from ..ops import (blockwise_attention, flash_attention,
-                   sequence_sharded_attention, switch_moe)
+from ..ops import (default_attention, sequence_sharded_attention,
+                   switch_moe)
 from ..parallel import (DP_AXIS, SP_AXIS, batch_sharding, build_mesh,
                         replicated, shard_variables)
 from ..parallel.chips import ChipGroup
@@ -237,11 +237,7 @@ class JaxTransformerTagger(BaseModel):
             mode = str(self.knobs.get("sp_schedule", "ring"))
             return lambda q, k, v, kv_mask: sequence_sharded_attention(
                 q, k, v, mesh, causal=False, kv_mask=kv_mask, mode=mode)
-        if jax.default_backend() in ("tpu", "axon"):
-            return lambda q, k, v, kv_mask: flash_attention(
-                q, k, v, causal=False, kv_mask=kv_mask)
-        return lambda q, k, v, kv_mask: blockwise_attention(
-            q, k, v, causal=False, kv_mask=kv_mask)
+        return default_attention(causal=False)
 
     def _pp_logits_fn(self, n_tags: int):
         """Assembled forward for ``pipeline_parallel > 1``: embed →

@@ -1,0 +1,120 @@
+"""JaxViT: Vision Transformer image classifier on the framework's ops.
+
+Beyond-parity zoo model (SURVEY.md §2 "Example models" lists only
+dense/conv/ENAS image classifiers): patches → the same pre-LN encoder
+blocks the sequence models use (``rafiki_tpu.ops`` flash attention on
+TPU, blockwise fallback elsewhere) → CLS-token head. Connects the
+attention-kernel layer to the flagship IMAGE_CLASSIFICATION task, and
+inherits the whole ``JaxModel`` substrate: device-resident input
+pipeline, scanned multi-step dispatch, traced lr/wd hyperparameters
+(one executable per batch-size bucket), AOT bucketed predict, and
+chip-utilization metering.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
+from ..model.jax_model import JaxModel
+from ..ops import default_attention
+from .transformer import _EncoderBlock
+
+MAX_DEPTH = 6  # supernet depth; the depth knob masks trailing blocks
+
+
+class _ViT(nn.Module):
+    """Patchify-conv + CLS token + encoder blocks + linear head.
+
+    ``depth`` (traced, a (MAX_DEPTH,) 0/1 mask — named for the knob
+    that drives it, the compiled-step cache-key convention) blends each
+    block's output with its input: a masked block is the identity, so
+    the searched depth rides ONE executable like JaxCnn's width mask.
+    """
+    n_classes: int
+    d_model: int
+    n_heads: int
+    patch: int
+    n_tokens: int  # 1 + (H/patch)·(W/patch), fixed per dataset
+    max_depth: int = MAX_DEPTH
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, depth=None):
+        attn = default_attention(causal=False)
+
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch),
+                    dtype=self.dtype)(x.astype(self.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, self.d_model)          # (B, hw, D)
+        # Params stay f32 (like every flax kernel; ``dtype`` is the
+        # COMPUTE dtype) — bf16 params would leak into the optimizer
+        # state and break the scanned train step's carry types.
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, self.d_model), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.n_tokens, self.d_model), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.max_depth):
+            y = _EncoderBlock(self.n_heads, dropout=0.0,
+                              dtype=self.dtype)(
+                x, attn, None, deterministic=not train)
+            if depth is not None:
+                gate = depth[i].astype(y.dtype)
+                y = x + gate * (y - x)   # masked block == identity
+            x = y
+        x = nn.LayerNorm(dtype=jnp.float32)(x[:, 0])  # CLS token
+        return nn.Dense(self.n_classes, dtype=jnp.float32)(x)
+
+
+class JaxViT(JaxModel):
+    """Vision Transformer; depth searched via a traced block mask."""
+
+    traced_knobs = frozenset({"learning_rate", "weight_decay"})
+    traced_knob_defaults = {"learning_rate": 1e-3, "weight_decay": 1e-4}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "depth": IntegerKnob(2, MAX_DEPTH),  # traced mask -> one exe
+            "d_model": FixedKnob(128),
+            "n_heads": FixedKnob(4),
+            "patch": FixedKnob(4),
+            "learning_rate": FloatKnob(1e-4, 1e-2, is_exp=True),
+            "batch_size": CategoricalKnob([64, 128, 256]),
+            "weight_decay": FloatKnob(1e-5, 1e-3, is_exp=True),
+            "max_epochs": IntegerKnob(3, 40),
+            "early_stop_epochs": FixedKnob(5),
+        }
+
+    def create_module(self, n_classes: int, image_shape: Sequence[int]):
+        patch = int(self.knobs.get("patch", 4))
+        h, w = int(image_shape[0]), int(image_shape[1])
+        if h % patch or w % patch:
+            raise ValueError(f"image {h}x{w} not divisible by "
+                             f"patch {patch}")
+        return _ViT(n_classes=n_classes,
+                    d_model=int(self.knobs.get("d_model", 128)),
+                    n_heads=int(self.knobs.get("n_heads", 4)),
+                    patch=patch,
+                    n_tokens=1 + (h // patch) * (w // patch))
+
+    def create_optimizer(self, steps_per_epoch: int, max_epochs: int):
+        return self.traced_hyperparam_optimizer(
+            steps_per_epoch, max_epochs, opt="adam", weight_decay=True)
+
+    def extra_apply_inputs(self) -> Dict[str, Any]:
+        import numpy as np
+
+        # Keyed by the KNOB name: that's what excludes ``depth`` from
+        # the compiled-step cache key (see step_cache_key).
+        depth = int(self.knobs.get("depth", MAX_DEPTH))
+        return {"depth":
+                (np.arange(MAX_DEPTH) < depth).astype(np.float32)}

@@ -8,14 +8,16 @@ parallelism over the ``sp`` mesh axis (SURVEY.md §5 — absent upstream,
 first-class here).
 """
 
-from .attention import (blockwise_attention, flash_attention,
+from .attention import (blockwise_attention, default_attention,
+                        flash_attention,
                         naive_attention, ring_attention,
                         sequence_sharded_attention, ulysses_attention)
 from .moe import switch_moe
 from .pipeline import pipeline_apply, pipelined
 
 __all__ = [
-    "blockwise_attention", "flash_attention", "naive_attention",
+    "blockwise_attention", "default_attention", "flash_attention",
+    "naive_attention",
     "pipeline_apply", "pipelined", "ring_attention",
     "sequence_sharded_attention", "switch_moe", "ulysses_attention",
 ]

@@ -458,6 +458,18 @@ def ulysses_attention(q, k, v, *, axis_name: str = SP_AXIS,
                               concat_axis=1, tiled=True)
 
 
+def default_attention(*, causal: bool = False):
+    """Backend-dispatched single-device attention: the Pallas flash
+    kernel on TPU, the blockwise XLA formulation elsewhere. Returns a
+    ``(q, k, v, kv_mask) -> out`` callable — the one place the backend
+    branch lives for every zoo model."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return lambda q, k, v, m: flash_attention(
+            q, k, v, causal=causal, kv_mask=m)
+    return lambda q, k, v, m: blockwise_attention(
+        q, k, v, causal=causal, kv_mask=m)
+
+
 def sequence_sharded_attention(q, k, v, mesh, *, causal: bool = False,
                                batch_axis: Optional[str] = DP_AXIS,
                                kv_mask=None, mode: str = "ring"):

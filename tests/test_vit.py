@@ -1,0 +1,83 @@
+"""JaxViT: Vision Transformer zoo model with traced depth mask."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.model import load_image_dataset, test_model_class
+from rafiki_tpu.models import JaxViT
+
+KNOBS = {"depth": 3, "learning_rate": 1e-3, "batch_size": 64,
+         "weight_decay": 1e-4, "max_epochs": 10, "early_stop_epochs": 5}
+
+
+@pytest.mark.slow
+def test_vit_end_to_end(synth_image_data):
+    train_path, val_path = synth_image_data
+    ds = load_image_dataset(val_path)
+    queries = [ds.images[i] for i in range(2)]
+    result = test_model_class(
+        JaxViT, TaskType.IMAGE_CLASSIFICATION, train_path, val_path,
+        test_queries=queries, knobs=KNOBS)
+    assert result.score > 0.5  # 4 classes; chance is 0.25
+    for pred in result.predictions:
+        assert len(pred) == ds.n_classes
+        assert abs(sum(pred) - 1.0) < 1e-3
+
+
+@pytest.mark.slow
+def test_vit_depth_mask_shares_one_executable(synth_image_data):
+    """Different depth knobs reuse the SAME compiled train step (depth
+    rides extra_apply_inputs as a traced block mask)."""
+    train_path, _ = synth_image_data
+    from rafiki_tpu.model.jax_model import _STEP_CACHE, clear_step_cache
+
+    clear_step_cache()
+    base = dict(KNOBS, max_epochs=1, early_stop_epochs=0)
+    m1 = JaxViT(**dict(base, depth=2))
+    m1.train(train_path)
+    n_after_first = len(_STEP_CACHE)
+    m1.destroy()
+    m2 = JaxViT(**dict(base, depth=5))
+    m2.train(train_path)
+    assert len(_STEP_CACHE) == n_after_first, (
+        "depth change recompiled the train step")
+    m2.destroy()
+
+
+def test_vit_depth_mask_is_identity_for_masked_blocks(synth_image_data):
+    """A masked block is exactly the identity: the full supernet with
+    depth mask d equals a module TRUNCATED to d blocks running the same
+    (sliced) parameters."""
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models.vit import MAX_DEPTH, _ViT
+
+    d = 2
+    module = _ViT(n_classes=4, d_model=32, n_heads=2, patch=4,
+                  n_tokens=1 + 9)
+    rng = jax.random.key(0)
+    x = jnp.asarray(np.random.default_rng(0).random((2, 12, 12, 1)),
+                    jnp.float32)
+    v = module.init(rng, x, depth=jnp.ones((MAX_DEPTH,)))
+    masked = module.apply(v, x, depth=jnp.asarray(
+        (np.arange(MAX_DEPTH) < d).astype(np.float32)))
+
+    truncated = _ViT(n_classes=4, d_model=32, n_heads=2, patch=4,
+                     n_tokens=1 + 9, max_depth=d)
+    keep = {"Conv_0", "cls", "pos_embed", "LayerNorm_0", "Dense_0"} | {
+        f"_EncoderBlock_{i}" for i in range(d)}
+    v_trunc = {"params": {k: v["params"][k] for k in keep}}
+    exact = truncated.apply(v_trunc, x, depth=jnp.ones((d,)))
+    np.testing.assert_allclose(np.asarray(masked), np.asarray(exact),
+                               atol=1e-5, rtol=1e-5)
+    # And the mask genuinely changes the function vs full depth.
+    full = module.apply(v, x, depth=jnp.ones((MAX_DEPTH,)))
+    assert not np.allclose(np.asarray(full), np.asarray(masked))
+
+
+def test_vit_rejects_indivisible_patch():
+    m = JaxViT(**JaxViT.validate_knobs(dict(KNOBS, depth=2)))
+    with pytest.raises(ValueError, match="divisible"):
+        m.create_module(4, (13, 13, 1))
