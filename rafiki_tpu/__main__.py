@@ -110,17 +110,45 @@ def _join(args: argparse.Namespace) -> None:
                                      f"/join-{os.getpid()}",
                              adopt_unowned=False)
     try:
-        attached = platform.admin.attach_workers(
-            args.train_job, chips_per_trial=args.chips_per_trial)
-        if not attached:
-            raise SystemExit("no chips available on this node")
-        print(f"attached {len(attached)} worker(s) to {args.train_job}",
-              flush=True)
-        ok = platform.admin.wait_until_train_job_done(args.train_job,
-                                                      timeout=args.timeout)
-        print("train job done" if ok else "timed out waiting", flush=True)
-        if not ok:
-            raise SystemExit(1)
+        if args.train_job:
+            attached = platform.admin.attach_workers(
+                args.train_job, chips_per_trial=args.chips_per_trial)
+            if not attached:
+                raise SystemExit("no chips available on this node")
+            print(f"attached {len(attached)} worker(s) to "
+                  f"{args.train_job}", flush=True)
+            ok = platform.admin.wait_until_train_job_done(
+                args.train_job, timeout=args.timeout)
+            print("train job done" if ok else "timed out waiting",
+                  flush=True)
+            if not ok:
+                raise SystemExit(1)
+        else:
+            # Serving replicas: extra copies of the served trial bins
+            # on this node; the Predictor round-robins across them.
+            attached = platform.admin.attach_inference_workers(
+                args.inference_job,
+                chips_per_worker=args.chips_per_trial)
+            if not attached:
+                raise SystemExit("no chips available on this node")
+            print(f"attached {len(attached)} replica worker(s) to "
+                  f"{args.inference_job}", flush=True)
+            import time
+
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
+                job = platform.meta.get_inference_job(args.inference_job)
+                if job is None or job["status"] != "RUNNING":
+                    print("inference job stopped", flush=True)
+                    break
+                time.sleep(2.0)
+            else:
+                # Leaving on timeout tears this node's replicas down
+                # mid-serve — be loud about it.
+                print("timed out while the inference job is still "
+                      "RUNNING; withdrawing this node's replicas",
+                      flush=True)
+                raise SystemExit(1)
     finally:
         platform.shutdown()
 
@@ -179,7 +207,11 @@ def main(argv=None) -> None:
                            "(shared filesystem)")
     join.add_argument("--bus", required=True,
                       help="primary node's bus URI (tcp://host:port)")
-    join.add_argument("--train-job", required=True)
+    join.add_argument("--train-job", default=None,
+                      help="attach train workers to this RUNNING job")
+    join.add_argument("--inference-job", default=None,
+                      help="attach serving REPLICA workers to this "
+                           "RUNNING inference job")
     join.add_argument("--chips", type=int, default=None,
                       help="limit to the first N local chips")
     join.add_argument("--chips-per-trial", type=int, default=1)
@@ -198,6 +230,10 @@ def main(argv=None) -> None:
     broker.set_defaults(fn=_broker)
 
     args = parser.parse_args(argv)
+    if args.cmd == "join":
+        if bool(args.train_job) == bool(args.inference_job):
+            parser.error("give exactly one of --train-job / "
+                         "--inference-job")
     if args.cmd == "serve":
         n_set = sum([args.coordinator is not None,
                      args.num_processes is not None,

@@ -265,6 +265,34 @@ class Admin:
                 attached.append(svc)
         return attached
 
+    def attach_inference_workers(self, inference_job_id: str,
+                                 chips_per_worker: int = 1,
+                                 ) -> List[Dict[str, Any]]:
+        """Elastic serving scale-out: attach one REPLICA worker per
+        served trial bin of a RUNNING inference job on THIS node's
+        chips (the ``join --inference-job`` path). The Predictor
+        round-robins across replicas, so QPS scales with unchanged
+        ensemble semantics."""
+        job = self.meta.get_inference_job(inference_job_id)
+        if job is None:
+            raise ValueError(f"unknown inference job {inference_job_id}")
+        if job["status"] != InferenceJobStatus.RUNNING:
+            raise ValueError(
+                f"inference job {inference_job_id} is not RUNNING")
+        from .services_manager import PREDICTOR_TRIAL
+
+        bins = {w["trial_id"]
+                for w in self.meta.get_inference_job_workers(
+                    inference_job_id)
+                if w["trial_id"] != PREDICTOR_TRIAL}
+        attached = []
+        for trial_id in sorted(bins):
+            svc = self.services.add_inference_worker(
+                inference_job_id, trial_id, chips_per_worker)
+            if svc is not None:
+                attached.append(svc)
+        return attached
+
     # --- Inference jobs (§3.2) ---
 
     def create_inference_job(self, user_id: str, train_job_id: str,

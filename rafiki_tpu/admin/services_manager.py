@@ -310,41 +310,25 @@ class ServicesManager:
         for holder, bin_ids in zip(grabbed, bins):
             trial_id = ",".join(bin_ids)
             svc_row, group = holder["row"], holder["group"]
-            chips = list(group.indices)
-            env = {
-                EnvVars.META_URI: self.meta_uri,
-                EnvVars.PARAMS_DIR: self.params_dir,
-                EnvVars.BUS_URI: self.bus_uri,
-                EnvVars.SERVICE_ID: svc_row["id"],
-                EnvVars.SERVICE_TYPE: ServiceType.INFERENCE,
-                EnvVars.INFERENCE_JOB_ID: inference_job_id,
-                EnvVars.TRIAL_ID: trial_id,
-            }
-            if chips is not None:
-                env[EnvVars.CHIPS] = ",".join(str(c) for c in chips)
             try:
-                container_id = self.container.create_service(svc_row["id"],
-                                                             env)
+                svc = self._launch_inference_worker(
+                    svc_row, group, inference_job_id, trial_id)
             except Exception:
-                # Roll back everything: this holder, holders not yet
-                # launched, and workers already launched for this job.
+                # Roll back the rest: holders not yet launched and
+                # workers already launched for this job (the failing
+                # holder itself was released/errored by the helper).
                 launched_ids = {s["id"] for s in services}
                 for h in grabbed:
                     hid = h["row"]["id"]
-                    if hid in launched_ids:
+                    if hid in launched_ids or hid == svc_row["id"]:
                         continue
                     self.allocator.release(self._alloc_name(hid))
-                    self.meta.update_service(
-                        hid, status=ServiceStatus.ERRORED
-                        if hid == svc_row["id"] else ServiceStatus.STOPPED)
+                    self.meta.update_service(hid,
+                                             status=ServiceStatus.STOPPED)
                 for launched in services:
                     self._stop_service(launched["id"])
                 raise
-            self.meta.update_service(svc_row["id"],
-                                     container_id=container_id, chips=chips)
-            self.meta.add_inference_job_worker(svc_row["id"],
-                                               inference_job_id, trial_id)
-            services.append(self.meta.get_service(svc_row["id"]))
+            services.append(svc)
         predictor = self._launch(
             ServiceType.PREDICT,
             {EnvVars.INFERENCE_JOB_ID: inference_job_id})
@@ -352,6 +336,58 @@ class ServicesManager:
                                            PREDICTOR_TRIAL)
         services.append(predictor)
         return services
+
+    def _launch_inference_worker(self, svc_row: Dict[str, Any], group,
+                                 inference_job_id: str, trial_id: str,
+                                 ) -> Dict[str, Any]:
+        """Env + container launch + meta wiring for ONE inference
+        worker holding an allocated chip group. On container failure:
+        releases this worker's chips, marks its row ERRORED, and
+        re-raises (callers add any broader rollback)."""
+        chips = list(group.indices)
+        env = {
+            EnvVars.META_URI: self.meta_uri,
+            EnvVars.PARAMS_DIR: self.params_dir,
+            EnvVars.BUS_URI: self.bus_uri,
+            EnvVars.SERVICE_ID: svc_row["id"],
+            EnvVars.SERVICE_TYPE: ServiceType.INFERENCE,
+            EnvVars.INFERENCE_JOB_ID: inference_job_id,
+            EnvVars.TRIAL_ID: trial_id,
+            EnvVars.CHIPS: ",".join(str(c) for c in chips),
+        }
+        try:
+            container_id = self.container.create_service(svc_row["id"],
+                                                         env)
+        except Exception:
+            self.allocator.release(self._alloc_name(svc_row["id"]))
+            self.meta.update_service(svc_row["id"],
+                                     status=ServiceStatus.ERRORED)
+            raise
+        self.meta.update_service(svc_row["id"], container_id=container_id,
+                                 chips=chips)
+        self.meta.add_inference_job_worker(svc_row["id"],
+                                           inference_job_id, trial_id)
+        return self.meta.get_service(svc_row["id"])
+
+    def add_inference_worker(self, inference_job_id: str, trial_id: str,
+                             chips_per_worker: int = 1,
+                             ) -> Optional[Dict[str, Any]]:
+        """Attach one REPLICA worker for an already-served trial bin on
+        THIS node's chips (elastic serving capacity: the Predictor
+        round-robins requests across same-bin replicas, so QPS scales
+        without changing the ensemble semantics). Returns None when
+        this node's chips are exhausted."""
+        svc_row = self.meta.create_service(ServiceType.INFERENCE,
+                                           ServiceStatus.DEPLOYING,
+                                           node_id=self.node_id)
+        group = self.allocator.allocate(
+            chips_per_worker, name=self._alloc_name(svc_row["id"]))
+        if group is None:
+            self.meta.update_service(svc_row["id"],
+                                     status=ServiceStatus.STOPPED)
+            return None
+        return self._launch_inference_worker(svc_row, group,
+                                             inference_job_id, trial_id)
 
     def stop_inference_services(self, inference_job_id: str) -> None:
         for w in self.meta.get_inference_job_workers(inference_job_id):

@@ -107,6 +107,16 @@ class Cache:
         prefix = f"w:{inference_job_id}:"
         return [k[len(prefix):] for k in self.bus.keys(prefix)]
 
+    def running_worker_info(self, inference_job_id: str,
+                            ) -> Dict[str, Dict[str, Any]]:
+        """worker_id -> registration info (e.g. the trial bin it
+        serves); the Predictor groups replicas of the same bin by it."""
+        prefix = f"w:{inference_job_id}:"
+        out: Dict[str, Dict[str, Any]] = {}
+        for k in self.bus.keys(prefix):
+            out[k[len(prefix):]] = self.bus.get(k) or {}
+        return out
+
     # --- Queries (Predictor side) ---
 
     def send_query(self, worker_id: str, query: Any,

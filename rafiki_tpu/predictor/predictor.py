@@ -70,6 +70,11 @@ class Predictor:
         self.cache = Cache(bus)
         self.gather_timeout = gather_timeout
         self.worker_wait_timeout = worker_wait_timeout
+        self._rr = 0  # replica round-robin cursor
+        # worker_id -> trial bin, memoized: registration info is
+        # immutable per worker id, and per-request bus.get fan-out
+        # would put O(workers) round-trips on the serving hot path.
+        self._bins: Dict[str, str] = {}
 
     def workers(self) -> List[str]:
         return self.cache.running_workers(self.inference_job_id)
@@ -87,6 +92,29 @@ class Predictor:
                 return []
             time.sleep(0.2)
 
+    def _bin_of(self, worker_id: str) -> str:
+        bin_id = self._bins.get(worker_id)
+        if bin_id is None:
+            info = self.cache.bus.get(
+                f"w:{self.inference_job_id}:{worker_id}") or {}
+            bin_id = str(info.get("trial_id") or worker_id)
+            self._bins[worker_id] = bin_id
+        return bin_id
+
+    def _choose_workers(self) -> List[str]:
+        """One worker per TRIAL BIN. Same-bin workers are replicas
+        (elastic serving capacity — extra copies of the same trials);
+        querying all of them would double-weight their trials in the
+        ensemble, so each request picks one per bin, rotating across
+        requests for load balance. The hot path costs one registry
+        keys() scan; per-worker info reads are memoized."""
+        groups: Dict[str, List[str]] = {}
+        for w in sorted(self._wait_workers()):
+            groups.setdefault(self._bin_of(w), []).append(w)
+        self._rr += 1
+        return [members[self._rr % len(members)]
+                for _, members in sorted(groups.items())]
+
     def predict(self, queries: List[Any]) -> List[Optional[Any]]:
         """Scatter-gather-ensemble a batch of queries.
 
@@ -94,7 +122,7 @@ class Predictor:
         whole request, and each worker replies once — the scatter/gather
         cost is O(workers), not O(queries x workers).
         """
-        workers = self._wait_workers()
+        workers = self._choose_workers()
         if not workers:
             raise RuntimeError(
                 f"no running inference workers for job "

@@ -174,8 +174,12 @@ class InferenceWorker:
                 warm()
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.RUNNING)
+            # The trial bin rides the registration so the Predictor can
+            # treat same-bin workers as REPLICAS (one is chosen per
+            # request) instead of extra ensemble members.
             self.cache.register_worker(self.inference_job_id,
-                                       self.service_id)
+                                       self.service_id,
+                                       info={"trial_id": self.trial_id})
         except Exception:
             _log.exception("inference worker %s failed to start",
                            self.service_id)

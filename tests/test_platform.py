@@ -383,3 +383,75 @@ def test_inference_pipeline_env_toggle(monkeypatch):
     # An explicit constructor arg beats the env var.
     assert InferenceWorker("s", "j", "t", None, None, bus,
                            pipeline=True).pipeline
+
+
+def test_predictor_round_robins_same_bin_replicas():
+    """Same-trial-bin workers are REPLICAS: each request picks one per
+    bin (rotating), never all — replicas must not double-weight their
+    trials in the ensemble."""
+    from rafiki_tpu.bus import MemoryBus
+    from rafiki_tpu.cache import Cache
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    bus = MemoryBus()
+    cache = Cache(bus)
+    cache.register_worker("job", "wA1", info={"trial_id": "tA"})
+    cache.register_worker("job", "wA2", info={"trial_id": "tA"})
+    cache.register_worker("job", "wB", info={"trial_id": "tB"})
+    p = Predictor("job", bus, worker_wait_timeout=1.0)
+    picks = [tuple(sorted(p._choose_workers())) for _ in range(4)]
+    for pick in picks:
+        assert len(pick) == 2          # one per bin, not three workers
+        assert "wB" in pick            # the singleton bin always serves
+        assert ("wA1" in pick) != ("wA2" in pick)
+    # The replica choice rotates across requests.
+    assert len(set(picks)) == 2
+
+
+@pytest.mark.slow
+def test_inference_replica_attach_keeps_ensemble_semantics(
+        platform, synth_image_data):
+    """attach_inference_workers adds a same-bin replica: predictions
+    stay numerically consistent (no double weighting) and the extra
+    worker takes live traffic."""
+    import requests as rq
+
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.model import load_image_dataset
+
+    train_path, val_path = synth_image_data
+    dev, model = _register_model(platform)
+    job = platform.admin.create_train_job(
+        dev["id"], "rep-app", TaskType.IMAGE_CLASSIFICATION,
+        [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 1},
+        train_path, val_path)
+    assert platform.admin.wait_until_train_job_done(job["id"], timeout=600)
+    inf = platform.admin.create_inference_job(dev["id"], job["id"],
+                                              max_models=1)
+    host = platform.admin.get_inference_job(inf["id"])["predictor_host"]
+    cache = Cache(platform.bus)
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline and \
+            len(cache.running_workers(inf["id"])) < 1:
+        time.sleep(0.2)
+
+    val = load_image_dataset(val_path)
+    q = {"queries": [encode_payload(val.images[i]) for i in range(4)]}
+    before = rq.post(f"http://{host}/predict", json=q,
+                     timeout=120).json()["predictions"]
+
+    attached = platform.admin.attach_inference_workers(inf["id"])
+    assert len(attached) == 1
+    while time.monotonic() < deadline and \
+            len(cache.running_workers(inf["id"])) < 2:
+        time.sleep(0.2)
+    assert len(cache.running_workers(inf["id"])) == 2
+
+    # Several requests: all succeed (both replicas serve) and match the
+    # pre-replica ensemble output — a replica is capacity, not weight.
+    for _ in range(4):
+        after = rq.post(f"http://{host}/predict", json=q,
+                        timeout=120).json()["predictions"]
+        np.testing.assert_allclose(np.asarray(after),
+                                   np.asarray(before), atol=1e-5)
+    platform.admin.stop_inference_job(inf["id"])
