@@ -133,3 +133,18 @@ def test_accuracy_parity_script():
     r = _run("examples/scripts/accuracy_parity.py", timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ACCURACY PARITY OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_parallelism_tour():
+    r = _run("examples/scripts/parallelism_tour.py", timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PARALLELISM TOUR OK" in r.stdout
+    # dp / sp-ring / sp-alltoall / pp are numerically transparent: the
+    # same model + seed scores identically under each.
+    import re
+
+    scores = {m.group(1): m.group(2) for m in re.finditer(
+        r"(\S[\w ]+?)\s+mesh\[.*?\] token-acc=([\d.]+)", r.stdout)}
+    assert scores["dp only"] == scores["sp ring"] == \
+        scores["sp alltoall"] == scores["pp gpipe"]
