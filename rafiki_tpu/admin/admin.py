@@ -328,18 +328,44 @@ class Admin:
         return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
 
     def get_status(self) -> Dict[str, Any]:
-        """Node status for operators: chip allocation + live services."""
+        """Node status for operators: chip allocation, live services,
+        and — with several nodes sharing this meta store — a per-node
+        cluster view (service counts + heartbeat age, so a stalled
+        join node is visible before its lease expires)."""
         alloc = self.services.allocator
         running = self.meta.get_services(status="RUNNING")
         by_type: Dict[str, int] = {}
+        nodes: Dict[str, Dict[str, Any]] = {}
+        now = time.time()
+        this_node = self.services.node_id
         for s in running:
             by_type[s["service_type"]] = by_type.get(s["service_type"],
                                                      0) + 1
+            # NULL node_id rows (pre-upgrade databases) attribute to
+            # whoever adopted them — the same ownership rule the
+            # supervisor applies — so a one-node cluster never renders
+            # a phantom "(unowned)" second node.
+            own = self.services._ownership(s)
+            nid = this_node if own == "local" else (
+                s.get("node_id") or "(unowned)")
+            node = nodes.setdefault(nid, {"services": 0,
+                                          "heartbeat_age_s": None})
+            node["services"] += 1
+            hb = self.services.last_heartbeat(s)
+            if hb:
+                age = round(max(0.0, now - hb), 1)
+                if node["heartbeat_age_s"] is None \
+                        or age < node["heartbeat_age_s"]:
+                    node["heartbeat_age_s"] = age
+        nodes.setdefault(this_node, {"services": 0,
+                                     "heartbeat_age_s": 0.0})
         return {
             "n_chips": alloc.n_chips,
             "free_chips": alloc.free_chips,
             "chip_allocation": round(alloc.utilization(), 4),
             "services_running": by_type,
+            "node_id": this_node,
+            "nodes": nodes,
         }
 
     # --- User administration (ADMIN-only; enforced by the REST layer) ---

@@ -228,11 +228,17 @@ class ServicesManager:
             return "local" if self.adopt_unowned else "unowned-skip"
         return "foreign"
 
+    @staticmethod
+    def last_heartbeat(svc: Dict[str, Any]) -> float:
+        """The service's last liveness signal (creation counts as the
+        first heartbeat) — the ONE definition shared by lease checks
+        and the /status cluster view."""
+        return svc.get("heartbeat_at") or svc.get("created_at") or 0.0
+
     def _lease_fresh(self, svc: Dict[str, Any]) -> bool:
         import time
 
-        hb = svc.get("heartbeat_at") or svc.get("created_at") or 0.0
-        return (time.time() - hb) <= self.NODE_LEASE
+        return (time.time() - self.last_heartbeat(svc)) <= self.NODE_LEASE
 
     def heartbeat(self) -> None:
         """Refresh this node's liveness lease (called by the platform's

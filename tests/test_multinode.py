@@ -249,3 +249,47 @@ def test_jax_distributed_cpu_pair(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
         assert "GLOBAL 2 LOCAL 1" in out, out
+
+
+def test_status_reports_cluster_nodes(tmp_path, synth_image_data,
+                                      broker):
+    """/status carries the per-node cluster view when several nodes
+    share the meta store: each node's service count + heartbeat age."""
+    train_path, val_path = synth_image_data
+    shared = str(tmp_path / "shared")
+    node_a = LocalPlatform(workdir=shared, bus_uri=broker.uri,
+                           supervise_interval=0)
+    node_b = None
+    try:
+        dev = node_a.admin.create_user("dev@x.c", "pw",
+                                       UserType.MODEL_DEVELOPER)
+        model = node_a.admin.create_model(
+            dev["id"], "ff", TaskType.IMAGE_CLASSIFICATION, FF_CLASS)
+        job = node_a.admin.create_train_job(
+            dev["id"], "app", TaskType.IMAGE_CLASSIFICATION,
+            [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 4},
+            train_path, val_path)
+        node_b = LocalPlatform(workdir=shared, bus_uri=broker.uri,
+                               supervise_interval=0,
+                               stop_jobs_on_shutdown=False,
+                               node_id="vm/join-status")
+        assert node_b.admin.attach_workers(job["id"])
+        # The joined worker reaches RUNNING asynchronously — poll.
+        deadline = time.monotonic() + 120
+        status = node_a.admin.get_status()
+        while "vm/join-status" not in status["nodes"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+            status = node_a.admin.get_status()
+        assert status["node_id"] == node_a.services.node_id
+        assert "vm/join-status" in status["nodes"]
+        joined = status["nodes"]["vm/join-status"]
+        assert joined["services"] >= 1
+        assert joined["heartbeat_age_s"] is not None
+        assert joined["heartbeat_age_s"] < 60
+        assert node_a.admin.wait_until_train_job_done(job["id"],
+                                                      timeout=600)
+    finally:
+        if node_b is not None:
+            node_b.shutdown()
+        node_a.shutdown()
