@@ -1,6 +1,14 @@
 """Benchmarks over the BASELINE.md configs; prints ONE JSON line.
 
-Default (no args): AutoML trials/hour on the PR1 reference config —
+Default (no args): when the accelerator probe succeeds, the FULL sweep —
+every config below runs and the one JSON line carries a per-config
+record under ``configs`` (headline fields = config 1, trials/hour), so a
+single driver invocation captures complete evidence for every BASELINE
+row. On CPU fallback the default degrades to the single fast config
+(``trials``) — the cross-platform numbers would be meaningless and the
+heavy configs would take hours on 1 core.
+
+``--config trials``: AutoML trials/hour on the PR1 reference config —
 K full trials (propose -> train -> evaluate) of JaxFeedForward on a
 synthetic fashion-MNIST-shaped dataset.
 
@@ -30,6 +38,12 @@ import time
 
 import numpy as np
 
+# Every recorded baseline below was measured on the tunneled TPU
+# (backend name "axon"; a directly attached chip reports "tpu").
+# vs_baseline against them is only meaningful from the same hardware
+# class, so records from any other platform carry vs_baseline = null.
+BASELINE_PLATFORMS = ("axon", "tpu")
+
 # Recorded from the first v5e-1 run of this script (see BASELINE.md,
 # 2026-07-30). None => this run establishes the baseline
 # (vs_baseline = 1.0).
@@ -56,21 +70,28 @@ class _UtilProbe:
 
     def __init__(self):
         self.values = []
+        self._prior = None
 
     def __enter__(self) -> "_UtilProbe":
         from rafiki_tpu.model.logger import logger
 
         self._logger = logger
+        # The sink binding is thread-local; save whatever this thread had
+        # installed and chain to it so a probe never swallows records a
+        # surrounding harness (or a prior probe) was collecting.
+        self._prior = logger.current_sink()
         logger.set_sink(self._collect)
         return self
 
     def __exit__(self, *exc) -> None:
-        self._logger.set_sink(None)
+        self._logger.set_sink(self._prior)
 
     def _collect(self, rec) -> None:
         util = (rec.get("values") or {}).get("chip_util")
         if util is not None:
             self.values.append(float(util))
+        if self._prior is not None:
+            self._prior(rec)
 
     def fields(self) -> dict:
         if not self.values:
@@ -82,7 +103,7 @@ class _UtilProbe:
                 "chip_util_peak": round(max(self.values), 4)}
 
 
-def main() -> None:
+def main() -> dict:
     import tempfile
 
     from rafiki_tpu.advisor import make_advisor
@@ -110,8 +131,9 @@ def main() -> None:
                 elapsed = min(elapsed, time.time() - t0)
 
     trials_per_hour = N_TRIALS / (elapsed / 3600.0)
-    _emit("automl_trials_per_hour", trials_per_hour, "trials/hour",
-          BASELINE_TRIALS_PER_HOUR, **probe.fields())
+    return _emit("automl_trials_per_hour", trials_per_hour,
+                 "trials/hour", BASELINE_TRIALS_PER_HOUR,
+                 **probe.fields())
 
 
 def _run_trial(model_class, advisor, train_path: str, val_path: str) -> float:
@@ -125,16 +147,31 @@ def _run_trial(model_class, advisor, train_path: str, val_path: str) -> float:
 
 
 def _emit(metric: str, value: float, unit: str, baseline,
-          **extra) -> None:
+          **extra) -> dict:
+    """Build (and return) one config's record. The caller — single-config
+    mode or the sweep — owns printing; config functions just return this."""
     import jax
 
-    vs = 1.0 if baseline is None else value / baseline
-    print(json.dumps({"metric": metric, "value": round(value, 2),
-                      "unit": unit, "vs_baseline": round(vs, 3),
-                      "platform": jax.default_backend(), **extra}))
+    platform = jax.default_backend()
+    if platform not in BASELINE_PLATFORMS:
+        # Recorded baselines are TPU figures; a CPU/other-platform value
+        # compared against them is nonsense (a 9x "win" from a CPU run
+        # is the bug this guards against).
+        vs = None
+    elif baseline is None:
+        vs = 1.0  # this run establishes the baseline
+    else:
+        vs = round(value / baseline, 3)
+    rec = {"metric": metric, "value": round(value, 2), "unit": unit,
+           "vs_baseline": vs, "platform": platform, **extra}
+    if "chip_util" in rec:
+        rec["chip_util_basis"] = ("spec-peak" if platform in
+                                  BASELINE_PLATFORMS
+                                  else "calibrated-cpu-roofline")
+    return rec
 
 
-def main_serving() -> None:
+def main_serving() -> dict:
     """Config[3]: ensemble QPS through Predictor HTTP + workers."""
     import tempfile
 
@@ -220,11 +257,11 @@ def main_serving() -> None:
             platform.admin.stop_inference_job(inf["id"])
         finally:
             platform.shutdown()
-    _emit("ensemble_inference_qps", qps, "queries/s",
-          BASELINE_SERVING_QPS)
+    return _emit("ensemble_inference_qps", qps, "queries/s",
+                 BASELINE_SERVING_QPS)
 
 
-def main_serving_openloop() -> None:
+def main_serving_openloop() -> dict:
     """Open-loop serving: ensemble QPS at saturation with request
     arrival decoupled from completion (VERDICT r1 item 5).
 
@@ -313,13 +350,13 @@ def main_serving_openloop() -> None:
                 platform.shutdown()
             _os.environ.pop("RAFIKI_TPU_SERVING_PIPELINE", None)
 
-    _emit("serving_openloop_qps", results["on"], "queries/s",
-          BASELINE_OPENLOOP_QPS,
-          qps_no_pipeline=round(results["off"], 2),
-          pipeline_speedup=round(results["on"] / results["off"], 3))
+    return _emit("serving_openloop_qps", results["on"], "queries/s",
+                 BASELINE_OPENLOOP_QPS,
+                 qps_no_pipeline=round(results["off"], 2),
+                 pipeline_speedup=round(results["on"] / results["off"], 3))
 
 
-def main_multitenant() -> None:
+def main_multitenant() -> dict:
     """Config[4]: aggregate trials/hour, two jobs contending for chips."""
     import tempfile
 
@@ -360,11 +397,12 @@ def main_multitenant() -> None:
         finally:
             platform.shutdown()
     total = 2 * trials_per_job
-    _emit("multitenant_trials_per_hour", total / (elapsed / 3600.0),
-          "trials/hour", BASELINE_MT_TRIALS_PER_HOUR)
+    return _emit("multitenant_trials_per_hour",
+                 total / (elapsed / 3600.0), "trials/hour",
+                 BASELINE_MT_TRIALS_PER_HOUR)
 
 
-def main_densenet() -> None:
+def main_densenet() -> dict:
     """Config[1]: flagship DenseNet-121 training throughput (CIFAR-10
     shapes). A first train() pays the XLA compile; the timed second run
     reuses the cached AOT step, so the figure is steady-state."""
@@ -397,11 +435,12 @@ def main_densenet() -> None:
                 m.destroy()
 
     images = (2048 // batch) * batch * epochs
-    _emit("densenet_train_images_per_sec", images / elapsed, "images/s",
-          BASELINE_DENSENET_IMAGES_PER_SEC, **probe.fields())
+    return _emit("densenet_train_images_per_sec", images / elapsed,
+                 "images/s", BASELINE_DENSENET_IMAGES_PER_SEC,
+                 **probe.fields())
 
 
-def main_enas() -> None:
+def main_enas() -> dict:
     """Config[2]: ENAS architecture search — controller advisor proposing
     architectures into weight-shared quick trials on the masked supernet."""
     import tempfile
@@ -427,17 +466,19 @@ def main_enas() -> None:
             budget={BudgetOption.MODEL_TRIAL_COUNT: 2 * n_trials + 1})
         runner.run_one()  # warm-up: pays the one supernet compile
         elapsed = float("inf")
-        for _ in range(2):  # best of two windows (see module docstring)
-            t0 = time.time()
-            for _ in range(n_trials):
-                runner.run_one()
-            elapsed = min(elapsed, time.time() - t0)
+        with _UtilProbe() as probe:
+            for _ in range(2):  # best of two windows (module docstring)
+                t0 = time.time()
+                for _ in range(n_trials):
+                    runner.run_one()
+                elapsed = min(elapsed, time.time() - t0)
 
-    _emit("enas_trials_per_hour", n_trials / (elapsed / 3600.0),
-          "trials/hour", BASELINE_ENAS_TRIALS_PER_HOUR)
+    return _emit("enas_trials_per_hour", n_trials / (elapsed / 3600.0),
+                 "trials/hour", BASELINE_ENAS_TRIALS_PER_HOUR,
+                 **probe.fields())
 
 
-def main_attention() -> None:
+def main_attention() -> dict:
     """Flash-attention kernel throughput (bf16, causal, T=8192) on the
     real chip. The tunneled TPU hides up to ~0.7 s of compute inside its
     sync latency, so the op loops inside ONE jit via lax.scan and the
@@ -482,8 +523,8 @@ def main_attention() -> None:
     # directly attached chip has none.
     overhead = 0.7 if jax.default_backend() == "axon" else 0.0
     per_iter = max(best - overhead, 1e-9) / N
-    _emit("flash_attention_tflops", flops / per_iter / 1e12, "TFLOP/s",
-          BASELINE_ATTENTION_TFLOPS)
+    return _emit("flash_attention_tflops", flops / per_iter / 1e12,
+                 "TFLOP/s", BASELINE_ATTENTION_TFLOPS)
 
 
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
@@ -512,16 +553,51 @@ _CONFIGS = {
 }
 
 
-if __name__ == "__main__":
-    import argparse
+# Sweep execution order: cheap kernels and single-process loops first
+# (they establish the headline even if a later platform-heavy config
+# wedges), then the serving stacks, then multitenant (which needs >= 2
+# chips and records a skip otherwise).
+_SWEEP_ORDER = ["trials", "densenet", "enas", "attention", "serving",
+                "serving-openloop", "multitenant"]
+
+
+def _run_config(name: str, platform: str) -> dict:
+    """One config → one record, whatever happens (the driver must always
+    get its JSON line; a crash in config N must not lose configs 1..N-1)."""
     import sys
     import traceback
 
+    fn, metric, unit = _CONFIGS[name]
+    t0 = time.time()
+    try:
+        rec = fn()
+    except SystemExit as e:  # unmet precondition (devices, platform)
+        rec = {"metric": metric, "value": 0.0, "unit": unit,
+               "vs_baseline": None, "platform": platform,
+               "error": str(e)}
+    except Exception as e:
+        traceback.print_exc(file=sys.stderr)
+        rec = {"metric": metric, "value": 0.0, "unit": unit,
+               "vs_baseline": None, "platform": platform,
+               "error": f"{type(e).__name__}: {e}"}
+    rec["seconds"] = round(time.time() - t0, 1)
+    print(f"[bench] {name}: {rec.get('value')} {rec.get('unit')} "
+          f"in {rec['seconds']}s"
+          + (f" ERROR {rec['error']}" if "error" in rec else ""),
+          file=sys.stderr)
+    return rec
+
+
+def _main_cli() -> None:
+    import argparse
+    import os
+
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="trials",
-                        choices=sorted(_CONFIGS))
+    parser.add_argument(
+        "--config", default=None, choices=sorted(_CONFIGS) + ["sweep"],
+        help="one config, or 'sweep' for all. Default: sweep on the "
+             "accelerator, 'trials' on CPU fallback.")
     args = parser.parse_args()
-    fn, metric, unit = _CONFIGS[args.config]
 
     # Resolve the platform BEFORE any backend touch. The site hook
     # latches jax_platforms to the accelerator regardless of
@@ -535,16 +611,34 @@ if __name__ == "__main__":
     except Exception:
         platform = "unknown"
 
-    try:
-        fn()
-    except SystemExit as e:
-        if e.code in (0, None):
-            raise
-        print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
-                          "vs_baseline": 0.0, "platform": platform,
-                          "error": str(e)}))
-    except Exception as e:
-        traceback.print_exc(file=sys.stderr)
-        print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
-                          "vs_baseline": 0.0, "platform": platform,
-                          "error": f"{type(e).__name__}: {e}"}))
+    config = args.config
+    if config is None:
+        config = "sweep" if platform in BASELINE_PLATFORMS else "trials"
+
+    if config != "sweep":
+        print(json.dumps(_run_config(config, platform)))
+        return
+
+    # Full sweep: ONE line, headline = config 1 (trials/hour), every
+    # config's record under "configs". RAFIKI_TPU_BENCH_CONFIGS can
+    # subset (comma-separated) when a manual run wants fewer. A mistyped
+    # or effectively-empty subset must not cost the JSON line: unknown
+    # names are reported and skipped, an empty result falls back to the
+    # full order.
+    import sys
+
+    subset = os.environ.get("RAFIKI_TPU_BENCH_CONFIGS", "").strip()
+    names = [n.strip() for n in subset.split(",") if n.strip()]
+    unknown = [n for n in names if n not in _CONFIGS]
+    if unknown:
+        print(f"[bench] ignoring unknown config name(s) {unknown} in "
+              f"RAFIKI_TPU_BENCH_CONFIGS (valid: {sorted(_CONFIGS)})",
+              file=sys.stderr)
+    names = [n for n in names if n in _CONFIGS] or _SWEEP_ORDER
+    configs = {name: _run_config(name, platform) for name in names}
+    headline = configs.get("trials") or next(iter(configs.values()))
+    print(json.dumps({**headline, "sweep": True, "configs": configs}))
+
+
+if __name__ == "__main__":
+    _main_cli()

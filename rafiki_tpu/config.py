@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import typing
 from dataclasses import dataclass
-from typing import Any, Dict, Mapping, Optional
+from typing import Any, Dict, Mapping, Optional, Union
 
 _PREFIX = "RAFIKI_TPU_"
 
@@ -61,6 +62,7 @@ class NodeConfig:
         "trace_dir": "RAFIKI_TPU_TRACE_DIR",
         "probe_timeout": "RAFIKI_TPU_PROBE_TIMEOUT",
     }
+    _types_cache = None  # deliberately un-annotated: not a field
 
     @classmethod
     def env_name(cls, field: str) -> str:
@@ -86,19 +88,42 @@ class NodeConfig:
 
     @classmethod
     def _coerce(cls, name: str, raw: str) -> Any:
-        hints = {f.name: f.type for f in dataclasses.fields(cls)}
-        hint = str(hints[name])
+        target = cls._field_types().get(name, str)
         try:
-            if "bool" in hint:
+            if target is bool:
                 return _parse_bool(raw)
-            if "int" in hint:
+            if target is int:
                 return int(raw)
-            if "float" in hint:
+            if target is float:
                 return float(raw)
         except ValueError as e:
             raise ValueError(
                 f"{cls.env_name(name)}={raw!r}: {e}") from None
         return raw
+
+    @classmethod
+    def _field_types(cls) -> Dict[str, type]:
+        """Resolved (Optional-unwrapped) scalar type per field. Fields
+        whose hint is not a plain scalar / Optional[scalar] stay str —
+        adding such a field must extend ``_coerce``, loudly, instead of
+        being silently substring-matched to the wrong parser."""
+        if cls._types_cache is None:
+            resolved: Dict[str, type] = {}
+            hints = typing.get_type_hints(cls)
+            import types as _types
+
+            # Optional[x] resolves to typing.Union; a PEP 604 `x | None`
+            # resolves to types.UnionType — unwrap both.
+            union_kinds = (Union, getattr(_types, "UnionType", Union))
+            for f in dataclasses.fields(cls):
+                hint = hints.get(f.name, str)
+                if typing.get_origin(hint) in union_kinds:
+                    args = [a for a in typing.get_args(hint)
+                            if a is not type(None)]
+                    hint = args[0] if len(args) == 1 else str
+                resolved[f.name] = hint if isinstance(hint, type) else str
+            cls._types_cache = resolved
+        return cls._types_cache
 
     def validate(self) -> "NodeConfig":
         if not (0 <= self.port <= 65535):

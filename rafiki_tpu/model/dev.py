@@ -50,7 +50,17 @@ def test_model_class(model_class: Type[BaseModel], task: str,
     _log.info("test_model_class: knobs=%s", knobs)
 
     records = []
-    logger.set_sink(records.append)
+    # Save + restore the caller's sink binding (same invariant as
+    # logger.current_sink documents): a harness wrapping this helper in
+    # its own capture must not lose it when we return.
+    prior_sink = logger.current_sink()
+
+    def _capture(rec, _prior=prior_sink):
+        records.append(rec)
+        if _prior is not None:
+            _prior(rec)
+
+    logger.set_sink(_capture)
     try:
         # 3. Train → evaluate.
         model = model_class(**knobs)
@@ -78,7 +88,7 @@ def test_model_class(model_class: Type[BaseModel], task: str,
                 "predict() must return one result per query"
         model2.destroy()
     finally:
-        logger.set_sink(None)
+        logger.set_sink(prior_sink)
 
     return TestModelResult(score=score, predictions=predictions,
                            knobs=knobs, log_records=records,

@@ -31,6 +31,13 @@ class ModelLogger:
     def set_sink(self, sink: Optional[LogSink]) -> None:
         self._tls.sink = sink
 
+    def current_sink(self) -> Optional[LogSink]:
+        """This thread's sink binding. Harnesses that install a
+        temporary sink (bench probes, trial runners) must save this and
+        restore it — and usually chain to it — rather than nulling the
+        binding on exit."""
+        return getattr(self._tls, "sink", None)
+
     def _emit(self, record: LogRecord) -> None:
         record.setdefault("time", time.time())
         sink = getattr(self._tls, "sink", None)

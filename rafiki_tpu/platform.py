@@ -51,6 +51,47 @@ class LocalPlatform:
         self.workdir = workdir
         os.makedirs(workdir, exist_ok=True)
 
+        # Node identity must be STABLE across restarts of the same node
+        # (host + workdir), or a crashed node's RUNNING service rows
+        # would be orphaned forever: the pid-scoped supervise sweep of a
+        # restarted process would never match them. Secondary (join)
+        # nodes pass an explicit unique node_id instead — they share the
+        # primary's workdir and must not collide with it.
+        self._lock_fd = None
+        if not node_id:
+            import hashlib
+            import socket
+
+            wd = hashlib.sha1(
+                os.path.abspath(workdir).encode()).hexdigest()[:8]
+            node_id = f"{socket.gethostname()}/{wd}"
+            # Identity is shared by DESIGN across restarts — but two
+            # live primaries on the same workdir would each judge the
+            # other's services through their own container manager and
+            # kill healthy workers. An exclusive flock held for the
+            # process lifetime makes the second startup fail fast
+            # instead — BEFORE this process opens the running primary's
+            # meta.db/bus (a doomed duplicate must not touch them, and
+            # the refusal path must have nothing to leak). Join nodes
+            # pass explicit unique ids and share the workdir
+            # legitimately.
+            self._lock_fd = os.open(os.path.join(workdir, "node.lock"),
+                                    os.O_CREAT | os.O_RDWR, 0o644)
+            import fcntl
+
+            try:
+                fcntl.flock(self._lock_fd,
+                            fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(self._lock_fd)
+                self._lock_fd = None
+                raise RuntimeError(
+                    f"another primary node already serves workdir "
+                    f"{workdir!r} (node_id {node_id}); a second one "
+                    f"would supervise-kill the first's workers. Use a "
+                    f"different workdir, or join the cluster with "
+                    f"`rafiki_tpu join`.") from None
+
         meta_uri = os.path.join(workdir, "meta.db")
         params_dir = os.path.join(workdir, "params")
         self.meta = MetaStore(meta_uri)
@@ -60,19 +101,6 @@ class LocalPlatform:
                                  bus=self.bus)
         self.container = ThreadContainerManager(self.ctx)
         self.allocator = ChipAllocator(n_chips)
-        # Node identity must be STABLE across restarts of the same node
-        # (host + workdir), or a crashed node's RUNNING service rows
-        # would be orphaned forever: the pid-scoped supervise sweep of a
-        # restarted process would never match them. Secondary (join)
-        # nodes pass an explicit unique node_id instead — they share the
-        # primary's workdir and must not collide with it.
-        if not node_id:
-            import hashlib
-            import socket
-
-            wd = hashlib.sha1(
-                os.path.abspath(workdir).encode()).hexdigest()[:8]
-            node_id = f"{socket.gethostname()}/{wd}"
         self.services = ServicesManager(
             self.meta, self.container, self.allocator,
             meta_uri=meta_uri, params_dir=params_dir, bus_uri=bus_uri,
@@ -149,5 +177,8 @@ class LocalPlatform:
         self.params.close()
         if isinstance(self.bus, MemoryBus):
             MemoryBus.reset_shared()
+        if self._lock_fd is not None:  # releases the flock too
+            os.close(self._lock_fd)
+            self._lock_fd = None
         if self._tmp is not None:
             self._tmp.cleanup()

@@ -108,8 +108,16 @@ class Predictor:
         ensemble, so each request picks one per bin, rotating across
         requests for load balance. The hot path costs one registry
         keys() scan; per-worker info reads are memoized."""
+        workers = sorted(self._wait_workers())
+        # Prune memo entries for departed workers once the map clearly
+        # outgrows the live set — long-lived predictors otherwise
+        # accumulate a row per worker restart, forever.
+        if len(self._bins) > 2 * len(workers) + 8:
+            live = set(workers)
+            self._bins = {w: b for w, b in self._bins.items()
+                          if w in live}
         groups: Dict[str, List[str]] = {}
-        for w in sorted(self._wait_workers()):
+        for w in workers:
             groups.setdefault(self._bin_of(w), []).append(w)
         self._rr += 1
         return [members[self._rr % len(members)]

@@ -160,8 +160,19 @@ class TrialRunner:
             status=TrialStatus.RUNNING, worker_id=self.worker_id,
             knobs=_jsonable_knobs(recorded), proposal=proposal.to_json())
         trial_id = trial["id"]
-        logger.set_sink(lambda rec, _tid=trial_id:
-                        self.meta.add_trial_log(_tid, rec))
+
+        # Save + chain whatever sink this thread already had (a bench
+        # harness's utilization probe, a test capture): the trial's
+        # records go to the meta store AND keep flowing outward, and the
+        # prior binding is restored afterwards instead of nulled.
+        prior_sink = logger.current_sink()
+
+        def _trial_sink(rec, _tid=trial_id, _prior=prior_sink):
+            self.meta.add_trial_log(_tid, rec)
+            if _prior is not None:
+                _prior(rec)
+
+        logger.set_sink(_trial_sink)
         t0 = time.time()
         try:
             model = self.model_class(**knobs)
@@ -207,7 +218,7 @@ class TrialRunner:
             _log.warning("trial %s #%d errored:\n%s", trial_id[:8],
                          proposal.trial_no, err)
         finally:
-            logger.set_sink(None)
+            logger.set_sink(prior_sink)
         return self.meta.get_trial(trial_id)
 
 

@@ -1,0 +1,85 @@
+"""bench.py record semantics — the driver-facing contract.
+
+The driver parses bench.py's one JSON line into BENCH_r{N}.json; these
+tests pin the parts a human later reads off that artifact: platform-
+correct vs_baseline (a CPU value compared against a TPU baseline must
+read as null, not a 9x win), per-config error records that never lose
+the sweep, and a utilization probe that chains rather than swallows
+whatever log sink the surrounding harness installed.
+"""
+
+import json
+import subprocess
+import sys
+
+import bench
+from rafiki_tpu.model.logger import logger
+
+
+def test_emit_nulls_vs_baseline_off_platform():
+    # Tests run on CPU (conftest), which is not in BASELINE_PLATFORMS.
+    rec = bench._emit("m", 2468.0, "u", 268.0)
+    assert rec["platform"] == "cpu"
+    assert rec["vs_baseline"] is None
+
+
+def test_emit_ratio_on_baseline_platform(monkeypatch):
+    monkeypatch.setattr(bench, "BASELINE_PLATFORMS", ("cpu",))
+    assert bench._emit("m", 536.0, "u", 268.0)["vs_baseline"] == 2.0
+    # baseline None = this run establishes it
+    assert bench._emit("m", 536.0, "u", None)["vs_baseline"] == 1.0
+
+
+def test_emit_labels_chip_util_basis(monkeypatch):
+    rec = bench._emit("m", 1.0, "u", None, chip_util=0.5)
+    assert rec["chip_util_basis"] == "calibrated-cpu-roofline"
+    monkeypatch.setattr(bench, "BASELINE_PLATFORMS", ("cpu",))
+    rec = bench._emit("m", 1.0, "u", None, chip_util=0.5)
+    assert rec["chip_util_basis"] == "spec-peak"
+
+
+def test_util_probe_chains_and_restores_prior_sink():
+    seen = []
+    logger.set_sink(seen.append)
+    try:
+        with bench._UtilProbe() as probe:
+            logger.log(chip_util=0.42, loss=1.0)
+        assert probe.values == [0.42]
+        # The pre-existing sink saw the record too...
+        assert seen and seen[0]["values"]["chip_util"] == 0.42
+        # ...and is back in place after the probe exits.
+        logger.log(loss=0.5)
+        assert len(seen) == 2
+    finally:
+        logger.set_sink(None)
+
+
+def test_run_config_captures_systemexit_as_error_record():
+    rec = bench._run_config("attention", "cpu")  # needs TPU -> SystemExit
+    assert rec["metric"] == "flash_attention_tflops"
+    assert rec["value"] == 0.0 and rec["vs_baseline"] is None
+    assert "error" in rec and "seconds" in rec
+
+
+def test_sweep_emits_one_line_with_per_config_records():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    # "attn" is a deliberate typo: unknown names must be skipped with a
+    # note, not crash the sweep before its one JSON line.
+    env.update({"RAFIKI_TPU_BENCH_CONFIGS": "attn,attention,multitenant",
+                "RAFIKI_TPU_PROBE_TIMEOUT": "5"})
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--config", "sweep"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["sweep"] is True
+    assert set(rec["configs"]) == {"attention", "multitenant"}
+    assert "ignoring unknown config name(s) ['attn']" in out.stderr
+    for sub in rec["configs"].values():  # both unrunnable on 1-dev CPU
+        assert "error" in sub and sub["vs_baseline"] is None

@@ -22,6 +22,7 @@ TINY_KNOBS = {"arch": "densenet_tiny", "growth_rate": 8,
 
 
 @pytest.mark.slow
+@pytest.mark.slower
 def test_densenet_end_to_end(synth_image_data):
     train_path, val_path = synth_image_data
     ds = load_image_dataset(val_path)

@@ -147,6 +147,7 @@ def test_enas_fixed_path_params_subset_of_supernet():
 
 
 @pytest.mark.slow
+@pytest.mark.slower
 def test_enas_search_loop_with_advisor_and_sharing(synth_image_data,
                                                    tmp_path):
     """End-to-end miniature of §3.5: EnasAdvisor proposes, TrialRunner

@@ -127,6 +127,7 @@ def test_sklearn_real_dataset_converters(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.slower
 def test_accuracy_parity_script():
     """The one-script accuracy-parity check (BASELINE.md table) stays
     reproducible: every model lands in its published band."""

@@ -30,6 +30,7 @@ def broker():
 
 
 @pytest.mark.slow
+@pytest.mark.slower
 def test_one_job_split_across_two_nodes(tmp_path, synth_image_data,
                                         broker):
     train_path, val_path = synth_image_data

@@ -408,6 +408,42 @@ def test_predictor_round_robins_same_bin_replicas():
     assert len(set(picks)) == 2
 
 
+def test_predictor_prunes_bins_of_departed_workers():
+    """The worker->bin memo must not grow monotonically across worker
+    restarts (a long-lived predictor under churn would otherwise leak a
+    row per restart, forever)."""
+    from rafiki_tpu.bus import MemoryBus
+    from rafiki_tpu.cache import Cache
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    bus = MemoryBus()
+    cache = Cache(bus)
+    cache.register_worker("job", "w-live", info={"trial_id": "t"})
+    p = Predictor("job", bus, worker_wait_timeout=1.0)
+    for i in range(40):  # churned-away workers, memoized then gone
+        p._bins[f"w-dead-{i}"] = "t-old"
+    assert p._choose_workers() == ["w-live"]
+    assert set(p._bins) == {"w-live"}
+
+
+def test_second_primary_on_same_workdir_is_refused(tmp_path):
+    """Two primaries sharing one workdir share a node_id by design
+    (restart stability) — so a LIVE second one must be refused at
+    startup, before its supervise sweep can kill the first's workers."""
+    from rafiki_tpu.platform import LocalPlatform
+
+    p1 = LocalPlatform(workdir=str(tmp_path / "w"), supervise_interval=0)
+    try:
+        with pytest.raises(RuntimeError, match="another primary"):
+            LocalPlatform(workdir=str(tmp_path / "w"),
+                          supervise_interval=0)
+    finally:
+        p1.shutdown()
+    # A clean restart of the SAME node (after shutdown) is legitimate.
+    LocalPlatform(workdir=str(tmp_path / "w"),
+                  supervise_interval=0).shutdown()
+
+
 @pytest.mark.slow
 def test_inference_replica_attach_keeps_ensemble_semantics(
         platform, synth_image_data):
