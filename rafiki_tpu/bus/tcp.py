@@ -53,19 +53,34 @@ class _Handler(socketserver.BaseRequestHandler):
         bus: MemoryBus = self.server.bus  # type: ignore[attr-defined]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        while True:
-            try:
-                req = _recv_frame(sock)
-            except (ConnectionError, OSError):
-                return
-            try:
-                resp = {"ok": True, "value": self._dispatch(bus, req)}
-            except Exception as e:  # report, keep the connection alive
-                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
-            try:
-                _send_frame(sock, resp)
-            except (ConnectionError, OSError):
-                return
+        # Track the live connection so a server stop() can sever it:
+        # without this, handler threads outlive shutdown() and keep
+        # serving the ORPHANED in-memory bus — clients would never
+        # notice the broker "died" and never migrate to its successor
+        # (a process kill closes these sockets; an in-process stop
+        # must behave the same).
+        conns = self.server.conns  # type: ignore[attr-defined]
+        with self.server.conns_lock:  # type: ignore[attr-defined]
+            conns.add(sock)
+        try:
+            while True:
+                try:
+                    req = _recv_frame(sock)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    resp = {"ok": True,
+                            "value": self._dispatch(bus, req)}
+                except Exception as e:  # report, keep connection alive
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                try:
+                    _send_frame(sock, resp)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            with self.server.conns_lock:  # type: ignore[attr-defined]
+                conns.discard(sock)
 
     @staticmethod
     def _dispatch(bus: MemoryBus, req: dict) -> Any:
@@ -109,6 +124,9 @@ class BusServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._server = _Server((host, port), _Handler)
         self._server.bus = MemoryBus()  # type: ignore[attr-defined]
+        self._server.conns = set()  # type: ignore[attr-defined]
+        self._server.conns_lock = (  # type: ignore[attr-defined]
+            threading.Lock())
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="bus-server", daemon=True)
@@ -124,6 +142,20 @@ class BusServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Sever live connections so stop() is indistinguishable from a
+        # broker-process death: blocked client ops fail NOW instead of
+        # quietly continuing against the orphaned in-memory state.
+        with self._server.conns_lock:  # type: ignore[attr-defined]
+            conns = list(self._server.conns)  # type: ignore[attr-defined]
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def serve_forever(self) -> None:
         """Run in the foreground (broker-process entrypoint)."""

@@ -1,7 +1,8 @@
 """Dataset preparation (SURVEY.md §2 "Dataset prep scripts")."""
 
 from .prep import prepare_cifar10, prepare_fashion_mnist
-from .real import prepare_sklearn_digits, prepare_sklearn_tabular
+from .real import (prepare_bundled_pos_corpus, prepare_sklearn_digits,
+                   prepare_sklearn_tabular)
 from .synth import (make_synthetic_corpus_dataset,
                     make_synthetic_image_dataset,
                     make_synthetic_tabular_dataset)
@@ -9,4 +10,5 @@ from .synth import (make_synthetic_corpus_dataset,
 __all__ = ["make_synthetic_image_dataset", "make_synthetic_corpus_dataset",
            "make_synthetic_tabular_dataset",
            "prepare_fashion_mnist", "prepare_cifar10",
-           "prepare_sklearn_digits", "prepare_sklearn_tabular"]
+           "prepare_sklearn_digits", "prepare_sklearn_tabular",
+           "prepare_bundled_pos_corpus"]

@@ -72,3 +72,55 @@ def prepare_sklearn_tabular(name: str, out_dir: str, val_frac: float = 0.2,
         features[va], targets[va],
         os.path.join(out_dir, f"{name}_val.csv"), names)
     return train, val
+
+
+BUNDLED_POS_CORPUS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "examples", "datasets", "english_pos", "corpus.tsv")
+
+
+def prepare_bundled_pos_corpus(out_dir: str, val_frac: float = 0.2,
+                               seed: int = 0,
+                               corpus_tsv: str = "") -> Tuple[str, str]:
+    """The bundled hand-tagged English POS corpus → train/val zip pair.
+
+    329 real English sentences (proverbs, Aesop retellings, public-
+    domain literature, everyday prose) hand-tagged with the 12-tag
+    Universal tagset — see ``examples/datasets/english_pos/README.md``
+    for sources and conventions. This is the real-language counterpart
+    of ``make_synthetic_corpus_dataset`` used for tagger accuracy
+    parity (SURVEY.md §7).
+    """
+    from ..model.dataset import write_corpus_dataset
+
+    path = corpus_tsv or BUNDLED_POS_CORPUS
+    sentences, tags = [], []
+    cur_w: list = []
+    cur_t: list = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                if cur_w:
+                    sentences.append(cur_w)
+                    tags.append(cur_t)
+                    cur_w, cur_t = [], []
+                continue
+            w, t = line.split("\t")
+            cur_w.append(w)
+            cur_t.append(t)
+    if cur_w:
+        sentences.append(cur_w)
+        tags.append(cur_t)
+
+    tag_names = sorted({t for st in tags for t in st})
+    tr, va = _split(len(sentences), val_frac, seed)
+    os.makedirs(out_dir, exist_ok=True)
+    train = write_corpus_dataset(
+        [sentences[i] for i in tr], [tags[i] for i in tr],
+        os.path.join(out_dir, "pos_train.zip"), tag_names)
+    val = write_corpus_dataset(
+        [sentences[i] for i in va], [tags[i] for i in va],
+        os.path.join(out_dir, "pos_val.zip"), tag_names)
+    return train, val

@@ -329,13 +329,23 @@ class JaxModel(BaseModel):
             variables = self._merge_shared(variables, shared_params)
         has_bs = "batch_stats" in variables
 
+        # A caller may size the lr schedule to a LARGER total than this
+        # run executes (``schedule_total_epochs``): successive-halving
+        # rungs all live on ONE schedule shape and each rung's
+        # checkpoint-resume continues it, so the rung sequence is
+        # step-for-step an uninterrupted full-budget run (ASHA warm
+        # starts; see advisor/asha.py).
+        sched_epochs = max(int(kwargs.get("schedule_total_epochs", 0)),
+                           max_epochs)
+
         cache_key = self._step_cache_key(
-            "train", mesh, steps_per_epoch, max_epochs, has_bs)
+            "train", mesh, steps_per_epoch, max_epochs, sched_epochs,
+            has_bs)
         entry = _step_cache_get(cache_key)
         if entry is not None:
             tx, train_chunk = entry["tx"], entry["step"]
         else:
-            tx = self.create_optimizer(steps_per_epoch, max_epochs)
+            tx = self.create_optimizer(steps_per_epoch, sched_epochs)
             module = self._module
             augment = self.augment_in_graph
             base_key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
@@ -557,8 +567,13 @@ class JaxModel(BaseModel):
                     bad_epochs += 1
                     if bad_epochs >= early_stop:
                         break
+            # The final epoch is snapshotted only on request
+            # (checkpoint_final_epoch): a plain trial is complete at
+            # that point, but a successive-halving rung needs its LAST
+            # state on disk — it is exactly where the next rung resumes.
             if mgr is not None and (epoch + 1) % ckpt_every == 0 \
-                    and epoch + 1 < max_epochs:
+                    and (epoch + 1 < max_epochs
+                         or kwargs.get("checkpoint_final_epoch")):
                 self._save_ckpt(mgr, epoch, state, best_loss, bad_epochs)
 
         variables = {"params": jax.device_get(state.params)}

@@ -174,8 +174,10 @@ class JaxTransformerTagger(BaseModel):
             "expert_parallel": FixedKnob(1),
             # > 1 pipelines the encoder blocks over a pp mesh axis
             # (GPipe microbatch schedule; needs n_layers % pp == 0;
-            # exclusive with sequence_parallel / moe for now; dropout
-            # runs deterministic inside the pipeline).
+            # composes with sequence_parallel and dropout — block
+            # params and optimizer state are STORED stage-sharded
+            # (P("pp", ...)), ~1/pp per chip; exclusive with
+            # moe_experts for now).
             "pipeline_parallel": FixedKnob(1),
             # Microbatches per pipeline step; 0 = auto (~4·pp).
             "pp_microbatches": FixedKnob(0),
@@ -211,17 +213,13 @@ class JaxTransformerTagger(BaseModel):
                 if n_layers % pp != 0:
                     raise ValueError(f"pipeline_parallel ({pp}) must "
                                      f"divide n_layers ({n_layers})")
-                if sp > 1 or experts > 0:
+                if experts > 0:
+                    # MoE inside pipelined stages would need expert
+                    # stacks sharded over ep *and* stage-stacked over
+                    # pp simultaneously; not composed yet.
                     raise ValueError(
                         "pipeline_parallel is exclusive with "
-                        "sequence_parallel / moe_experts for now")
-                if float(self.knobs.get("dropout", 0.0)) > 0.0:
-                    # Dropout inside the pipelined stages would need
-                    # per-tick rng threading; silently training
-                    # unregularized would differ from the same knobs
-                    # without pp — reject loudly.
-                    raise ValueError(
-                        "pipeline_parallel requires dropout=0.0")
+                        "moe_experts for now")
             self._mesh = build_mesh(ChipGroup.current().devices(), sp=sp,
                                     ep=ep, pp=pp)
         return self._mesh
@@ -239,51 +237,117 @@ class JaxTransformerTagger(BaseModel):
                 q, k, v, mesh, causal=False, kv_mask=kv_mask, mode=mode)
         return default_attention(causal=False)
 
-    def _pp_logits_fn(self, n_tags: int):
+    # --- pipeline-parallel layout -------------------------------------
+    #
+    # With ``pipeline_parallel > 1`` the encoder blocks are STORED
+    # stage-stacked: a ``{"outer": ..., "stages": {"stage{j}": ...}}``
+    # tree whose stage leaves carry a leading pp axis that
+    # ``shard_variables``' path rule places with ``P("pp", ...)`` —
+    # each chip persistently holds only its own layer span (params AND
+    # optimizer state drop ~1/pp per chip), not just pipelined compute.
+    # ``self._variables`` keeps the ordinary flax layout so init /
+    # dump_parameters / load_parameters / param sharing are unchanged;
+    # the two helpers below convert at the train/predict boundary.
+
+    def _pp_split(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Ordinary flax params → pp layout (host-side, cheap)."""
+        pp = int(self.knobs.get("pipeline_parallel", 1))
+        span = int(self.knobs.get("n_layers", 2)) // pp
+        tmap = jax.tree_util.tree_map
+        outer = {k: v for k, v in params.items()
+                 if not k.startswith("_EncoderBlock_")}
+        stages = {
+            f"stage{j}": tmap(
+                lambda *a: np.stack([np.asarray(x) for x in a]),
+                *[params[f"_EncoderBlock_{s * span + j}"]
+                  for s in range(pp)])
+            for j in range(span)}
+        return {"outer": outer, "stages": stages}
+
+    def _pp_merge(self, pp_params: Dict[str, Any]) -> Dict[str, Any]:
+        """pp layout → ordinary flax params (inverse of ``_pp_split``)."""
+        pp = int(self.knobs.get("pipeline_parallel", 1))
+        span = int(self.knobs.get("n_layers", 2)) // pp
+        tmap = jax.tree_util.tree_map
+        out = dict(pp_params["outer"])
+        for j in range(span):
+            for s in range(pp):
+                out[f"_EncoderBlock_{s * span + j}"] = tmap(
+                    lambda a, _s=s: a[_s], pp_params["stages"][f"stage{j}"])
+        return out
+
+    def _pp_logits_fn(self, n_tags: int, train: bool):
         """Assembled forward for ``pipeline_parallel > 1``: embed →
         GPipe-pipelined encoder blocks (``ops.pipeline_apply`` inside
-        ``shard_map`` over pp, batch sharded over dp) → head, all from
-        the module's ORDINARY parameter tree (init/dump/load are
-        unchanged; stage stacking happens inside the traced step).
-        Compute is pipelined; parameter storage stays replicated —
-        stage-sharded storage is the op-level API's job
-        (``ops.pipelined`` + ``P("pp", ...)`` placement).
-        Dropout runs deterministic inside the pipeline.
+        ``shard_map`` over pp, batch over dp, sequence over sp when
+        ``sequence_parallel > 1``) → head, reading the pp param layout
+        (see ``_pp_split``). Dropout is supported: the key is folded
+        per (optimizer step, schedule tick, stage, sp shard), so every
+        microbatch position draws an independent mask.
+
+        Returns ``logits_fn(pp_params, ids, step_i)``.
         """
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        from ..ops import pipeline_apply
+        from ..ops import pipeline_apply, ring_attention, ulysses_attention
         from ..parallel import PP_AXIS
 
         mesh = self.mesh
         pp = int(self.knobs.get("pipeline_parallel", 1))
+        sp = mesh.shape[SP_AXIS]
         n_layers = int(self.knobs.get("n_layers", 2))
         span = n_layers // pp
         d_model = int(self.knobs.get("d_model", 128))
         vocab = int(self.knobs.get("vocab_size", 16384))
         max_len = int(self.knobs.get("max_len", 128))
         micro = int(self.knobs.get("pp_microbatches", 0))
+        dropout = float(self.knobs.get("dropout", 0.0)) if train else 0.0
+        seed = int(self.knobs.get("seed", 0))
         block = _EncoderBlock(int(self.knobs.get("n_heads", 4)),
-                              dropout=0.0, dtype=jnp.bfloat16)
-        # pp > 1 guarantees sp == 1 (mesh validation), so _attn_fn is
-        # the single-group flash/blockwise dispatch — one copy of the
-        # backend branch.
-        attn = self._attn_fn()
+                              dropout=dropout, dtype=jnp.bfloat16)
+        if sp > 1:
+            # Inside the pp shard_map the sequence dim is already the
+            # local sp shard, so the attention must be the *collective*
+            # form (ring/Ulysses over the sp axis of the SAME
+            # shard_map), not sequence_sharded_attention's own wrapper.
+            mode = str(self.knobs.get("sp_schedule", "ring"))
+            inner = (ring_attention if mode == "ring"
+                     else ulysses_attention)
+            attn = (lambda q, k, v, kv_mask: inner(
+                q, k, v, causal=False, axis_size=sp, kv_mask=kv_mask))
+        else:
+            attn = self._attn_fn()
 
-        def stage_fn(prm, xm):
-            x, mask = xm
-            for j in range(span):
-                x = block.apply({"params": prm[f"stage{j}"]}, x, attn,
-                                mask, deterministic=True)
-            return (x, mask)
+        act_spec = P(DP_AXIS, SP_AXIS) if sp > 1 else P(DP_AXIS)
 
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(P(PP_AXIS), P(DP_AXIS), P(DP_AXIS)),
-            out_specs=P(DP_AXIS), check_vma=False)
-        def run_blocks(stacked, x, mask):
-            local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+            in_specs=(P(PP_AXIS), act_spec, act_spec, P()),
+            out_specs=act_spec, check_vma=False)
+        def run_blocks(stages, x, mask, step_i):
+            local = jax.tree_util.tree_map(lambda a: a[0], stages)
+
+            def stage_fn(prm, xm, t):
+                xx, mm = xm
+                det = dropout == 0.0
+                rngs = None
+                if not det:
+                    key = jax.random.key(seed + 1)
+                    for part in (step_i, t,
+                                 jax.lax.axis_index(PP_AXIS)):
+                        key = jax.random.fold_in(key, part)
+                    if sp > 1:
+                        key = jax.random.fold_in(
+                            key, jax.lax.axis_index(SP_AXIS))
+                for j in range(span):
+                    if not det:
+                        rngs = {"dropout": jax.random.fold_in(key, j)}
+                    xx = block.apply({"params": prm[f"stage{j}"]}, xx,
+                                     attn, mm, deterministic=det,
+                                     rngs=rngs)
+                return (xx, mm)
+
             b = x.shape[0]
             if micro > 0:
                 if b % micro:
@@ -298,26 +362,21 @@ class JaxTransformerTagger(BaseModel):
             xs = x.reshape(m, b // m, *x.shape[1:])
             ms = mask.reshape(m, b // m, *mask.shape[1:])
             out, _ = pipeline_apply(stage_fn, local, (xs, ms),
-                                    axis_size=pp)
+                                    axis_size=pp, stage_takes_tick=True)
             return out.reshape(b, *out.shape[2:])
 
-        def logits_fn(params, ids):
+        def logits_fn(pp_params, ids, step_i):
+            outer = pp_params["outer"]
             mask = ids != PAD_ID
             x = nn.Embed(vocab, d_model, dtype=jnp.bfloat16).apply(
-                {"params": params["Embed_0"]}, ids)
+                {"params": outer["Embed_0"]}, ids)
             pe = jnp.asarray(_sinusoidal(max_len, d_model))
             x = x + pe[None, :ids.shape[1]].astype(x.dtype)
-            stacked = {
-                f"stage{j}": jax.tree_util.tree_map(
-                    lambda *a: jnp.stack(a),
-                    *[params[f"_EncoderBlock_{s * span + j}"]
-                      for s in range(pp)])
-                for j in range(span)}
-            x = run_blocks(stacked, x, mask)
+            x = run_blocks(pp_params["stages"], x, mask, step_i)
             x = nn.LayerNorm(dtype=jnp.float32).apply(
-                {"params": params["LayerNorm_0"]}, x)
+                {"params": outer["LayerNorm_0"]}, x)
             return nn.Dense(n_tags, dtype=jnp.float32).apply(
-                {"params": params["Dense_0"]}, x)
+                {"params": outer["Dense_0"]}, x)
 
         return logits_fn
 
@@ -381,8 +440,16 @@ class JaxTransformerTagger(BaseModel):
             variables = traverse_util.unflatten_dict(flat, sep="/")
         # Expert-stacked leaves shard over ep, everything else
         # replicates (shard_variables' rules; with ep == 1 this is the
-        # plain replicated placement).
-        params = shard_variables(variables, mesh)["params"]
+        # plain replicated placement). Under pp > 1 the blocks are
+        # first re-laid stage-stacked so their leaves (and the optimizer
+        # state derived from them) STORE sharded over pp — per-chip
+        # param bytes drop ~1/pp, the point of pipeline parallelism.
+        pp_mode = mesh.shape["pp"] > 1
+        if pp_mode:
+            params = shard_variables(
+                self._pp_split(variables["params"]), mesh)
+        else:
+            params = shard_variables(variables, mesh)["params"]
 
         cache_key = step_cache_key(self, "train", mesh, steps, max_epochs)
         cached = _step_cache_get(cache_key)
@@ -397,14 +464,14 @@ class JaxTransformerTagger(BaseModel):
                 end_value=lr * 0.02)
             tx = optax.adamw(sched, weight_decay=1e-3)
             drop_key = jax.random.key(int(self.knobs.get("seed", 0)) + 1)
-            pp_logits = (self._pp_logits_fn(n_tags)
-                         if mesh.shape["pp"] > 1 else None)
+            pp_logits = (self._pp_logits_fn(n_tags, train=True)
+                         if pp_mode else None)
 
             @jax.jit
             def train_step(params, opt_state, ids, lengths, tags, step_i):
                 def loss_fn(p):
                     if pp_logits is not None:
-                        logits, mods = pp_logits(p, ids), {}
+                        logits, mods = pp_logits(p, ids, step_i), {}
                     else:
                         logits, mods = module.apply(
                             {"params": p}, ids, attn, train=True,
@@ -458,6 +525,8 @@ class JaxTransformerTagger(BaseModel):
             logger.log(epoch=epoch, loss=ep_loss / steps,
                        token_acc=ep_acc / steps)
 
+        if pp_mode:
+            params = self._pp_merge(params)
         self._variables = {"params": jax.device_get(params)}
         self._invalidate_compiled()
 
@@ -489,18 +558,26 @@ class JaxTransformerTagger(BaseModel):
     def _predict_probs(self, sentences: List[List[str]]) -> np.ndarray:
         self._ensure_module(len(self._meta["tag_names"]))
         dp = self.mesh.shape[DP_AXIS]
+        pp_mode = self.mesh.shape["pp"] > 1
         if self._vars_dev is None:
             # Same placement rules as training: expert stacks shard
-            # over ep (replicating them would cost ep× HBM at
-            # inference), everything else replicates.
-            self._vars_dev = shard_variables(self._variables, self.mesh)
+            # over ep, stage stacks over pp (replicating either would
+            # cost ep×/pp× HBM at inference), everything else
+            # replicates.
+            if pp_mode:
+                self._vars_dev = {"params": shard_variables(
+                    self._pp_split(self._variables["params"]),
+                    self.mesh)}
+            else:
+                self._vars_dev = shard_variables(self._variables,
+                                                 self.mesh)
         if self._predict_fn is None:
-            if self.mesh.shape["pp"] > 1:
+            if pp_mode:
                 pp_logits = self._pp_logits_fn(
-                    len(self._meta["tag_names"]))
+                    len(self._meta["tag_names"]), train=False)
                 self._predict_fn = jax.jit(
                     lambda v, ids: jax.nn.softmax(
-                        pp_logits(v["params"], ids), -1))
+                        pp_logits(v["params"], ids, jnp.int32(0)), -1))
             else:
                 module, attn = self._module, self._attn_fn()
                 self._predict_fn = jax.jit(

@@ -27,10 +27,11 @@ import jax.numpy as jnp
 from ..parallel.mesh import PP_AXIS
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
                    stage_params: Any, x: jnp.ndarray, *,
                    axis_name: str = PP_AXIS,
-                   axis_size: int) -> jnp.ndarray:
+                   axis_size: int,
+                   stage_takes_tick: bool = False) -> jnp.ndarray:
     """Run ``x`` through ``axis_size`` pipeline stages inside shard_map.
 
     Args:
@@ -44,6 +45,13 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         its own slice with the leading stage axis already squeezed).
       x: microbatched input — an array or pytree whose leaves are
         (M, mb, ...), replicated across ``pp``.
+      stage_takes_tick: when True, ``stage_fn`` is called as
+        ``stage_fn(params_slice, mb, t)`` with the schedule tick index
+        ``t`` (int32 tracer) — the ingredient stochastic stages need to
+        fold a per-tick RNG key (dropout inside the pipeline: each
+        (tick, stage) pair must draw an independent mask, and the tick
+        index is exactly what distinguishes the microbatch a stage is
+        working on).
 
     Returns outputs matching ``x``'s tree structure, leaves (M, mb,
     ...) (replicated across ``pp``; the last stage's results are
@@ -64,7 +72,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         # the collection window below); later stages consume the hop.
         mb_in = tmap(lambda xs, st: jnp.where(
             stage == 0, xs[jnp.clip(t, 0, m - 1)], st), x, state)
-        out = stage_fn(stage_params, mb_in)
+        out = stage_fn(stage_params, mb_in, t) if stage_takes_tick \
+            else stage_fn(stage_params, mb_in)
         # The last stage's tick-t output is microbatch t - (s - 1);
         # collect it only inside the valid window.
         idx = t - (s - 1)

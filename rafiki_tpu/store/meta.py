@@ -12,6 +12,7 @@ readers unblocked during writes.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sqlite3
 import threading
@@ -19,7 +20,53 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+_log = logging.getLogger(__name__)
+
 Row = Dict[str, Any]
+
+# Filesystems whose (frequently broken or disabled) POSIX lock
+# semantics make sqlite a documented corruption hazard. sqlite-over-NFS
+# is the classic case: https://www.sqlite.org/howtocorrupt.html §2.
+_NETWORK_FS = {"nfs", "nfs4", "cifs", "smb", "smb2", "smbfs", "9p",
+               "fuse.sshfs", "glusterfs", "lustre", "ceph", "afs"}
+
+
+def _filesystem_type(path: str) -> str:
+    """fstype of the mount holding ``path`` (best effort; "" unknown)."""
+    try:
+        best, fstype = "", ""
+        with open("/proc/mounts", encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 3:
+                    mnt = parts[1]
+                    if path.startswith(mnt.rstrip("/") + "/") \
+                            or path == mnt:
+                        if len(mnt) >= len(best):
+                            best, fstype = mnt, parts[2]
+        return fstype
+    except OSError:
+        return ""
+
+
+def _warn_if_network_filesystem(path: str) -> None:
+    """Multi-host deployments must NOT share meta.db over NFS-like
+    mounts (SURVEY.md §2.10 durability; docs/ops.md "Supported
+    topologies"): sqlite's cross-process safety rests on POSIX locks
+    the network filesystem may fake. Warn loudly — refusing outright
+    would break single-writer setups that are actually safe, so the
+    operator decides (RAFIKI_TPU_ALLOW_NETWORK_DB=1 silences)."""
+    if os.environ.get("RAFIKI_TPU_ALLOW_NETWORK_DB") == "1":
+        return
+    fstype = _filesystem_type(path)
+    if fstype.lower() in _NETWORK_FS:
+        _log.warning(
+            "meta store %s sits on a %s mount: sqlite file locking is "
+            "unreliable on network filesystems and concurrent nodes "
+            "can corrupt the database. Keep meta.db on node-local "
+            "disk and let join nodes reach state through the primary "
+            "(docs/ops.md: supported topologies). Set "
+            "RAFIKI_TPU_ALLOW_NETWORK_DB=1 to silence.", path, fstype)
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS users (
@@ -147,6 +194,7 @@ class MetaStore:
         if uri != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(uri)) or ".",
                         exist_ok=True)
+            _warn_if_network_filesystem(os.path.abspath(uri))
         self._conn = sqlite3.connect(uri, check_same_thread=False,
                                      timeout=30.0)
         self._conn.row_factory = sqlite3.Row

@@ -119,6 +119,13 @@ class InferenceWorker:
             pipeline = _parse_bool(os.environ.get(
                 "RAFIKI_TPU_SERVING_PIPELINE", "1"))
         self.pipeline = pipeline
+        # The bus registration is a LEASE, not a one-shot: it is
+        # re-asserted at this cadence so a broker restart (whose fresh
+        # in-memory state forgot every registration) re-learns this
+        # worker without anyone noticing — the Predictor's next
+        # registry scan finds it again within one interval.
+        self.reregister_interval = float(os.environ.get(
+            "RAFIKI_TPU_WORKER_REREGISTER", "5.0"))
         self.stop_flag = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._model: Optional[Any] = None
@@ -191,19 +198,60 @@ class InferenceWorker:
             # the device BEFORE blocking on burst N's result readback
             # (predict_submit), hiding the device->host sync latency
             # behind the next burst's compute.
+            #
+            # Bus failures do NOT kill the worker: the broker holds all
+            # queue/registry state in memory, so a broker restart both
+            # drops this worker's blocked pop (a ConnectionError/
+            # RuntimeError here) AND forgets its registration. The loop
+            # absorbs the error, re-registers, and resumes — in-flight
+            # bursts on the dead broker are lost (their clients time
+            # out and retry), but the worker itself recovers without a
+            # supervise restart. The periodic re-registration covers
+            # the quieter case where the restart happens BETWEEN pops
+            # and no error ever surfaces on this side.
+            import time as _time
+
             pending = None
+            last_reg = _time.monotonic()
             while not self.stop_flag.is_set():
-                items = self.cache.pop_queries(
-                    self.service_id, max_items=self.max_batch,
-                    timeout=0.0 if pending is not None
-                    else self.batch_timeout)
-                handle = self._dispatch_batch(items) if items else None
-                if not self.pipeline and handle is not None:
-                    self._complete_batch(*handle)
-                    handle = None
-                if pending is not None:
-                    self._complete_batch(*pending)
-                pending = handle
+                try:
+                    if (_time.monotonic() - last_reg
+                            >= self.reregister_interval):
+                        self.cache.register_worker(
+                            self.inference_job_id, self.service_id,
+                            info={"trial_id": self.trial_id})
+                        last_reg = _time.monotonic()
+                    items = self.cache.pop_queries(
+                        self.service_id, max_items=self.max_batch,
+                        timeout=0.0 if pending is not None
+                        else self.batch_timeout)
+                    handle = (self._dispatch_batch(items) if items
+                              else None)
+                    if not self.pipeline and handle is not None:
+                        self._complete_batch(*handle)
+                        handle = None
+                    if pending is not None:
+                        self._complete_batch(*pending)
+                    pending = handle
+                except (ConnectionError, OSError, RuntimeError):
+                    _log.warning(
+                        "inference worker %s lost the bus; "
+                        "re-registering and resuming", self.service_id,
+                        exc_info=True)
+                    if pending is not None:  # drain device work; the
+                        try:                 # reply push may also fail
+                            self._complete_batch(*pending)
+                        except (ConnectionError, OSError, RuntimeError):
+                            pass             # burst lost; client retries
+                        pending = None
+                    self.stop_flag.wait(1.0)
+                    try:
+                        self.cache.register_worker(
+                            self.inference_job_id, self.service_id,
+                            info={"trial_id": self.trial_id})
+                        last_reg = _time.monotonic()
+                    except (ConnectionError, OSError, RuntimeError):
+                        pass  # broker still down; retry next iteration
             if pending is not None:
                 self._complete_batch(*pending)
             self.meta.update_service(self.service_id,
@@ -214,8 +262,11 @@ class InferenceWorker:
                                      status=ServiceStatus.ERRORED)
             raise
         finally:
-            self.cache.unregister_worker(self.inference_job_id,
-                                         self.service_id)
+            try:
+                self.cache.unregister_worker(self.inference_job_id,
+                                             self.service_id)
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # broker gone; nothing to unregister from
 
     def _dispatch_batch(self, items: list):
         """Flatten a burst into ONE chip-side predict dispatch; returns

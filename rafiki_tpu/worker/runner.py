@@ -180,8 +180,27 @@ class TrialRunner:
             # is keyed by (sub_train_job, knobs), not trial id, so the
             # re-proposed trial after a worker crash resumes the crashed
             # attempt's epochs instead of repaying them (SURVEY.md §5).
-            ckpt_dir = self._ckpt_dir(knobs)
+            #
+            # A proposal may instead pin its OWN checkpoint scope
+            # (``ckpt_scope``): successive-halving rungs of one
+            # configuration share a scope, so each rung resumes the
+            # previous rung's final state — optimizer moments, early-
+            # stop counters and the per-epoch data order all continue,
+            # making the rung sequence step-identical to one
+            # uninterrupted run (advisor/asha.py). Scoped checkpoints
+            # persist across trials (the NEXT rung needs them) and are
+            # always on, independent of RAFIKI_TPU_CKPT.
+            ckpt_scope = proposal.meta.get("ckpt_scope")
+            if ckpt_scope:
+                ckpt_dir = os.path.join(
+                    self.params.params_dir, "ckpt",
+                    f"{self.sub_train_job_id}-{ckpt_scope}")
+            else:
+                ckpt_dir = self._ckpt_dir(knobs)
             train_kwargs = {"checkpoint_dir": ckpt_dir} if ckpt_dir else {}
+            if ckpt_scope:
+                train_kwargs["checkpoint_final_epoch"] = True
+            train_kwargs.update(proposal.meta.get("train_kwargs") or {})
             try:
                 # Opt-in per-trial profiler trace (RAFIKI_TPU_TRACE_DIR);
                 # each trial's trace lands in its own TensorBoard-readable

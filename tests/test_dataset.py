@@ -59,3 +59,29 @@ def test_corpus_splits_share_tag_id_space(tmp_path):
         str(tmp_path), n_train=64, n_val=2, n_tags=12, max_len=4, seed=3)
     assert (load_corpus_dataset(tr).tag_names
             == load_corpus_dataset(va).tag_names)
+
+
+def test_bundled_english_pos_corpus(tmp_path):
+    """The committed hand-tagged English corpus stays well-formed: every
+    tag in the Universal tagset, both splits share one tag-id space,
+    and the size matches its README (329 sentences / 2,996 tokens)."""
+    from rafiki_tpu.datasets import prepare_bundled_pos_corpus
+
+    tr, va = prepare_bundled_pos_corpus(str(tmp_path))
+    dtr, dva = load_corpus_dataset(tr), load_corpus_dataset(va)
+    assert dtr.tag_names == dva.tag_names
+    universal = {"NOUN", "VERB", "ADJ", "ADV", "PRON", "DET", "ADP",
+                 "NUM", "CONJ", "PRT", "PUNCT", "X"}
+    assert set(dtr.tag_names) <= universal
+    n_sents = dtr.size + dva.size
+    n_tokens = sum(len(s) for s in dtr.sentences + dva.sentences)
+    assert n_sents == 329 and n_tokens == 2996, (n_sents, n_tokens)
+    # Real language, not synthetic ids: a few high-frequency English
+    # words must be present and consistently tagged.
+    from collections import Counter
+    tag_of = Counter()
+    for s, ts in zip(dtr.sentences, dtr.tags):
+        for w, t in zip(s, ts):
+            if w.lower() == "the":
+                tag_of[dtr.tag_names[t]] += 1
+    assert set(tag_of) == {"DET"}

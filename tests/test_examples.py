@@ -131,7 +131,7 @@ def test_sklearn_real_dataset_converters(tmp_path):
 def test_accuracy_parity_script():
     """The one-script accuracy-parity check (BASELINE.md table) stays
     reproducible: every model lands in its published band."""
-    r = _run("examples/scripts/accuracy_parity.py", timeout=900)
+    r = _run("examples/scripts/accuracy_parity.py", timeout=2400)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ACCURACY PARITY OK" in r.stdout
 
