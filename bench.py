@@ -572,6 +572,8 @@ def _run_config(name: str, platform: str) -> dict:
     try:
         rec = fn()
     except SystemExit as e:  # unmet precondition (devices, platform)
+        if e.code in (0, None):
+            raise  # a clean exit is not an unmet precondition
         rec = {"metric": metric, "value": 0.0, "unit": unit,
                "vs_baseline": None, "platform": platform,
                "error": str(e)}

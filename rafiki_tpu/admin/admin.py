@@ -27,11 +27,16 @@ class Admin:
     def __init__(self, meta: MetaStore, params: ParamStore,
                  services: ServicesManager, jwt_secret: str = "rafiki-tpu",
                  superadmin_email: str = "superadmin@rafiki",
-                 superadmin_password: str = "rafiki"):
+                 superadmin_password: str = "rafiki",
+                 datasets_dir: str = ""):
         self.meta = meta
         self.params = params
         self.services = services
         self.jwt_secret = jwt_secret
+        # Uploaded datasets land here (REST/browser upload path); empty
+        # disables uploads — jobs can always reference datasets by
+        # filesystem path directly.
+        self.datasets_dir = datasets_dir
         if self.meta.get_user_by_email(superadmin_email) is None:
             self.meta.create_user(
                 superadmin_email, auth.hash_password(superadmin_password),
@@ -127,6 +132,103 @@ class Admin:
     def get_models(self, user_id: str,
                    task: Optional[str] = None) -> List[Dict[str, Any]]:
         return [_public_model(m) for m in self.meta.get_models(user_id, task)]
+
+    # --- Datasets ---
+
+    def create_dataset(self, user_id: str, name: str, task: str,
+                       data: bytes, filename: str = "") -> Dict[str, Any]:
+        """Store an uploaded dataset file (the browser/REST upload path)
+        and return its row — ``path`` is what train-job forms submit as
+        ``train/val_dataset_path``. Format validation stays with the
+        model SDK loaders at train time (the dataset zip is
+        task-specific); the upload only persists bytes."""
+        import os
+        import re
+
+        if not self.datasets_dir:
+            raise ValueError("this node has no datasets dir configured")
+        if not data:
+            raise ValueError("empty dataset upload")
+        os.makedirs(self.datasets_dir, exist_ok=True)
+        # The stored filename is server-generated; only the extension
+        # survives from the client (sanitized), so an hostile filename
+        # cannot traverse out of the datasets dir.
+        ext = os.path.splitext(filename or "")[1]
+        if not re.fullmatch(r"\.[A-Za-z0-9]{1,8}", ext or ""):
+            ext = ".zip"
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", name)[:48] or "dataset"
+        import sqlite3
+        import uuid
+
+        # Bytes land on disk BEFORE the meta row commits: a failed write
+        # (ENOSPC, permissions) must not leave a pathless row squatting
+        # on the unique name with no delete API to recover it.
+        path = os.path.join(self.datasets_dir,
+                            f"{uuid.uuid4().hex[:12]}-{safe}{ext}")
+        with open(path, "wb") as f:
+            f.write(data)
+        try:
+            row = self.meta.create_dataset(user_id, name, task, path,
+                                           len(data))
+        except sqlite3.IntegrityError:
+            os.unlink(path)
+            # The dashboard defaults the name to the filename, so
+            # re-uploads are routine — answer with a clear 400, not an
+            # opaque constraint error.
+            raise ValueError(
+                f"you already have a dataset named {name!r}; pick "
+                f"another name")
+        return dict(row)
+
+    def get_datasets(self, user_id: str,
+                     task: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self.meta.get_datasets(user_id, task=task)
+
+    # --- Services (dashboard log view) ---
+
+    def _sees_all_services(self,
+                           claims: Optional[Dict[str, Any]]) -> bool:
+        return claims is None or claims.get("user_type") in (
+            UserType.SUPERADMIN, UserType.ADMIN)
+
+    def get_services(self, claims: Optional[Dict[str, Any]] = None,
+                     ) -> List[Dict[str, Any]]:
+        """Service rows, newest first (dashboard services table).
+        Admins see the whole cluster; other users see only services
+        working for THEIR jobs — another tenant's worker list (and the
+        job structure it implies) is not theirs to read."""
+        rows = self.meta.get_services()
+        if not self._sees_all_services(claims):
+            owned = self.meta.get_owned_service_ids(claims.get("user_id"))
+            rows = [r for r in rows if r["id"] in owned]
+        rows.sort(key=lambda r: r["created_at"], reverse=True)
+        return [{k: r.get(k) for k in
+                 ("id", "service_type", "status", "chips", "node_id",
+                  "created_at", "stopped_at")} for r in rows]
+
+    def get_service_logs(self, service_id: str, max_bytes: int = 65536,
+                         claims: Optional[Dict[str, Any]] = None,
+                         ) -> Dict[str, Any]:
+        """Tail of one service's captured log (utils/service_logs).
+        Same visibility rule as ``get_services``: logs carry trial
+        knobs/scores/dataset paths, so only the owning user or an admin
+        may read them."""
+        from ..utils.service_logs import service_log_path, tail_log
+
+        svc = self.meta.get_service(service_id)
+        if svc is None:
+            raise ValueError(f"unknown service {service_id}")
+        if not self._sees_all_services(claims):
+            owner = self.meta.get_service_owner(service_id)
+            self.check_access(claims, owner or "")
+        text = None
+        if self.services.log_dir:
+            text = tail_log(
+                service_log_path(self.services.log_dir, service_id),
+                max_bytes=max_bytes)
+        return {"service_id": service_id, "status": svc["status"],
+                "log": text,
+                "captured": text is not None}
 
     # --- Train jobs (§3.1) ---
 

@@ -17,6 +17,11 @@ route-compatible so reference quickstart scripts port 1:1:
 - ``POST /inference_jobs``           deploy best trials behind a predictor
 - ``GET  /inference_jobs/<id>``      incl. ``predictor_host``
 - ``POST /inference_jobs/<id>/stop``
+- ``POST /datasets``                 upload a dataset file (raw bytes body,
+                                     ``?name=&task=&filename=``)
+- ``GET  /datasets``                 list own uploaded datasets
+- ``GET  /services``                 cluster service rows
+- ``GET  /services/<id>/logs``       tail one service's captured log
 
 Auth: ``Authorization: Bearer <jwt>`` on everything but ``POST /tokens``.
 """
@@ -56,6 +61,10 @@ class AdminApp:
             ("GET", "/users", self._list_users),
             ("POST", "/users/<user_id>/ban", self._ban_user),
             ("GET", "/status", self._status),
+            ("POST", "/datasets", self._create_dataset),
+            ("GET", "/datasets", self._list_datasets),
+            ("GET", "/services", self._list_services),
+            ("GET", "/services/<service_id>/logs", self._service_logs),
         ], host=host, port=port, name="admin")
         self.host = self._http.host
         self.port = self._http.port
@@ -181,6 +190,40 @@ class AdminApp:
     def _status(self, params, body, ctx):
         self._auth(ctx)
         return 200, self.admin.get_status()
+
+    def _create_dataset(self, params, body, ctx):
+        claims = self._auth(ctx, *_WRITE_TYPES)
+        # The file travels as the raw request body (the browser posts
+        # the File object directly; the client SDK streams the file) —
+        # no multipart parser needed in a first-party server. Metadata
+        # rides the query string.
+        name = ctx.query_one("name")
+        task = ctx.query_one("task")
+        if not name or not task:
+            raise HttpError(400, "need ?name= and ?task= query params")
+        if ctx.raw_body is None:
+            raise HttpError(
+                400, "dataset bytes must be the request body with a "
+                     "non-JSON Content-Type (application/octet-stream)")
+        return 201, self.admin.create_dataset(
+            claims["user_id"], name, task, ctx.raw_body,
+            filename=ctx.query_one("filename", ""))
+
+    def _list_datasets(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_datasets(claims["user_id"],
+                                            task=ctx.query_one("task"))
+
+    def _list_services(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_services(claims=claims)
+
+    def _service_logs(self, params, body, ctx):
+        claims = self._auth(ctx)
+        max_bytes = int(ctx.query_one("max_bytes", "65536"))
+        return 200, self.admin.get_service_logs(params["service_id"],
+                                                max_bytes=max_bytes,
+                                                claims=claims)
 
     def _list_users(self, params, body, ctx):
         self._auth(ctx, UserType.SUPERADMIN, UserType.ADMIN)

@@ -58,7 +58,7 @@ class ServicesManager:
                  allocator: Optional[ChipAllocator] = None,
                  meta_uri: str = ":memory:", params_dir: str = "",
                  bus_uri: str = "", node_id: str = "",
-                 adopt_unowned: bool = True):
+                 adopt_unowned: bool = True, log_dir: str = ""):
         self.meta = meta
         self.container = container
         self.allocator = allocator or ChipAllocator()
@@ -67,6 +67,9 @@ class ServicesManager:
         self.meta_uri = meta_uri
         self.params_dir = params_dir
         self.bus_uri = bus_uri
+        # Per-service log files land here (dashboard log view); empty
+        # disables capture.
+        self.log_dir = log_dir
         # Node identity: services are stamped with their launching node
         # so, with several nodes sharing one meta store (multi-host
         # scale-out), each node supervises/restarts only what IT runs —
@@ -88,13 +91,7 @@ class ServicesManager:
         svc = self.meta.create_service(service_type,
                                        ServiceStatus.DEPLOYING, chips=chips,
                                        node_id=self.node_id)
-        env = {
-            EnvVars.META_URI: self.meta_uri,
-            EnvVars.PARAMS_DIR: self.params_dir,
-            EnvVars.BUS_URI: self.bus_uri,
-            EnvVars.SERVICE_ID: svc["id"],
-            EnvVars.SERVICE_TYPE: service_type,
-        }
+        env = self._base_env(svc["id"], service_type)
         if chips is not None:
             env[EnvVars.CHIPS] = ",".join(str(c) for c in chips)
         env.update(extra_env)
@@ -105,6 +102,19 @@ class ServicesManager:
             raise
         self.meta.update_service(svc["id"], container_id=container_id)
         return self.meta.get_service(svc["id"])
+
+    def _base_env(self, service_id: str, service_type: str,
+                  ) -> Dict[str, str]:
+        env = {
+            EnvVars.META_URI: self.meta_uri,
+            EnvVars.PARAMS_DIR: self.params_dir,
+            EnvVars.BUS_URI: self.bus_uri,
+            EnvVars.SERVICE_ID: service_id,
+            EnvVars.SERVICE_TYPE: service_type,
+        }
+        if self.log_dir:
+            env[EnvVars.LOG_DIR] = self.log_dir
+        return env
 
     def _stop_service(self, service_id: str) -> None:
         svc = self.meta.get_service(service_id)
@@ -175,15 +185,9 @@ class ServicesManager:
                                      status=ServiceStatus.STOPPED)
             return None
         chips = list(group.indices)
-        env = {
-            EnvVars.META_URI: self.meta_uri,
-            EnvVars.PARAMS_DIR: self.params_dir,
-            EnvVars.BUS_URI: self.bus_uri,
-            EnvVars.SERVICE_ID: svc_row["id"],
-            EnvVars.SERVICE_TYPE: ServiceType.TRAIN,
-            EnvVars.SUB_TRAIN_JOB_ID: sub_id,
-            EnvVars.CHIPS: ",".join(str(c) for c in chips),
-        }
+        env = self._base_env(svc_row["id"], ServiceType.TRAIN)
+        env[EnvVars.SUB_TRAIN_JOB_ID] = sub_id
+        env[EnvVars.CHIPS] = ",".join(str(c) for c in chips)
         try:
             container_id = self.container.create_service(svc_row["id"], env)
         except Exception:
@@ -351,16 +355,10 @@ class ServicesManager:
         releases this worker's chips, marks its row ERRORED, and
         re-raises (callers add any broader rollback)."""
         chips = list(group.indices)
-        env = {
-            EnvVars.META_URI: self.meta_uri,
-            EnvVars.PARAMS_DIR: self.params_dir,
-            EnvVars.BUS_URI: self.bus_uri,
-            EnvVars.SERVICE_ID: svc_row["id"],
-            EnvVars.SERVICE_TYPE: ServiceType.INFERENCE,
-            EnvVars.INFERENCE_JOB_ID: inference_job_id,
-            EnvVars.TRIAL_ID: trial_id,
-            EnvVars.CHIPS: ",".join(str(c) for c in chips),
-        }
+        env = self._base_env(svc_row["id"], ServiceType.INFERENCE)
+        env[EnvVars.INFERENCE_JOB_ID] = inference_job_id
+        env[EnvVars.TRIAL_ID] = trial_id
+        env[EnvVars.CHIPS] = ",".join(str(c) for c in chips)
         try:
             container_id = self.container.create_service(svc_row["id"],
                                                          env)

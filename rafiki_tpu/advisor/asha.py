@@ -11,15 +11,22 @@ so parallel TrainWorkers never block on a synchronous bracket barrier
 
 The budget rides the model's own ``max_epochs`` knob (IntegerKnob range
 or the sorted numeric values of a CategoricalKnob), so any zoo model is
-ASHA-compatible unmodified. Promotions **warm-start**: the promoted
-trial loads its configuration's rung-r weights from the ParamStore
-(``LOCAL_RECENT`` under a per-config ``params_scope``) and trains only
-the *delta* epochs between rungs — prior epochs are not repaid. When the
-warm-start params are unavailable (expired store, first run after a
-crash) the TrialRunner falls back to the full rung budget carried in
-``meta["cold_start_knobs"]``, so scores stay comparable within a rung
-either way. With no tunable budget knob the strategy degenerates to
-random search at a fixed budget.
+ASHA-compatible unmodified. Promotions **warm-start by checkpoint
+resume**: every trial of a configuration shares a ``ckpt_scope``
+(``asha-cfg-<id>``), so the TrialRunner keeps the configuration's final
+train state — params, optimizer moments, early-stop counters — on disk
+after each rung, and a promotion proposes the FULL cumulative rung
+budget: the model's own checkpoint-resume continues at the epoch the
+previous rung ended, so only the delta epochs actually execute, at their
+true epoch indices. All rungs additionally share one learning-rate
+schedule shape (``schedule_total_epochs`` pinned to the ladder's top),
+which makes the rung sequence step-for-step identical to one
+uninterrupted full-budget run — the proposed knobs ARE the reproducible
+record, with no delta/cumulative split. When the checkpoint is
+unavailable (expired store, first run after a crash) the resume falls
+back to a fresh start and the full proposed budget simply trains from
+scratch, so scores stay rung-comparable either way. With no tunable
+budget knob the strategy degenerates to random search at a fixed budget.
 """
 
 from __future__ import annotations
@@ -78,12 +85,6 @@ class AshaAdvisor(BaseAdvisor):
         self._next_config = 0
         # trial_no -> (config_id, rung); popped by _observe/_forget.
         self._pending: Dict[int, Tuple[int, int]] = {}
-        # trial_no -> knob overrides if the warm-start params are gone;
-        # attached to the proposal by _decorate (same propose() call).
-        self._pending_cold: Dict[int, Knobs] = {}
-        # trial_no -> knobs to RECORD (cumulative budget) in trial rows
-        # and best()-tracking, vs the delta actually executed.
-        self._pending_record: Dict[int, Knobs] = {}
 
     # --- Strategy hooks (called under the base lock) ---
 
@@ -92,20 +93,11 @@ class AshaAdvisor(BaseAdvisor):
         if promo is not None:
             cid, rung = promo
             knobs = dict(self._configs[cid])
-            full = self._ladder[rung]
-            delta = full - self._ladder[rung - 1]
-            if self._legal_budget(delta):
-                # Warm-start: train only the epochs this rung adds. The
-                # full budget rides along as the cold-start fallback.
-                knobs[self.budget_knob] = delta
-                self._pending_cold[trial_no] = {self.budget_knob: full}
-            else:
-                knobs[self.budget_knob] = full
-            # Reproducibility: the trial's RECORDED budget is the
-            # cumulative rung budget — retraining with the recorded
-            # knobs from scratch reproduces the scored model; the delta
-            # is an execution detail of the warm start.
-            self._pending_record[trial_no] = {self.budget_knob: full}
+            # The FULL cumulative rung budget — checkpoint resume (the
+            # shared ckpt_scope set in _decorate) makes only the delta
+            # epochs execute, at their true epoch indices. The proposed
+            # knobs are therefore also the reproducible record.
+            knobs[self.budget_knob] = self._ladder[rung]
             self._pending[trial_no] = (cid, rung)
             return knobs
         # New configuration at rung 0.
@@ -136,36 +128,34 @@ class AshaAdvisor(BaseAdvisor):
         return None
 
     def _params_type(self, trial_no: int) -> str:
-        # Promotions warm-start from their OWN configuration's latest
-        # saved parameters (rung r's weights); new rung-0 configs cold
-        # start. The per-config isolation comes from params_scope below.
-        entry = self._pending.get(trial_no)
-        if entry is not None and entry[1] > 0:
-            return ParamsType.LOCAL_RECENT
+        # The warm start is the checkpoint (ckpt_scope below), not
+        # ParamStore retrieval: the checkpoint carries the FULL train
+        # state (optimizer moments, early-stop counters), which dumped
+        # inference params cannot. Rung-0 trials and checkpoint-less
+        # promotions alike start fresh and train their full budget.
         return ParamsType.NONE
-
-    def _legal_budget(self, value: int) -> bool:
-        """Can the budget knob legally take ``value``? (The rung delta
-        may fall outside an IntegerKnob's range or between a
-        CategoricalKnob's values.)"""
-        from .base import budget_value_legal
-
-        return budget_value_legal(self.knob_config.get(self.budget_knob),
-                                  value)
 
     def _decorate(self, proposal: Proposal) -> None:
         entry = self._pending.get(proposal.trial_no)
-        if entry is not None:
-            # The TrialRunner saves AND retrieves this trial's params
-            # under the config-scoped key, so LOCAL_RECENT means "this
-            # configuration's most recent weights", not "this worker's".
-            proposal.meta["params_scope"] = f"asha-cfg-{entry[0]}"
-            cold = self._pending_cold.pop(proposal.trial_no, None)
-            if cold:
-                proposal.meta["cold_start_knobs"] = cold
-            rec = self._pending_record.pop(proposal.trial_no, None)
-            if rec:
-                proposal.meta["record_knobs"] = rec
+        if entry is None or len(self._ladder) < 2:
+            # No ladder (degenerate random search) or a single rung:
+            # nothing will ever be promoted/resumed, so don't tax every
+            # trial with per-epoch checkpointing it cannot use.
+            return
+        cid = entry[0]
+        # Every trial of one configuration shares a checkpoint scope:
+        # rung r leaves its final state on disk
+        # (checkpoint_final_epoch, set by the TrialRunner for scoped
+        # proposals) and rung r+1 resumes it. Scoped params keep each
+        # configuration's dumped-weights lineage separate as well.
+        proposal.meta["ckpt_scope"] = f"asha-cfg-{cid}"
+        proposal.meta["params_scope"] = f"asha-cfg-{cid}"
+        # One schedule shape for the whole ladder: every rung sizes
+        # its lr schedule to the TOP budget, so a resumed rung
+        # continues the exact schedule an uninterrupted full-budget
+        # run would be on.
+        proposal.meta["train_kwargs"] = {
+            "schedule_total_epochs": self._ladder[-1]}
 
     def _observe(self, proposal: Proposal, score: float) -> None:
         entry = self._pending.pop(proposal.trial_no, None)

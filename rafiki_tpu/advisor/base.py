@@ -47,19 +47,6 @@ class Proposal:
                         meta=d.get("meta", {}))
 
 
-def budget_value_legal(knob, value: int) -> bool:
-    """Can a budget knob legally take ``value``? Shared by the
-    budget-laddering strategies (ASHA rung deltas, PBT cumulative
-    rounds)."""
-    from ..model.knobs import CategoricalKnob, IntegerKnob
-
-    if isinstance(knob, IntegerKnob):
-        return knob.value_min <= value <= knob.value_max
-    if isinstance(knob, CategoricalKnob):
-        return value in knob.values
-    return False
-
-
 class BaseAdvisor:
     """Base search strategy. Thread-safe: one advisor serves many workers."""
 
@@ -97,7 +84,7 @@ class BaseAdvisor:
     def feedback(self, proposal: Proposal, score: float) -> None:
         with self._lock:
             # ``record_knobs``: a strategy may execute reduced knobs
-            # (ASHA trains the rung DELTA on a warm start) while the
+            # (PBT trains one round on inherited weights) while the
             # reproducible configuration — what best() must hand back —
             # carries the cumulative values.
             knobs = {**proposal.knobs,

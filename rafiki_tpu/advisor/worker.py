@@ -55,6 +55,9 @@ class AdvisorWorker:
             self._thread.join(timeout=5)
 
     def run(self) -> None:
+        from ..utils.service_logs import bind_service_log
+
+        bind_service_log(getattr(self, "log_path", None))
         while not self._stop.is_set():
             req = self.bus.pop(_req_queue(self.sub_id), timeout=0.25)
             if req is None:

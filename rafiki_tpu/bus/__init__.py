@@ -21,9 +21,9 @@ bus is first-party: one wire-compatible interface with two backends —
 from .base import BaseBus
 from .memory import MemoryBus
 from .native import NativeBusServer, serve_broker
-from .tcp import BusClient, BusServer
+from .tcp import BusClient, BusOpError, BusServer
 
-__all__ = ["BaseBus", "MemoryBus", "BusClient", "BusServer",
+__all__ = ["BaseBus", "MemoryBus", "BusClient", "BusOpError", "BusServer",
            "NativeBusServer", "serve_broker", "connect"]
 
 

@@ -162,6 +162,16 @@ class BusServer:
         self._server.serve_forever()
 
 
+class BusOpError(RuntimeError):
+    """The broker itself REPORTED an op failure (malformed request,
+    unknown op — protocol/version skew), as opposed to a transport
+    failure (ConnectionError/OSError: broker dead or restarting).
+    Subclasses RuntimeError so pre-existing broad catches still see it;
+    callers that must react differently — a transport failure heals
+    when the broker returns, a reported error usually will not — can
+    now tell them apart (worker/inference.py serve loop)."""
+
+
 class BusClient(BaseBus):
     def __init__(self, host: str, port: int, timeout: float = 300.0):
         self.host, self.port = host, port
@@ -198,7 +208,7 @@ class BusClient(BaseBus):
             self._drop()
             raise
         if not resp.get("ok"):
-            raise RuntimeError(f"bus error: {resp.get('error')}")
+            raise BusOpError(f"bus error: {resp.get('error')}")
         return resp.get("value")
 
     def _drop(self) -> None:

@@ -86,6 +86,47 @@ class Client:
         path = "/models" + (f"?task={task}" if task else "")
         return self._call("GET", path)
 
+    # --- Datasets ---
+
+    def create_dataset(self, name: str, task: str,
+                       file_path: str) -> Dict[str, Any]:
+        """Upload a dataset file; the returned row's ``path`` is what
+        ``create_train_job`` takes as a dataset path."""
+        import os
+        from urllib.parse import quote
+
+        headers = {"Content-Type": "application/octet-stream"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        with open(file_path, "rb") as f:
+            resp = self._session.post(
+                self._base + f"/datasets?name={quote(name)}"
+                f"&task={quote(task)}"
+                f"&filename={quote(os.path.basename(file_path))}",
+                data=f, headers=headers, timeout=self._timeout)
+        data = resp.json()
+        if resp.status_code >= 400:
+            raise ClientError(resp.status_code,
+                              data.get("error", "unknown error"))
+        return data
+
+    def get_datasets(self, task: Optional[str] = None,
+                     ) -> List[Dict[str, Any]]:
+        path = "/datasets" + (f"?task={task}" if task else "")
+        return self._call("GET", path)
+
+    # --- Services ---
+
+    def get_services(self) -> List[Dict[str, Any]]:
+        """Cluster service rows (type, status, chips, node)."""
+        return self._call("GET", "/services")
+
+    def get_service_logs(self, service_id: str,
+                         max_bytes: int = 65536) -> Dict[str, Any]:
+        """Tail of one service's captured log file."""
+        return self._call(
+            "GET", f"/services/{service_id}/logs?max_bytes={max_bytes}")
+
     # --- Train jobs ---
 
     def create_train_job(self, app: str, task: str, model_ids: List[str],

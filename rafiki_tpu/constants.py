@@ -109,3 +109,4 @@ class EnvVars:
     META_URI = "RAFIKI_TPU_META_URI"
     BUS_URI = "RAFIKI_TPU_BUS_URI"
     PARAMS_DIR = "RAFIKI_TPU_PARAMS_DIR"
+    LOG_DIR = "RAFIKI_TPU_LOG_DIR"

@@ -43,7 +43,19 @@ def build_service(env: Dict[str, str], ctx: Optional[SystemContext] = None,
     service_id = env[EnvVars.SERVICE_ID]
     chips = (ChipGroup.from_env(env[EnvVars.CHIPS])
              if env.get(EnvVars.CHIPS) else None)
+    service = _build(service_type, service_id, env, ctx, chips)
+    # Thread-mode log capture: the worker binds its own thread to this
+    # file at run() start (utils/service_logs; dashboard log view).
+    if env.get(EnvVars.LOG_DIR):
+        from ..utils.service_logs import service_log_path
 
+        service.log_path = service_log_path(env[EnvVars.LOG_DIR],
+                                            service_id)
+    return service
+
+
+def _build(service_type: str, service_id: str, env: Dict[str, str],
+           ctx: SystemContext, chips: Optional[ChipGroup]) -> Any:
     if service_type == ServiceType.TRAIN:
         from ..worker.train import TrainWorker
 
@@ -101,7 +113,21 @@ def main() -> None:
     # before any backend touch — the site hook's latch would otherwise
     # send this child to the accelerator even when it is unreachable.
     ensure_platform()
-    service = build_service(dict(os.environ))
+    # Subprocess/docker mode: the whole process IS the service, so its
+    # log file captures every thread via a root FileHandler (the
+    # thread-bound handler is for resident-runner mode).
+    env = dict(os.environ)
+    if env.get(EnvVars.LOG_DIR):
+        from ..utils.service_logs import attach_process_log, \
+            service_log_path
+
+        attach_process_log(service_log_path(
+            env[EnvVars.LOG_DIR], env[EnvVars.SERVICE_ID]))
+        # The root FileHandler above now owns the file; dropping the
+        # env var stops build_service from ALSO binding the thread-
+        # routing handler to it (every record would land twice).
+        env.pop(EnvVars.LOG_DIR)
+    service = build_service(env)
     stop = getattr(service, "stop", None)
     if stop is not None:
         signal.signal(signal.SIGTERM, lambda *_: stop())

@@ -502,8 +502,15 @@ class JaxModel(BaseModel):
             if mgr.latest_step() is not None:
                 state, start_epoch, best_loss, bad_epochs = \
                     self._restore_ckpt(mgr, state)
+                if early_stop and bad_epochs >= early_stop:
+                    # The restored run had already early-stopped: an
+                    # uninterrupted run would train nothing past this
+                    # point, so neither does the resume (ASHA rungs stay
+                    # step-identical even when rung r stopped early).
+                    start_epoch = max_epochs
 
         t0 = time.time()
+        last_epoch = None
         step = start_epoch * steps_per_epoch
         for epoch in range(start_epoch, max_epochs):
             ep_rng = np.random.default_rng(
@@ -560,6 +567,7 @@ class JaxModel(BaseModel):
             logger.log(epoch=epoch, loss=ep_loss, train_acc=ep_acc,
                        steps_per_sec=(step - start_epoch * steps_per_epoch)
                        / (time.time() - t0), **util)
+            last_epoch = epoch
             if early_stop:
                 if ep_loss < best_loss - 1e-4:
                     best_loss, bad_epochs = ep_loss, 0
@@ -567,14 +575,18 @@ class JaxModel(BaseModel):
                     bad_epochs += 1
                     if bad_epochs >= early_stop:
                         break
-            # The final epoch is snapshotted only on request
-            # (checkpoint_final_epoch): a plain trial is complete at
-            # that point, but a successive-halving rung needs its LAST
-            # state on disk — it is exactly where the next rung resumes.
             if mgr is not None and (epoch + 1) % ckpt_every == 0 \
-                    and (epoch + 1 < max_epochs
-                         or kwargs.get("checkpoint_final_epoch")):
+                    and epoch + 1 < max_epochs:
                 self._save_ckpt(mgr, epoch, state, best_loss, bad_epochs)
+        # The LAST state is snapshotted after the loop, only on request
+        # (checkpoint_final_epoch): a plain trial is complete here, but a
+        # successive-halving rung resumes exactly this state. Post-loop
+        # placement covers both the early-stop break and a max_epochs
+        # that is not a multiple of the cadence — the in-loop cadence
+        # save alone would leave a stale final checkpoint either way.
+        if mgr is not None and kwargs.get("checkpoint_final_epoch") \
+                and last_epoch is not None:
+            self._save_ckpt(mgr, last_epoch, state, best_loss, bad_epochs)
 
         variables = {"params": jax.device_get(state.params)}
         if has_bs:
@@ -588,7 +600,17 @@ class JaxModel(BaseModel):
                   for i, leaf in enumerate(jax.tree.leaves(state))}
         arrays["es_best_loss"] = np.asarray(best_loss, np.float64)
         arrays["es_bad_epochs"] = np.asarray(bad_epochs, np.int64)
-        mgr.save(epoch, arrays)
+        try:
+            mgr.save(epoch, arrays)
+        except OSError:
+            # Checkpoints are an optimization, never the result: a
+            # failed snapshot (disk full, or a sibling worker's
+            # end-of-job sweep deleting a scoped dir mid-save) must not
+            # error the trial that trained fine. Losing the snapshot
+            # just means the next resume cold-starts — the documented
+            # fallback.
+            _log.warning("checkpoint save to %s failed; continuing "
+                         "without it", mgr.ckpt_dir, exc_info=True)
 
     def _restore_ckpt(self, mgr, state):
         """Returns (state, start_epoch, best_loss, bad_epochs); falls back

@@ -104,8 +104,10 @@ class LocalPlatform:
         self.services = ServicesManager(
             self.meta, self.container, self.allocator,
             meta_uri=meta_uri, params_dir=params_dir, bus_uri=bus_uri,
-            node_id=node_id, adopt_unowned=adopt_unowned)
-        self.admin = Admin(self.meta, self.params, self.services)
+            node_id=node_id, adopt_unowned=adopt_unowned,
+            log_dir=os.path.join(workdir, "logs"))
+        self.admin = Admin(self.meta, self.params, self.services,
+                           datasets_dir=os.path.join(workdir, "datasets"))
         self.app: Optional[AdminApp] = None
         if http:
             self.app = AdminApp(self.admin, port=admin_port).start()

@@ -40,7 +40,11 @@ class CheckpointManager:
 
     def steps(self) -> list:
         out = []
-        for name in os.listdir(self.ckpt_dir):
+        try:
+            names = os.listdir(self.ckpt_dir)
+        except FileNotFoundError:
+            return []  # dir swept concurrently == no checkpoints
+        for name in names:
             m = _CKPT_RE.match(name)
             if m:
                 out.append(int(m.group(1)))
@@ -52,6 +56,11 @@ class CheckpointManager:
 
     def save(self, step: int, arrays: Dict[str, np.ndarray]) -> str:
         path = self._path(step)
+        # The dir may have been swept out from under an in-flight trial
+        # (a sibling worker's end-of-job cleanup of scoped rung
+        # checkpoints); losing the history is the documented benign
+        # outcome there, but the SAVE itself must not error the trial.
+        os.makedirs(self.ckpt_dir, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=self.ckpt_dir, suffix=".tmp")
         os.close(fd)
         try:

@@ -157,6 +157,16 @@ CREATE TABLE IF NOT EXISTS services (
     created_at REAL NOT NULL,
     stopped_at REAL
 );
+CREATE TABLE IF NOT EXISTS datasets (
+    id TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL,
+    name TEXT NOT NULL,
+    task TEXT NOT NULL,
+    path TEXT NOT NULL,
+    size_bytes INTEGER NOT NULL,
+    created_at REAL NOT NULL,
+    UNIQUE (user_id, name)
+);
 CREATE TABLE IF NOT EXISTS train_job_workers (
     service_id TEXT PRIMARY KEY,
     sub_train_job_id TEXT NOT NULL
@@ -304,6 +314,28 @@ class MetaStore:
                    task: Optional[str] = None) -> List[Row]:
         sql = ("SELECT * FROM models WHERE (user_id = ? "
                "OR access_right = 'PUBLIC')")
+        args: list = [user_id]
+        if task is not None:
+            sql += " AND task = ?"
+            args.append(task)
+        return self._select(sql + " ORDER BY created_at", tuple(args))
+
+    # --- Datasets ---
+
+    def create_dataset(self, user_id: str, name: str, task: str,
+                       path: str, size_bytes: int) -> Row:
+        return self._insert("datasets", {
+            "id": _new_id(), "user_id": user_id, "name": name,
+            "task": task, "path": path, "size_bytes": int(size_bytes),
+            "created_at": _now()})
+
+    def get_dataset(self, dataset_id: str) -> Optional[Row]:
+        return self._one("SELECT * FROM datasets WHERE id = ?",
+                         (dataset_id,))
+
+    def get_datasets(self, user_id: str,
+                     task: Optional[str] = None) -> List[Row]:
+        sql = "SELECT * FROM datasets WHERE user_id = ?"
         args: list = [user_id]
         if task is not None:
             sql += " AND task = ?"
@@ -540,6 +572,35 @@ class MetaStore:
                              sub_train_job_id: str) -> None:
         self._insert("train_job_workers", {
             "service_id": service_id, "sub_train_job_id": sub_train_job_id})
+
+    def get_service_owner(self, service_id: str) -> Optional[str]:
+        """user_id owning the job a service works for, or None for
+        unmapped services (ownership gate on the log-view routes)."""
+        row = self._one(
+            "SELECT tj.user_id AS user_id FROM train_job_workers w "
+            "JOIN sub_train_jobs s ON s.id = w.sub_train_job_id "
+            "JOIN train_jobs tj ON tj.id = s.train_job_id "
+            "WHERE w.service_id = ?", (service_id,))
+        if row is None:
+            row = self._one(
+                "SELECT ij.user_id AS user_id FROM inference_job_workers w "
+                "JOIN inference_jobs ij ON ij.id = w.inference_job_id "
+                "WHERE w.service_id = ?", (service_id,))
+        return row["user_id"] if row else None
+
+    def get_owned_service_ids(self, user_id: str) -> set:
+        """All service ids working for jobs owned by ``user_id`` — ONE
+        query, because the dashboard polls the services view."""
+        rows = self._select(
+            "SELECT w.service_id AS sid FROM train_job_workers w "
+            "JOIN sub_train_jobs s ON s.id = w.sub_train_job_id "
+            "JOIN train_jobs tj ON tj.id = s.train_job_id "
+            "WHERE tj.user_id = ? "
+            "UNION "
+            "SELECT w.service_id FROM inference_job_workers w "
+            "JOIN inference_jobs ij ON ij.id = w.inference_job_id "
+            "WHERE ij.user_id = ?", (user_id, user_id))
+        return {r["sid"] for r in rows}
 
     def get_train_job_workers(self, sub_train_job_id: str) -> List[Row]:
         return self._select(

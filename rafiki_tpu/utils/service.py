@@ -25,9 +25,13 @@ Handler = Callable[[Dict[str, str], Optional[Dict[str, Any]],
 
 
 class RequestContext:
-    def __init__(self, headers, query: Dict[str, List[str]]):
+    def __init__(self, headers, query: Dict[str, List[str]],
+                 raw_body: Optional[bytes] = None):
         self.headers = headers
         self.query = query
+        # Non-JSON request payload (e.g. a dataset upload posted as
+        # application/octet-stream); None for JSON/empty requests.
+        self.raw_body = raw_body
 
     @property
     def bearer_token(self) -> Optional[str]:
@@ -83,15 +87,25 @@ class JsonHttpServer:
             def _dispatch(self, method: str):
                 parsed = urlparse(self.path)
                 body = None
+                raw_body = None
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
                     raw = self.rfile.read(length)
-                    try:
-                        body = json.loads(raw)
-                    except json.JSONDecodeError:
-                        self._reply(400, {"error": "invalid JSON body"})
-                        return
-                ctx = RequestContext(self.headers, parse_qs(parsed.query))
+                    ctype = (self.headers.get("Content-Type") or "").lower()
+                    if "json" in ctype or not ctype:
+                        # JSON (or legacy clients that send none): the
+                        # body must parse.
+                        try:
+                            body = json.loads(raw)
+                        except json.JSONDecodeError:
+                            self._reply(400, {"error": "invalid JSON body"})
+                            return
+                    else:
+                        # A declared non-JSON payload (file upload)
+                        # passes through verbatim for the handler.
+                        raw_body = raw
+                ctx = RequestContext(self.headers, parse_qs(parsed.query),
+                                     raw_body=raw_body)
                 for m, pattern, handler in outer._routes:
                     if m != method:
                         continue

@@ -1,0 +1,112 @@
+"""Per-service log capture for the dashboard's log view.
+
+Parity: SURVEY.md §2 "Web UI" — upstream surfaces each docker service's
+log stream in the admin UI (``docker service logs`` behind a REST
+route). Here services are usually THREADS of the resident runner
+(container/manager.py), so there is no per-process stdout to tail;
+instead each worker thread binds itself to a per-service log file and a
+single process-wide ``logging.Handler`` routes every record emitted by
+that thread — the worker loop, the model SDK, the stores — into the
+bound file. Subprocess/docker runtimes get the same file by attaching a
+plain FileHandler in their entrypoint (container/services.py ``main``),
+so ``<log_dir>/<service_id>.log`` is the one contract the Admin's
+``GET /services/<id>/logs`` route needs, whatever the runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+_local = threading.local()
+_install_lock = threading.Lock()
+_installed = False
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+class _ServiceLogHandler(logging.Handler):
+    """Routes records to the EMITTING thread's bound service file."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        f = getattr(_local, "file", None)
+        if f is None:
+            return
+        try:
+            f.write(self.format(record) + "\n")
+            f.flush()
+        except Exception:
+            self.handleError(record)
+
+
+def _install() -> None:
+    """Attach the routing handler once per process, on the package
+    logger so every ``rafiki_tpu.*`` record passes through. The package
+    level is raised to INFO only if unset — the handler would otherwise
+    capture nothing under the stdlib's WARNING default — and the
+    process's own handlers are unaffected (records still propagate)."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        pkg = logging.getLogger("rafiki_tpu")
+        if pkg.level == logging.NOTSET:
+            pkg.setLevel(logging.INFO)
+        handler = _ServiceLogHandler()
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        pkg.addHandler(handler)
+        _installed = True
+
+
+def service_log_path(log_dir: str, service_id: str) -> str:
+    return os.path.join(log_dir, f"{service_id}.log")
+
+
+def bind_service_log(log_path: Optional[str]) -> None:
+    """Bind the CALLING thread's log records to ``log_path`` (appending;
+    a restarted service continues its history). ``None`` is a no-op so
+    workers can call this unconditionally — only services launched with
+    a log dir (ServicesManager) capture."""
+    if not log_path:
+        return
+    _install()
+    prior = getattr(_local, "file", None)
+    if prior is not None:
+        try:
+            prior.close()
+        except OSError:
+            pass
+    os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+    _local.file = open(log_path, "a", encoding="utf-8")
+
+
+def attach_process_log(log_path: Optional[str]) -> None:
+    """Subprocess/docker entrypoint variant: the WHOLE process is one
+    service, so a plain FileHandler on the root logger captures every
+    thread (container/services.py ``main``)."""
+    if not log_path:
+        return
+    os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+    handler = logging.FileHandler(log_path)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger()
+    if root.level == logging.NOTSET or root.level > logging.INFO:
+        root.setLevel(logging.INFO)
+    root.addHandler(handler)
+
+
+def tail_log(log_path: str, max_bytes: int = 65536) -> Optional[str]:
+    """Last ``max_bytes`` of a service's log, or None if it never wrote
+    one (service predates log capture, or runs on a node whose files
+    this node cannot see)."""
+    try:
+        size = os.path.getsize(log_path)
+        with open(log_path, "r", encoding="utf-8", errors="replace") as f:
+            if size > max_bytes:
+                f.seek(size - max_bytes)
+                f.readline()  # drop the partial first line
+            return f.read()
+    except OSError:
+        return None

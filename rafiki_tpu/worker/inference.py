@@ -14,7 +14,7 @@ import os
 import threading
 from typing import Any, Optional
 
-from ..bus import BaseBus
+from ..bus import BaseBus, BusOpError
 from ..cache import Cache
 from ..constants import ServiceStatus
 from ..parallel.chips import ChipGroup
@@ -126,6 +126,13 @@ class InferenceWorker:
         # registry scan finds it again within one interval.
         self.reregister_interval = float(os.environ.get(
             "RAFIKI_TPU_WORKER_REREGISTER", "5.0"))
+        # Broker-REPORTED op failures (BusOpError) this many times in a
+        # row — with zero successful iterations in between — mean
+        # protocol skew, not an outage: the serve loop escalates to
+        # ERRORED so supervision notices (at 1 s per recovery lap, the
+        # default is ~30 s of a persistently rejecting broker).
+        self.max_op_errors = int(os.environ.get(
+            "RAFIKI_TPU_WORKER_MAX_OP_ERRORS", "30"))
         self.stop_flag = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._model: Optional[Any] = None
@@ -170,6 +177,9 @@ class InferenceWorker:
         return _PackedEnsemble(models)
 
     def run(self) -> None:
+        from ..utils.service_logs import bind_service_log
+
+        bind_service_log(getattr(self, "log_path", None))
         if self.chips is not None:
             self.chips.bind_to_thread()
         try:
@@ -213,6 +223,15 @@ class InferenceWorker:
 
             pending = None
             last_reg = _time.monotonic()
+            # Transport failures (broker dead/restarting) heal when the
+            # broker returns, so they retry forever. A broker-REPORTED
+            # op failure (BusOpError: protocol/version skew) normally
+            # clears within one recovery lap — a restarted broker that
+            # forgot this worker's registration reports errors until the
+            # re-register lands — but a PERSISTENT one never will, so a
+            # run of them without a single successful loop iteration
+            # escalates to ERRORED instead of warning forever.
+            consecutive_op_errors = 0
             while not self.stop_flag.is_set():
                 try:
                     if (_time.monotonic() - last_reg
@@ -233,7 +252,14 @@ class InferenceWorker:
                     if pending is not None:
                         self._complete_batch(*pending)
                     pending = handle
-                except (ConnectionError, OSError, RuntimeError):
+                    consecutive_op_errors = 0
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    if isinstance(e, BusOpError):
+                        consecutive_op_errors += 1
+                        if consecutive_op_errors > self.max_op_errors:
+                            raise
+                    else:
+                        consecutive_op_errors = 0
                     _log.warning(
                         "inference worker %s lost the bus; "
                         "re-registering and resuming", self.service_id,

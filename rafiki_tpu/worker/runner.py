@@ -120,7 +120,7 @@ class TrialRunner:
             return None
         # Warm-start params are resolved BEFORE knob validation: a
         # proposal may carry reduced knobs that are only valid with the
-        # warm start (ASHA promotions train delta epochs) plus
+        # warm start (PBT rounds train delta epochs) plus
         # ``cold_start_knobs`` overrides to apply when the shared params
         # are legitimately absent (expired store, fresh node). A
         # retrieval ERROR is different from absence: silently cold-
@@ -221,7 +221,11 @@ class TrialRunner:
             finally:
                 model.destroy()
             self.meta.mark_trial_completed(trial_id, score, params_id)
-            if ckpt_dir:
+            # Scoped checkpoints outlive the trial — the configuration's
+            # next rung resumes them; cleanup_scoped_checkpoints() runs
+            # when the sub-job is done. Unscoped crash-resume dirs are
+            # spent once the trial completes.
+            if ckpt_dir and not ckpt_scope:
                 shutil.rmtree(ckpt_dir, ignore_errors=True)
             self.advisor.feedback(proposal, score)
             _log.info("trial %s #%d done: score=%.4f (%.1fs)", trial_id[:8],
@@ -240,6 +244,28 @@ class TrialRunner:
             logger.set_sink(prior_sink)
         return self.meta.get_trial(trial_id)
 
+
+    def cleanup_scoped_checkpoints(self) -> None:
+        """Remove every scoped checkpoint dir of this sub-train-job.
+
+        Scoped dirs (``<params_dir>/ckpt/<sub_id>-<scope>``) persist
+        across trials by design — successive-halving rungs of one
+        configuration resume each other — so nothing inside the trial
+        loop may delete them. Without a terminal sweep they would grow
+        one dir per halving configuration forever; the TrainWorker calls
+        this once its sub-job's budget is exhausted. Racing a still-
+        running sibling worker is benign: a trial that loses its scope
+        dir mid-flight cold-starts its full proposed budget, which is
+        the documented fallback and stays rung-comparable.
+        """
+        root = os.path.join(self.params.params_dir, "ckpt")
+        if not os.path.isdir(root):
+            return
+        prefix = f"{self.sub_train_job_id}-"
+        for name in os.listdir(root):
+            if name.startswith(prefix):
+                shutil.rmtree(os.path.join(root, name),
+                              ignore_errors=True)
 
     def _ckpt_dir(self, knobs: Dict[str, Any]) -> Optional[str]:
         if os.environ.get("RAFIKI_TPU_CKPT") != "1":

@@ -80,6 +80,11 @@ class TrainWorker:
     # --- The loop ---
 
     def run(self) -> None:
+        # Route this thread's records to the service log file, if the
+        # launcher assigned one (dashboard per-service log view).
+        from ..utils.service_logs import bind_service_log
+
+        bind_service_log(getattr(self, "log_path", None))
         sub = self.meta.get_sub_train_job(self.sub_id)
         if sub is None:
             raise ValueError(f"unknown sub_train_job {self.sub_id}")
@@ -101,6 +106,13 @@ class TrainWorker:
             budget=job["budget"], stop_flag=self.stop_flag)
         try:
             runner.run()
+            # The job is truly over (budget spent, not a mid-job stop
+            # or crash): sweep the scoped rung checkpoints this job's
+            # halving configurations accumulated (runner docstring).
+            if not self.stop_flag.is_set() and runner.budget.exhausted(
+                    len(self.meta.get_trials(
+                        self.sub_id, status=TrialStatus.COMPLETED))):
+                runner.cleanup_scoped_checkpoints()
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.STOPPED)
         except Exception:

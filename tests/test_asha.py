@@ -42,15 +42,15 @@ def test_promotion_reuses_config_at_higher_budget():
     for p, s in zip(proposals, scores):
         adv.feedback(p, s)
     # 6 completed at rung 0 -> floor(6/3)=2 promotable; the next two
-    # proposals must be the two best configs, warm-starting with the
-    # rung-1 DELTA budget (3-1=2) and a full-budget cold-start fallback.
+    # proposals must be the two best configs at the FULL rung-1 budget
+    # (checkpoint resume executes only the delta — the proposal itself
+    # is the reproducible record).
     p7 = adv.propose()
     p8 = adv.propose()
     promoted = [p7, p8]
     budgets = {p.knobs["max_epochs"] for p in promoted}
-    assert budgets == {2}
-    assert all(p.meta["cold_start_knobs"] == {"max_epochs": 3}
-               for p in promoted)
+    assert budgets == {3}
+    assert all("cold_start_knobs" not in p.meta for p in promoted)
     promoted_widths = {p.knobs["width"] for p in promoted}
     best_widths = {proposals[1].knobs["width"], proposals[3].knobs["width"]}
     assert promoted_widths == best_widths
@@ -58,6 +58,12 @@ def test_promotion_reuses_config_at_higher_budget():
     assert {p.knobs["learning_rate"] for p in promoted} == \
         {proposals[1].knobs["learning_rate"],
          proposals[3].knobs["learning_rate"]}
+    # A promotion shares its configuration's checkpoint scope with the
+    # rung-0 trial that produced it, and pins the ladder-top schedule.
+    rung0_scopes = {p.meta["ckpt_scope"] for p in proposals}
+    assert all(p.meta["ckpt_scope"] in rung0_scopes for p in promoted)
+    assert all(p.meta["train_kwargs"] ==
+               {"schedule_total_epochs": 27} for p in promoted + proposals)
 
 
 def test_promotions_climb_to_top_rung():
@@ -72,9 +78,9 @@ def test_promotions_climb_to_top_rung():
         # Score correlated with width: halving should drive the widest
         # configs upward through every rung.
         adv.feedback(p, p.knobs["width"] / 64 + rng.normal(0, 0.01))
-    # Proposals carry rung DELTAS (warm-start): ladder 1/3/9/27 ->
-    # deltas 1, 2, 6, 18.
-    assert seen_budgets == {1, 2, 6, 18}
+    # Proposals carry the FULL cumulative rung budgets (ladder 1/3/9/27);
+    # checkpoint resume turns them into deltas at execution time.
+    assert seen_budgets == {1, 3, 9, 27}
     best_knobs, _ = adv.best()
     assert best_knobs["width"] >= 40
 
@@ -85,13 +91,13 @@ def test_forget_refunds_promotion():
     adv.feedback(proposals[0], 0.9)
     adv.feedback(proposals[1], 0.1)
     promo = adv.propose()
-    # IntegerKnob(1,27), eta=2: rung-1 full budget 2, delta 2-1=1.
-    assert promo.knobs["max_epochs"] == 1
-    assert promo.meta["cold_start_knobs"] == {"max_epochs": 2}
+    # IntegerKnob(1,27), eta=2: rung-1 full cumulative budget 2.
+    assert promo.knobs["max_epochs"] == 2
+    assert promo.meta["ckpt_scope"].startswith("asha-cfg-")
     adv.forget(promo)
     # The promotion slot is refunded: the same config is re-promotable.
     promo2 = adv.propose()
-    assert promo2.knobs["max_epochs"] == 1
+    assert promo2.knobs["max_epochs"] == 2
     assert promo2.knobs["width"] == promo.knobs["width"]
 
 
@@ -110,45 +116,27 @@ def test_registry_selects_asha():
     assert adv.propose() is None  # budget enforced
 
 
-def test_promotions_warm_start_from_own_config(tmp_path):
-    """A promoted trial must receive ITS configuration's rung-r weights
-    as shared params; rung-0 trials cold start."""
+def test_promotions_resume_own_configs_checkpoint(tmp_path):
+    """A promoted trial must receive ITS configuration's checkpoint dir
+    (the scope its rung-0 trial wrote), with a final-epoch save
+    requested, and the scoped dir must survive trial completion so the
+    NEXT rung can resume it."""
+    import os
+
     from rafiki_tpu.constants import BudgetOption
-    from rafiki_tpu.model.base import BaseModel
     from rafiki_tpu.store import MetaStore, ParamStore
     from rafiki_tpu.worker.runner import TrialRunner
 
-    received = []  # (width, shared-params marker or None)
+    log = []  # (max_epochs, shared) via _make_fake_model
+    kwargs_seen = []
 
-    class FakeModel(BaseModel):
-        @staticmethod
-        def get_knob_config():
-            return CONFIG
-
-        def __init__(self, **knobs):
-            super().__init__(**knobs)
-            self._params = {}
-
+    class FakeModel(_make_fake_model(log)):
         def train(self, path, *, shared_params=None, **kw):
-            marker = (None if shared_params is None
-                      else float(np.asarray(
-                          shared_params["marker"]).reshape(-1)[0]))
-            received.append((self.knobs["width"], marker,
-                             self.knobs["max_epochs"]))
-            self._params = {"marker":
-                            np.asarray(float(self.knobs["width"]))}
-
-        def evaluate(self, path):
-            return self.knobs["width"] / 64.0  # wider = better
-
-        def predict(self, queries):
-            return [0 for _ in queries]
-
-        def dump_parameters(self):
-            return dict(self._params)
-
-        def load_parameters(self, params):
-            self._params = dict(params)
+            kwargs_seen.append((self.knobs["width"], dict(kw)))
+            # Scoped checkpoints must already exist for a promotion:
+            # rung 0 of the same config "wrote" them (marker file).
+            super().train(path, shared_params=shared_params, **kw)
+            os.makedirs(kw["checkpoint_dir"], exist_ok=True)
 
     adv = AshaAdvisor(CONFIG, seed=3, eta=3, total_trials=10)
     runner = TrialRunner(FakeModel, adv, "tr", "va", MetaStore(":memory:"),
@@ -157,24 +145,34 @@ def test_promotions_warm_start_from_own_config(tmp_path):
                          budget={BudgetOption.MODEL_TRIAL_COUNT: 10})
     runner.run()
 
-    rung0 = [r for r in received if r[1] is None]
-    promotions = [r for r in received if r[1] is not None]
-    assert promotions, "no promotion ever warm-started"
-    for width, marker, _ in promotions:
-        # the warm-start came from the SAME config's earlier params
-        assert marker == float(width)
-    assert len(rung0) + len(promotions) == len(received)
-    # Promotions trained only the rung DELTA (ladder 1/3/9/27 under
-    # eta=3 -> deltas 2/6/18), never a full rung budget from scratch.
-    assert {e for _, _, e in promotions} <= {2, 6, 18}
-    assert all(e == 1 for _, _, e in rung0)
+    assert kwargs_seen, "no trials ran"
+    by_width = {}
+    for w, kw in kwargs_seen:
+        by_width.setdefault(w, []).append(kw)
+    promoted = {w: kws for w, kws in by_width.items() if len(kws) > 1}
+    assert promoted, "no configuration was ever promoted"
+    for w, kws in promoted.items():
+        # Same config -> same scoped checkpoint dir across rungs, and
+        # every rung requests its final state on disk + the ladder-top
+        # schedule shape.
+        dirs = {kw["checkpoint_dir"] for kw in kws}
+        assert len(dirs) == 1
+        d = dirs.pop()
+        assert "asha-cfg-" in d
+        assert os.path.isdir(d), "scoped dir was deleted mid-bracket"
+        assert all(kw["checkpoint_final_epoch"] for kw in kws)
+        assert all(kw["schedule_total_epochs"] == 27 for kw in kws)
+    # Job over: the worker-level sweep clears every scope of this job.
+    runner.cleanup_scoped_checkpoints()
+    root = os.path.join(str(tmp_path / "p"), "ckpt")
+    assert not os.path.isdir(root) or not [
+        n for n in os.listdir(root) if n.startswith("asha-warm-")]
 
 
-def test_promotion_records_cumulative_budget(tmp_path):
-    """Review finding r2: a promotion EXECUTES the rung delta but must
-    RECORD the cumulative budget — retraining from scratch with the
-    recorded knobs (advisor.best(), trial rows) reproduces the scored
-    model."""
+def test_promotion_budgets_are_cumulative_through_runner(tmp_path):
+    """Trial rows and advisor.best() carry the full cumulative rung
+    budgets — the proposal IS the reproducible record (no
+    record/executed split since checkpoint-resume landed)."""
     from rafiki_tpu.constants import BudgetOption
     from rafiki_tpu.store import MetaStore, ParamStore
     from rafiki_tpu.worker.runner import TrialRunner
@@ -184,14 +182,14 @@ def test_promotion_records_cumulative_budget(tmp_path):
     for p, s in zip(proposals, [0.9, 0.1, 0.2]):
         adv.feedback(p, s)
     promo = adv.propose()
-    assert promo.knobs["max_epochs"] == 2            # executed delta
-    assert promo.meta["record_knobs"] == {"max_epochs": 3}
+    assert promo.knobs["max_epochs"] == 3            # full rung-1 budget
+    assert "record_knobs" not in promo.meta
     adv.feedback(promo, 0.95)
     best_knobs, _ = adv.best()
     assert best_knobs["max_epochs"] == 3             # reproducible
 
     # And through the TrialRunner: trial rows carry ladder budgets
-    # (1/3/9/27), never the executed deltas (2/6/18).
+    # (1/3/9/27) only.
     log = []
     meta = MetaStore(":memory:")
     adv2 = AshaAdvisor(CONFIG, seed=3, eta=3, total_trials=8)
@@ -205,41 +203,57 @@ def test_promotion_records_cumulative_budget(tmp_path):
                 if t["status"] == "COMPLETED"}
     assert recorded <= {1, 3, 9, 27}, recorded
     executed = {e for e, _ in log}
-    assert executed & {2, 6, 18}, (
-        f"no promotion ever executed a delta: {executed}")
+    assert executed == recorded
 
 
-def test_promotion_cold_start_pays_full_budget(tmp_path):
-    """If the warm-start params vanished, the runner applies the
-    proposal's cold_start_knobs so the promoted trial retrains the FULL
-    rung budget (scores stay rung-comparable)."""
+def test_rung_resume_is_step_identical_to_uninterrupted_run(tmp_path,
+                                                            synth_image_data):
+    """The verdict's acceptance test: a promoted rung-1 trial — rung 0
+    trained 2 epochs, checkpointed its final state, rung 1 resumed and
+    trained to 6 — must score EXACTLY what one uninterrupted 6-epoch
+    run of the same configuration scores (same seed, same data order,
+    same lr schedule, same optimizer state at every step)."""
     from rafiki_tpu.constants import BudgetOption
+    from rafiki_tpu.model.knobs import FixedKnob
+    from rafiki_tpu.models.feedforward import JaxFeedForward
     from rafiki_tpu.store import MetaStore, ParamStore
     from rafiki_tpu.worker.runner import TrialRunner
 
-    epochs_seen = []
+    class AshaFF(JaxFeedForward):
+        @staticmethod
+        def get_knob_config():
+            cfg = dict(JaxFeedForward.get_knob_config())
+            # eta=3 ladder over [2,6]: rungs at 2 and 6 epochs. One
+            # batch size keeps the XLA step cache shared across trials.
+            cfg["max_epochs"] = IntegerKnob(2, 6)
+            cfg["batch_size"] = FixedKnob(64)
+            return cfg
 
-    class FakeModel(_make_fake_model(epochs_seen)):
-        pass
-
-    adv = AshaAdvisor(CONFIG, seed=3, eta=3, total_trials=4)
-    store = ParamStore(str(tmp_path / "p"))
-    runner = TrialRunner(FakeModel, adv, "tr", "va", MetaStore(":memory:"),
-                         store, sub_train_job_id="asha-cold",
+    train_path, val_path = synth_image_data
+    meta = MetaStore(":memory:")
+    adv = AshaAdvisor(AshaFF.get_knob_config(), seed=0, eta=3,
+                      total_trials=4)
+    runner = TrialRunner(AshaFF, adv, train_path, val_path, meta,
+                         ParamStore(str(tmp_path / "p")),
+                         sub_train_job_id="asha-ident",
                          budget={BudgetOption.MODEL_TRIAL_COUNT: 4})
-    # Run rung-0 trials until a promotion is pending, then clear the
-    # param store to simulate expiry.
-    for _ in range(3):
-        runner.run_one()
-    promo = adv.propose()
-    assert promo.meta.get("cold_start_knobs"), "expected a promotion"
-    import shutil
+    rows = runner.run()
+    promoted = [r for r in rows if r["status"] == "COMPLETED"
+                and r["knobs"]["max_epochs"] == 6]
+    assert promoted, "no rung-1 promotion completed"
+    promo = promoted[0]
 
-    shutil.rmtree(str(tmp_path / "p"), ignore_errors=True)
-    runner.run_one(promo)
-    # The last trial ran with the FULL rung budget (3), not the delta.
-    assert epochs_seen[-1][1] is None  # no shared params arrived
-    assert epochs_seen[-1][0] == 3
+    # Uninterrupted run: identical knobs, full budget, same schedule
+    # shape the rungs pinned — no checkpointing involved.
+    knobs = AshaFF.validate_knobs(dict(promo["knobs"]))
+    model = AshaFF(**knobs)
+    try:
+        model.train(train_path, schedule_total_epochs=6)
+        ref_score = float(model.evaluate(val_path))
+    finally:
+        model.destroy()
+    assert promo["score"] == pytest.approx(ref_score, abs=1e-6), (
+        "rung resume diverged from the uninterrupted run")
 
 
 def _make_fake_model(log):

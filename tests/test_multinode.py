@@ -372,3 +372,64 @@ def test_broker_restart_mid_serving_recovers(tmp_path, synth_image_data,
     finally:
         platform.shutdown()
         broker.stop()
+
+
+def test_persistent_bus_op_error_escalates_to_errored():
+    """ADVICE r3: a broker that persistently REPORTS op failures
+    (protocol/version skew — BusOpError, not a transport outage) must
+    not leave the worker warn-looping as RUNNING forever: after
+    max_op_errors consecutive laps with no successful iteration the
+    serve loop re-raises and the service goes ERRORED. Transport
+    failures (ConnectionError) keep retrying indefinitely."""
+    from rafiki_tpu.bus import BusOpError, MemoryBus
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    class FakeMeta:
+        def __init__(self):
+            self.statuses = []
+
+        def update_service(self, service_id, **fields):
+            self.statuses.append(fields.get("status"))
+
+    def make_worker(exc_factory, fail_forever=True, n_failures=0):
+        w = InferenceWorker("svc", "ij", "tr", FakeMeta(), None,
+                            MemoryBus(), batch_timeout=0.0)
+        w.max_op_errors = 3
+        w._load_model = lambda: type(
+            "M", (), {"predict_submit": staticmethod(
+                lambda q: (lambda: [0] * len(q)))})()
+        calls = {"n": 0}
+
+        class FlakyCache:
+            def register_worker(self, *a, **k):
+                pass
+
+            def unregister_worker(self, *a, **k):
+                pass
+
+            def pop_queries(self, *a, **k):
+                calls["n"] += 1
+                if fail_forever or calls["n"] <= n_failures:
+                    raise exc_factory()
+                w.stop_flag.set()
+                return []
+
+        w.cache = FlakyCache()
+        # Recovery laps sleep via stop_flag.wait(1.0); shrink it so the
+        # test runs in well under a second.
+        real_wait = w.stop_flag.wait
+        w.stop_flag.wait = lambda t=None: real_wait(0.01)
+        return w
+
+    # Persistent op errors: escalates after max_op_errors laps.
+    w = make_worker(lambda: BusOpError("bus error: unknown op"))
+    with pytest.raises(BusOpError):
+        w.run()
+    assert w.meta.statuses[-1] == "ERRORED"
+
+    # Transport errors beyond the cap: never escalates; a later stop
+    # lands STOPPED.
+    w2 = make_worker(lambda: ConnectionError("broker down"),
+                     fail_forever=False, n_failures=6)
+    w2.run()
+    assert w2.meta.statuses[-1] == "STOPPED"

@@ -171,3 +171,120 @@ def test_serve_cli_starts_and_stops_gracefully(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_dataset_upload_and_browser_only_flow(http_platform,
+                                              synth_image_data):
+    """VERDICT r3 item 1: dataset upload → meta row + stored file, and
+    the uploaded paths drive a full train job — every quickstart step
+    is doable through the REST surface the browser uses."""
+    from rafiki_tpu.client import Client
+    from rafiki_tpu.constants import BudgetOption, TaskType
+
+    train_path, val_path = synth_image_data
+    client = Client(admin_port=http_platform.app.port)
+    client.login("superadmin@rafiki", "rafiki")
+
+    up_train = client.create_dataset(
+        "synth-train", TaskType.IMAGE_CLASSIFICATION, train_path)
+    up_val = client.create_dataset(
+        "synth-val", TaskType.IMAGE_CLASSIFICATION, val_path)
+    # Stored under the node's datasets dir, byte-identical to the upload.
+    assert up_train["path"] != train_path
+    assert up_train["path"].startswith(http_platform.workdir)
+    assert os.path.getsize(up_train["path"]) == os.path.getsize(train_path)
+    assert up_train["size_bytes"] == os.path.getsize(train_path)
+    listed = client.get_datasets(task=TaskType.IMAGE_CLASSIFICATION)
+    assert {d["name"] for d in listed} == {"synth-train", "synth-val"}
+
+    model = client.create_model(
+        "ff-up", TaskType.IMAGE_CLASSIFICATION,
+        "rafiki_tpu.models.feedforward:JaxFeedForward")
+    job = client.create_train_job(
+        "upapp", TaskType.IMAGE_CLASSIFICATION, [model["id"]],
+        {BudgetOption.MODEL_TRIAL_COUNT: 1},
+        up_train["path"], up_val["path"])
+    done = client.wait_until_train_job_done(job["id"], timeout=600)
+    assert done["status"] == "STOPPED"
+    best = client.get_best_trials_of_train_job(job["id"], max_count=1)
+    assert best and best[0]["score"] is not None
+
+
+def test_dataset_upload_requires_auth_and_body(http_platform, tmp_path):
+    import requests as rq
+
+    base = f"http://127.0.0.1:{http_platform.app.port}"
+    r = rq.post(base + "/datasets?name=x&task=IMAGE_CLASSIFICATION",
+                data=b"zz", timeout=10,
+                headers={"Content-Type": "application/octet-stream"})
+    assert r.status_code == 401
+    from rafiki_tpu.client import Client
+    client = Client(admin_port=http_platform.app.port)
+    tok = client.login("superadmin@rafiki", "rafiki")["token"]
+    # Missing body / missing metadata are 400s, not crashes.
+    hdr = {"Authorization": f"Bearer {tok}",
+           "Content-Type": "application/octet-stream"}
+    r = rq.post(base + "/datasets?name=x&task=T", timeout=10, headers=hdr)
+    assert r.status_code == 400
+    r = rq.post(base + "/datasets?name=x", data=b"zz", timeout=10,
+                headers=hdr)
+    assert r.status_code == 400
+    # A hostile filename cannot traverse out of the datasets dir.
+    ds = rq.post(base + "/datasets?name=evil&task=T"
+                 "&filename=..%2F..%2Fpwn.zip", data=b"zz",
+                 timeout=10, headers=hdr).json()
+    import os as _os
+    assert _os.path.dirname(ds["path"]) == \
+        _os.path.join(http_platform.workdir, "datasets")
+
+
+def test_service_log_view(http_platform, synth_image_data):
+    """VERDICT r3 item 1: every service the platform launches captures
+    a per-service log file the dashboard can tail over REST."""
+    from rafiki_tpu.client import Client
+    from rafiki_tpu.constants import BudgetOption, TaskType
+
+    train_path, val_path = synth_image_data
+    client = Client(admin_port=http_platform.app.port)
+    client.login("superadmin@rafiki", "rafiki")
+    model = client.create_model(
+        "ff-logs", TaskType.IMAGE_CLASSIFICATION,
+        "rafiki_tpu.models.feedforward:JaxFeedForward")
+    job = client.create_train_job(
+        "logapp", TaskType.IMAGE_CLASSIFICATION, [model["id"]],
+        {BudgetOption.MODEL_TRIAL_COUNT: 1}, train_path, val_path)
+    client.wait_until_train_job_done(job["id"], timeout=600)
+
+    services = client.get_services()
+    train_svcs = [s for s in services if s["service_type"] == "TRAIN"]
+    assert train_svcs, f"no train service rows in {services}"
+    logs = client.get_service_logs(train_svcs[0]["id"])
+    assert logs["captured"], "train worker wrote no service log"
+    # The trial lifecycle (runner INFO records) landed in THIS
+    # service's file.
+    assert "trial" in logs["log"]
+    # Unknown ids are a clean 400-class error, not a 500.
+    from rafiki_tpu.client import ClientError
+    with pytest.raises(ClientError):
+        client.get_service_logs("nope")
+
+    # Tenant scoping: another (non-admin) user sees neither the service
+    # rows nor the logs of this user's job — logs carry trial knobs,
+    # scores and dataset paths.
+    client.create_user("peek@x.c", "pw", UserType.APP_DEVELOPER)
+    other = Client(admin_port=http_platform.app.port)
+    other.login("peek@x.c", "pw")
+    assert other.get_services() == []
+    with pytest.raises(ClientError) as e:
+        other.get_service_logs(train_svcs[0]["id"])
+    assert e.value.status == 403
+
+
+def test_dashboard_upload_and_log_elements(http_platform):
+    """The browser-only flow's UI hooks exist in the served page."""
+    url = f"http://127.0.0.1:{http_platform.app.port}/"
+    text = requests.get(url, timeout=10).text
+    for el in ("nd-upload", "nd-file", "nd-name", "nd-task",  # datasets
+               "nm-src-file",                 # model .py file upload
+               "services", "svclog"):         # per-service log view
+        assert f'id="{el}"' in text, f"missing dashboard element #{el}"
