@@ -13,6 +13,11 @@ small MLPs ≈ 0.95+).
 Run:  python examples/scripts/accuracy_parity.py
 Exits non-zero if any model lands below its band — the reproducible
 one-script check BASELINE.md's accuracy table points at.
+
+``--fast`` runs only the sub-minute rows (Sk models, FeedForward, CNN,
+the tabular MLPs) — the pre-commit tier's parity gate, so a parity
+regression in a default-tier change surfaces within minutes instead of
+at the next nightly full run (VERDICT r3 item 8).
 """
 
 import tempfile
@@ -63,7 +68,7 @@ def run_enas_search(train, val, band: float) -> None:
     record("JaxEnas(search)", "digits", best, band)
 
 
-def main() -> None:
+def main(fast: bool = False) -> None:
     from rafiki_tpu.datasets import (prepare_bundled_pos_corpus,
                                      prepare_sklearn_digits,
                                      prepare_sklearn_tabular)
@@ -88,46 +93,49 @@ def main() -> None:
                    "batch_size": 64, "weight_decay": 1e-4,
                    "max_epochs": 12, "early_stop_epochs": 5},
                   train, val, "digits", 0.90)
-        run_image(JaxViT,
-                  {"depth": 4, "learning_rate": 1e-3, "batch_size": 64,
-                   "weight_decay": 1e-4, "max_epochs": 25},
-                  train, val, "digits", 0.90)
-        # Flagship CNN family (BASELINE config[1]): the DenseNet-BC
-        # architecture at its tiny preset — the 8x8 digits cannot feed
-        # a 121-layer stack meaningfully, but the family (dense blocks,
-        # BN, SGD-cosine recipe) is exactly the one the 121 preset
-        # scales up.
-        run_image(JaxDenseNet,
-                  {"arch": "densenet_tiny", "growth_rate": 12,
-                   "learning_rate": 0.05, "batch_size": 64,
-                   "weight_decay": 1e-4, "max_epochs": 30,
-                   "early_stop_epochs": 5, "quick_train": False},
-                  train, val, "digits", 0.90)
-        # Flagship search family (BASELINE config[2]): full ENAS loop.
-        # Band: the searched arch must land in the same band as the
-        # hand-designed JaxCnn above — search must not lose accuracy.
-        run_enas_search(train, val, 0.90)
+        if not fast:
+            run_image(JaxViT,
+                      {"depth": 4, "learning_rate": 1e-3, "batch_size": 64,
+                       "weight_decay": 1e-4, "max_epochs": 25},
+                      train, val, "digits", 0.90)
+            # Flagship CNN family (BASELINE config[1]): the DenseNet-BC
+            # architecture at its tiny preset — the 8x8 digits cannot
+            # feed a 121-layer stack meaningfully, but the family (dense
+            # blocks, BN, SGD-cosine recipe) is exactly the one the 121
+            # preset scales up.
+            run_image(JaxDenseNet,
+                      {"arch": "densenet_tiny", "growth_rate": 12,
+                       "learning_rate": 0.05, "batch_size": 64,
+                       "weight_decay": 1e-4, "max_epochs": 30,
+                       "early_stop_epochs": 5, "quick_train": False},
+                      train, val, "digits", 0.90)
+            # Flagship search family (BASELINE config[2]): full ENAS
+            # loop. Band: the searched arch must land in the same band
+            # as the hand-designed JaxCnn above — search must not lose
+            # accuracy.
+            run_enas_search(train, val, 0.90)
 
-        # Sequence taggers on the bundled REAL English corpus
-        # (examples/datasets/english_pos; hand-tagged Universal
-        # tagset). ~2.4k train tokens: published token accuracies for
-        # small taggers without pretraining on corpora this size are
-        # ~80-90%; bands hold margin for seed variance.
-        ctr, cva = prepare_bundled_pos_corpus(tmp + "/pos")
-        for cls, knobs, band in (
-                (JaxPosTagger,
-                 {"embed_dim": 64, "hidden": 128, "learning_rate": 1e-2,
-                  "batch_size": 32, "max_epochs": 20}, 0.78),
-                (JaxTransformerTagger,
-                 {"d_model": 128, "n_heads": 4, "n_layers": 2,
-                  "learning_rate": 3e-3, "batch_size": 32,
-                  "max_epochs": 30, "max_len": 64, "dropout": 0.1},
-                 0.72)):
-            model = cls(**cls.validate_knobs(knobs))
-            model.train(ctr)
-            acc = float(model.evaluate(cva))
-            model.destroy()
-            record(cls.__name__, "english_pos", acc, band)
+            # Sequence taggers on the bundled REAL English corpus
+            # (examples/datasets/english_pos; hand-tagged Universal
+            # tagset). ~2.4k train tokens: published token accuracies
+            # for small taggers without pretraining on corpora this
+            # size are ~80-90%; bands hold margin for seed variance.
+            ctr, cva = prepare_bundled_pos_corpus(tmp + "/pos")
+            for cls, knobs, band in (
+                    (JaxPosTagger,
+                     {"embed_dim": 64, "hidden": 128,
+                      "learning_rate": 1e-2, "batch_size": 32,
+                      "max_epochs": 20}, 0.78),
+                    (JaxTransformerTagger,
+                     {"d_model": 128, "n_heads": 4, "n_layers": 2,
+                      "learning_rate": 3e-3, "batch_size": 32,
+                      "max_epochs": 30, "max_len": 64, "dropout": 0.1},
+                     0.72)):
+                model = cls(**cls.validate_knobs(knobs))
+                model.train(ctr)
+                acc = float(model.evaluate(cva))
+                model.destroy()
+                record(cls.__name__, "english_pos", acc, band)
 
         for dataset, band in (("breast_cancer", 0.90), ("wine", 0.90)):
             train, val = prepare_sklearn_tabular(dataset, f"{tmp}/{dataset}")
@@ -147,10 +155,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import argparse
+
     from rafiki_tpu.jaxenv import ensure_platform
 
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--fast", action="store_true",
+                        help="sub-minute rows only (pre-commit tier)")
+    args = parser.parse_args()
     # Resolve the JAX platform up front: honors JAX_PLATFORMS=cpu (the
     # site hook's config latch otherwise ignores it) and falls back to
     # CPU instead of hanging when the TPU tunnel is unreachable.
     ensure_platform()
-    main()
+    main(fast=args.fast)

@@ -201,9 +201,32 @@ class ServicesManager:
         return self.meta.get_service(svc_row["id"])
 
     def stop_train_services(self, train_job_id: str) -> None:
+        """Terminal teardown: every stop path for a train job funnels
+        here — explicit ``stop_train_job``, natural wind-down
+        (``_refresh_train_job_status``), error termination once the
+        workers give up — so this is also where the job's scoped rung
+        checkpoints are swept. The workers' own budget-exhausted sweep
+        (TrialRunner.cleanup_scoped_checkpoints) covers admin-less
+        runners, but a stopped or error-terminated job never reaches
+        it and would leak one train-state dir per halving
+        configuration. Crash-restart is unaffected: supervise recreates
+        individual workers mid-job without coming through here."""
         for sub in self.meta.get_sub_train_jobs(train_job_id):
             for w in self.meta.get_train_job_workers(sub["id"]):
                 self._stop_service(w["service_id"])
+            self._sweep_scoped_checkpoints(sub["id"])
+
+    def _sweep_scoped_checkpoints(self, sub_id: str) -> None:
+        if not self.params_dir:
+            return
+        root = os.path.join(self.params_dir, "ckpt")
+        if not os.path.isdir(root):
+            return
+        import shutil
+
+        for name in os.listdir(root):
+            if name.startswith(f"{sub_id}-"):
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
 
     # How long a foreign node's RUNNING row stays credible without a
     # heartbeat. Must comfortably exceed the heartbeat cadence

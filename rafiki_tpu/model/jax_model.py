@@ -335,8 +335,9 @@ class JaxModel(BaseModel):
         # checkpoint-resume continues it, so the rung sequence is
         # step-for-step an uninterrupted full-budget run (ASHA warm
         # starts; see advisor/asha.py).
-        sched_epochs = max(int(kwargs.get("schedule_total_epochs", 0)),
-                           max_epochs)
+        from .loop_ckpt import schedule_epochs
+
+        sched_epochs = schedule_epochs(kwargs, max_epochs)
 
         cache_key = self._step_cache_key(
             "train", mesh, steps_per_epoch, max_epochs, sched_epochs,

@@ -1,0 +1,122 @@
+"""Checkpoint-resume for hand-rolled epoch loops.
+
+Parity: SURVEY.md §5 "Checkpoint / resume". ``JaxModel``'s integrated
+loop has its own save/restore; the zoo models with custom loops (the
+sequence taggers, the tabular MLPs) get the SAME train-kwargs contract
+from this helper — ``checkpoint_dir`` / ``checkpoint_every_epochs`` /
+``checkpoint_final_epoch`` / ``schedule_total_epochs`` — so ASHA's
+scoped rung-resume (advisor/asha.py) works across the whole trainable
+zoo, not just JaxModel subclasses. A model that adopts this helper must
+also derive its per-epoch data order from the epoch index (not a
+sequentially-consumed RNG) so a resumed run visits the same batches an
+uninterrupted run would.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .logger import logger
+
+_log = logging.getLogger(__name__)
+
+
+def schedule_epochs(kwargs: Dict[str, Any], max_epochs: int) -> int:
+    """The LR-schedule horizon in epochs: ``schedule_total_epochs``
+    (ASHA pins it to the ladder's top budget so every rung sits on ONE
+    schedule shape) floored at the executed ``max_epochs``."""
+    return max(int(kwargs.get("schedule_total_epochs", 0) or 0),
+               max_epochs)
+
+
+def epoch_rng(seed: int, epoch: int) -> np.random.Generator:
+    """Per-epoch host RNG: epoch k's data order is a pure function of
+    (seed, k), so a run resumed at epoch k permutes identically to an
+    uninterrupted run (same constant JaxModel.train uses)."""
+    return np.random.default_rng((int(seed) + 1) * 100003 + epoch)
+
+
+class LoopCheckpointer:
+    """Save/restore of an arbitrary train-state pytree for custom loops.
+
+    Built on ``CheckpointManager`` with the identical on-disk format
+    JaxModel writes (positional ``leaf_<i>`` safetensors), including its
+    fallback semantics: a structurally incompatible snapshot (different
+    knob config reusing the dir) logs a warning and starts fresh, and a
+    failed save never errors the trial that trained fine.
+    """
+
+    def __init__(self, kwargs: Dict[str, Any]):
+        self._dir = kwargs.get("checkpoint_dir")
+        self._every = int(kwargs.get("checkpoint_every_epochs", 1))
+        self._final = bool(kwargs.get("checkpoint_final_epoch"))
+        self._mgr = None
+        if self._dir and self._every > 0:
+            from ..store.checkpoint import CheckpointManager
+
+            self._mgr = CheckpointManager(self._dir)
+
+    def restore(self, state: Any) -> Tuple[Any, int]:
+        """Returns ``(state, start_epoch)``; fresh start on mismatch."""
+        if self._mgr is None or self._mgr.latest_step() is None:
+            return state, 0
+        saved_epoch, arrays = self._mgr.restore()
+        leaves, treedef = jax.tree.flatten(state)
+        n_saved = sum(1 for k in arrays if k.startswith("leaf_"))
+        if n_saved != len(leaves):
+            _log.warning("checkpoint in %s has %d leaves, model has %d; "
+                         "starting fresh", self._dir, n_saved, len(leaves))
+            return state, 0
+        try:
+            # safetensors round-trips 0-d arrays as shape (1,); restore
+            # each leaf to its exact aval so compiled steps accept the
+            # state unchanged. Mesh-placed leaves (NamedSharding — the
+            # params and the moment tensors derived from them) keep
+            # their sharding; everything else (optax's scalar ``count``,
+            # created uncommitted by ``tx.init``) stays uncommitted —
+            # committing it to one device would conflict with the
+            # mesh-committed params inside a jitted step.
+            def _leaf(i, leaf):
+                val = np.asarray(arrays[f"leaf_{i}"]) \
+                    .reshape(leaf.shape).astype(leaf.dtype)
+                if isinstance(leaf.sharding, jax.sharding.NamedSharding):
+                    return jax.device_put(val, leaf.sharding)
+                return jax.numpy.asarray(val)
+
+            new_leaves = [_leaf(i, leaf) for i, leaf in enumerate(leaves)]
+        except ValueError:
+            _log.warning("checkpoint in %s has incompatible leaf shapes; "
+                         "starting fresh", self._dir)
+            return state, 0
+        logger.log(msg=f"resumed from checkpoint epoch {saved_epoch}")
+        return jax.tree.unflatten(treedef, new_leaves), saved_epoch + 1
+
+    def after_epoch(self, epoch: int, state: Any, max_epochs: int) -> None:
+        """In-loop cadence save (skips the final epoch — see after_loop)."""
+        if self._mgr is not None and (epoch + 1) % self._every == 0 \
+                and epoch + 1 < max_epochs:
+            self._save(epoch, state)
+
+    def after_loop(self, last_epoch: Optional[int], state: Any) -> None:
+        """Post-loop final save, only on request (checkpoint_final_epoch):
+        a successive-halving rung resumes exactly this state, and the
+        post-loop placement covers a ``max_epochs`` that is not a
+        multiple of the cadence."""
+        if self._mgr is not None and self._final and last_epoch is not None:
+            self._save(last_epoch, state)
+
+    def _save(self, epoch: int, state: Any) -> None:
+        arrays = {f"leaf_{i}": np.asarray(jax.device_get(leaf))
+                  for i, leaf in enumerate(jax.tree.leaves(state))}
+        try:
+            self._mgr.save(epoch, arrays)
+        except OSError:
+            # Checkpoints are an optimization, never the result (see
+            # JaxModel._save_ckpt): losing the snapshot means the next
+            # resume cold-starts — the documented fallback.
+            _log.warning("checkpoint save to %s failed; continuing "
+                         "without it", self._dir, exc_info=True)

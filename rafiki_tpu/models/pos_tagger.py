@@ -28,6 +28,7 @@ from ..model.dataset import (PAD_ID, hash_token_ids,  # noqa: F401
 from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
+from ..model.loop_ckpt import LoopCheckpointer, epoch_rng, schedule_epochs
 from ..parallel import batch_sharding, build_mesh, replicated
 from ..parallel.chips import ChipGroup
 
@@ -138,14 +139,15 @@ class JaxPosTagger(BaseModel):
         # Reuse the jitted step AND its optax tx across repeat trials with
         # identical static config (same process-level cache JaxModel uses;
         # a fresh tx per trial would defeat jit's cache).
-        cache_key = step_cache_key(self, "train", mesh, steps, max_epochs)
+        sched_epochs = schedule_epochs(kwargs, max_epochs)
+        cache_key = step_cache_key(self, "train", mesh, steps, sched_epochs)
         cached = _step_cache_get(cache_key)
         if cached is not None:
             tx, train_step = cached["tx"], cached["step"]
         else:
             lr = float(self.knobs.get("learning_rate", 1e-2))
             tx = optax.adam(optax.cosine_decay_schedule(
-                lr, decay_steps=max(1, steps * max_epochs), alpha=0.01))
+                lr, decay_steps=max(1, steps * sched_epochs), alpha=0.01))
             module = self._module
 
             @jax.jit
@@ -171,9 +173,12 @@ class JaxPosTagger(BaseModel):
         opt_state = tx.init(params)
         logger.define_plot("Training", ["loss", "token_acc"], x_axis="epoch")
         x_shard = batch_sharding(mesh)
-        order_rng = np.random.default_rng(int(self.knobs.get("seed", 0)))
-        for epoch in range(max_epochs):
-            order = order_rng.permutation(ds.size)
+        ckpt = LoopCheckpointer(kwargs)
+        (params, opt_state), start_epoch = ckpt.restore((params, opt_state))
+        seed = int(self.knobs.get("seed", 0))
+        last_epoch = None
+        for epoch in range(start_epoch, max_epochs):
+            order = epoch_rng(seed, epoch).permutation(ds.size)
             ep_loss = ep_acc = 0.0
             for s in range(steps):
                 sel = order[s * batch_size:(s + 1) * batch_size]
@@ -188,6 +193,9 @@ class JaxPosTagger(BaseModel):
                 ep_acc += float(acc)
             logger.log(epoch=epoch, loss=ep_loss / steps,
                        token_acc=ep_acc / steps)
+            last_epoch = epoch
+            ckpt.after_epoch(epoch, (params, opt_state), max_epochs)
+        ckpt.after_loop(last_epoch, (params, opt_state))
 
         self._variables = {"params": jax.device_get(params)}
         self._invalidate_compiled()

@@ -27,6 +27,7 @@ from ..model.dataset import load_tabular_dataset
 from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
+from ..model.loop_ckpt import LoopCheckpointer, epoch_rng, schedule_epochs
 from ..parallel import batch_sharding, build_mesh, replicated
 from ..parallel.chips import ChipGroup
 
@@ -116,15 +117,17 @@ class _JaxTabBase(BaseModel):
                              int(self.knobs.get("trial_epochs", 1)))
         steps = max(1, ds.size // batch_size)
 
+        sched_epochs = schedule_epochs(kwargs, max_epochs)
         cache_key = step_cache_key(self, "train", mesh,
-                                   ds.features.shape[1], steps, max_epochs)
+                                   ds.features.shape[1], steps,
+                                   sched_epochs)
         cached = _step_cache_get(cache_key)
         if cached is not None:
             tx, train_step = cached["tx"], cached["step"]
         else:
             lr = float(self.knobs.get("learning_rate", 1e-3))
             tx = optax.adam(optax.cosine_decay_schedule(
-                lr, decay_steps=max(1, steps * max_epochs), alpha=0.01))
+                lr, decay_steps=max(1, steps * sched_epochs), alpha=0.01))
             module = self._module
             regression = self.regression
 
@@ -150,9 +153,12 @@ class _JaxTabBase(BaseModel):
 
         logger.define_plot("Training", ["loss"], x_axis="epoch")
         x_shard = batch_sharding(mesh)
-        order_rng = np.random.default_rng(int(self.knobs.get("seed", 0)))
-        for epoch in range(max_epochs):
-            order = order_rng.permutation(ds.size)
+        ckpt = LoopCheckpointer(kwargs)
+        (params, opt_state), start_epoch = ckpt.restore((params, opt_state))
+        seed = int(self.knobs.get("seed", 0))
+        last_epoch = None
+        for epoch in range(start_epoch, max_epochs):
+            order = epoch_rng(seed, epoch).permutation(ds.size)
             ep_loss = 0.0
             for s in range(steps):
                 sel = order[s * batch_size:(s + 1) * batch_size]
@@ -164,6 +170,9 @@ class _JaxTabBase(BaseModel):
                     jax.device_put(targets[sel], x_shard))
                 ep_loss += float(loss)
             logger.log(epoch=epoch, loss=ep_loss / steps)
+            last_epoch = epoch
+            ckpt.after_epoch(epoch, (params, opt_state), max_epochs)
+        ckpt.after_loop(last_epoch, (params, opt_state))
 
         self._variables = {"params": jax.device_get(params)}
         self._invalidate_compiled()

@@ -41,6 +41,7 @@ from ..model.dataset import (PAD_ID, hash_token_ids,
 from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
+from ..model.loop_ckpt import LoopCheckpointer, epoch_rng, schedule_epochs
 from ..ops import (default_attention, sequence_sharded_attention,
                    switch_moe)
 from ..parallel import (DP_AXIS, SP_AXIS, batch_sharding, build_mesh,
@@ -70,6 +71,13 @@ class _EncoderBlock(nn.Module):
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     moe_experts: int = 0
+    # Inside the pipeline's shard_map (where GSPMD cannot partition for
+    # us) the expert stack arrives pre-sliced: ``moe_local_experts`` is
+    # this rank's slice size and ``ep_axis`` the mesh axis to psum the
+    # partial expert outputs over. None/default = the GSPMD path
+    # (full stack declared; PartitionSpec("ep", ...) does the rest).
+    moe_local_experts: Optional[int] = None
+    ep_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, attn_fn, kv_mask, *, deterministic: bool):
@@ -91,20 +99,22 @@ class _EncoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.moe_experts > 0:
             e, f = self.moe_experts, 4 * d_model
+            e_loc = self.moe_local_experts or e
             init = nn.initializers.lecun_normal()
             gate_w = self.param("moe_gate", init, (d_model, e),
                                 jnp.float32)
-            w1 = self.param("expert_w1", init, (e, d_model, f),
+            w1 = self.param("expert_w1", init, (e_loc, d_model, f),
                             self.dtype)
-            b1 = self.param("expert_b1", nn.initializers.zeros, (e, f),
+            b1 = self.param("expert_b1", nn.initializers.zeros, (e_loc, f),
                             self.dtype)
-            w2 = self.param("expert_w2", init, (e, f, d_model),
+            w2 = self.param("expert_w2", init, (e_loc, f, d_model),
                             self.dtype)
             b2 = self.param("expert_b2", nn.initializers.zeros,
-                            (e, d_model), self.dtype)
+                            (e_loc, d_model), self.dtype)
             tokens = h.astype(self.dtype).reshape(b * t, d_model)
             out, aux = switch_moe(tokens, gate_w, w1, b1, w2, b2,
-                                  token_mask=kv_mask.reshape(b * t))
+                                  token_mask=kv_mask.reshape(b * t),
+                                  expert_axis=self.ep_axis)
             self.sow("losses", "moe_aux", aux)
             out = nn.Dropout(self.dropout,
                              deterministic=deterministic)(out)
@@ -174,10 +184,11 @@ class JaxTransformerTagger(BaseModel):
             "expert_parallel": FixedKnob(1),
             # > 1 pipelines the encoder blocks over a pp mesh axis
             # (GPipe microbatch schedule; needs n_layers % pp == 0;
-            # composes with sequence_parallel and dropout — block
-            # params and optimizer state are STORED stage-sharded
-            # (P("pp", ...)), ~1/pp per chip; exclusive with
-            # moe_experts for now).
+            # composes with sequence_parallel, dropout AND moe_experts/
+            # expert_parallel — block params and optimizer state are
+            # STORED stage-sharded (P("pp", ...)), expert stacks
+            # additionally over ep (P("pp", "ep", ...)), ~1/pp per
+            # chip).
             "pipeline_parallel": FixedKnob(1),
             # Microbatches per pipeline step; 0 = auto (~4·pp).
             "pp_microbatches": FixedKnob(0),
@@ -213,13 +224,6 @@ class JaxTransformerTagger(BaseModel):
                 if n_layers % pp != 0:
                     raise ValueError(f"pipeline_parallel ({pp}) must "
                                      f"divide n_layers ({n_layers})")
-                if experts > 0:
-                    # MoE inside pipelined stages would need expert
-                    # stacks sharded over ep *and* stage-stacked over
-                    # pp simultaneously; not composed yet.
-                    raise ValueError(
-                        "pipeline_parallel is exclusive with "
-                        "moe_experts for now")
             self._mesh = build_mesh(ChipGroup.current().devices(), sp=sp,
                                     ep=ep, pp=pp)
         return self._mesh
@@ -280,22 +284,33 @@ class JaxTransformerTagger(BaseModel):
         """Assembled forward for ``pipeline_parallel > 1``: embed →
         GPipe-pipelined encoder blocks (``ops.pipeline_apply`` inside
         ``shard_map`` over pp, batch over dp, sequence over sp when
-        ``sequence_parallel > 1``) → head, reading the pp param layout
+        ``sequence_parallel > 1``, experts over ep when
+        ``moe_experts > 0``) → head, reading the pp param layout
         (see ``_pp_split``). Dropout is supported: the key is folded
         per (optimizer step, schedule tick, stage, sp shard), so every
-        microbatch position draws an independent mask.
+        microbatch position draws an independent mask. MoE is
+        supported: stage-stacked expert leaves enter the shard_map
+        sharded ``P("pp", "ep", ...)`` so each rank holds its stage's
+        slice of the expert stack, ``switch_moe`` runs in its
+        collective form (route globally, compute local experts, psum
+        partials over ep), and the router load-balance loss rides the
+        pipeline in the microbatch carry.
 
-        Returns ``logits_fn(pp_params, ids, step_i)``.
+        Returns ``logits_fn(pp_params, ids, step_i) -> (logits, aux)``
+        where ``aux`` is the mean MoE load-balance loss (0.0 for dense
+        models).
         """
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ..ops import pipeline_apply, ring_attention, ulysses_attention
-        from ..parallel import PP_AXIS
+        from ..parallel import EP_AXIS, PP_AXIS
 
         mesh = self.mesh
         pp = int(self.knobs.get("pipeline_parallel", 1))
         sp = mesh.shape[SP_AXIS]
+        ep = mesh.shape[EP_AXIS]
+        experts = int(self.knobs.get("moe_experts", 0))
         n_layers = int(self.knobs.get("n_layers", 2))
         span = n_layers // pp
         d_model = int(self.knobs.get("d_model", 128))
@@ -304,8 +319,11 @@ class JaxTransformerTagger(BaseModel):
         micro = int(self.knobs.get("pp_microbatches", 0))
         dropout = float(self.knobs.get("dropout", 0.0)) if train else 0.0
         seed = int(self.knobs.get("seed", 0))
-        block = _EncoderBlock(int(self.knobs.get("n_heads", 4)),
-                              dropout=dropout, dtype=jnp.bfloat16)
+        block = _EncoderBlock(
+            int(self.knobs.get("n_heads", 4)), dropout=dropout,
+            dtype=jnp.bfloat16, moe_experts=experts,
+            moe_local_experts=(experts // ep) if ep > 1 else None,
+            ep_axis=EP_AXIS if (ep > 1 and experts > 0) else None)
         if sp > 1:
             # Inside the pp shard_map the sequence dim is already the
             # local sp shard, so the attention must be the *collective*
@@ -321,49 +339,80 @@ class JaxTransformerTagger(BaseModel):
 
         act_spec = P(DP_AXIS, SP_AXIS) if sp > 1 else P(DP_AXIS)
 
-        @functools.partial(
-            shard_map, mesh=mesh,
-            in_specs=(P(PP_AXIS), act_spec, act_spec, P()),
-            out_specs=act_spec, check_vma=False)
-        def run_blocks(stages, x, mask, step_i):
-            local = jax.tree_util.tree_map(lambda a: a[0], stages)
+        def stage_leaf_spec(path, leaf):
+            name = "/".join(str(getattr(p, "key", p))
+                            for p in path).lower()
+            if ep > 1 and experts > 0 and "expert" in name:
+                return P(PP_AXIS, EP_AXIS)
+            return P(PP_AXIS)
 
-            def stage_fn(prm, xm, t):
-                xx, mm = xm
-                det = dropout == 0.0
-                rngs = None
-                if not det:
-                    key = jax.random.key(seed + 1)
-                    for part in (step_i, t,
-                                 jax.lax.axis_index(PP_AXIS)):
-                        key = jax.random.fold_in(key, part)
-                    if sp > 1:
-                        key = jax.random.fold_in(
-                            key, jax.lax.axis_index(SP_AXIS))
-                for j in range(span):
+        def make_run_blocks(stages_tree):
+            stage_specs = jax.tree_util.tree_map_with_path(
+                stage_leaf_spec, stages_tree)
+
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=(stage_specs, act_spec, act_spec, P()),
+                out_specs=(act_spec, P()), check_vma=False)
+            def run_blocks(stages, x, mask, step_i):
+                local = jax.tree_util.tree_map(lambda a: a[0], stages)
+
+                def stage_fn(prm, xm, t):
+                    xx, mm, aux = xm
+                    det = dropout == 0.0
+                    rngs = None
                     if not det:
-                        rngs = {"dropout": jax.random.fold_in(key, j)}
-                    xx = block.apply({"params": prm[f"stage{j}"]}, xx,
-                                     attn, mm, deterministic=det,
-                                     rngs=rngs)
-                return (xx, mm)
+                        key = jax.random.key(seed + 1)
+                        for part in (step_i, t,
+                                     jax.lax.axis_index(PP_AXIS)):
+                            key = jax.random.fold_in(key, part)
+                        if sp > 1:
+                            key = jax.random.fold_in(
+                                key, jax.lax.axis_index(SP_AXIS))
+                    for j in range(span):
+                        if not det:
+                            rngs = {"dropout": jax.random.fold_in(key, j)}
+                        # mutable=["losses"] is a no-op for dense blocks
+                        # (empty collection, aux += 0), so one call
+                        # covers both MoE and dense stages.
+                        xx, mods = block.apply(
+                            {"params": prm[f"stage{j}"]}, xx, attn,
+                            mm, deterministic=det, rngs=rngs,
+                            mutable=["losses"])
+                        aux = aux + sum(jax.tree_util.tree_leaves(
+                            mods.get("losses", {})))
+                    return (xx, mm, aux)
 
-            b = x.shape[0]
-            if micro > 0:
-                if b % micro:
-                    raise ValueError(
-                        f"pp_microbatches ({micro}) must divide the "
-                        f"per-dp-shard batch ({b})")
-                m = micro
-            else:
-                m = min(b, 4 * pp)
-                while b % m:  # auto: largest divisor <= 4·pp
-                    m -= 1
-            xs = x.reshape(m, b // m, *x.shape[1:])
-            ms = mask.reshape(m, b // m, *mask.shape[1:])
-            out, _ = pipeline_apply(stage_fn, local, (xs, ms),
-                                    axis_size=pp, stage_takes_tick=True)
-            return out.reshape(b, *out.shape[2:])
+                b = x.shape[0]
+                if micro > 0:
+                    if b % micro:
+                        raise ValueError(
+                            f"pp_microbatches ({micro}) must divide the "
+                            f"per-dp-shard batch ({b})")
+                    m = micro
+                else:
+                    m = min(b, 4 * pp)
+                    while b % m:  # auto: largest divisor <= 4·pp
+                        m -= 1
+                xs = x.reshape(m, b // m, *x.shape[1:])
+                ms = mask.reshape(m, b // m, *mask.shape[1:])
+                # The aux accumulator rides the pipeline with the
+                # activations: each stage adds its blocks' router
+                # losses, so the collected last-stage value is the
+                # microbatch's total across ALL layers.
+                zeros = jnp.zeros((m, 1), jnp.float32)
+                out, _, aux = pipeline_apply(
+                    stage_fn, local, (xs, ms, zeros), axis_size=pp,
+                    stage_takes_tick=True)
+                aux = aux.mean()
+                # Every rank must return the same replicated scalar
+                # for out_specs=P(): average the data-shard axes.
+                aux = jax.lax.pmean(aux, DP_AXIS)
+                if sp > 1:
+                    aux = jax.lax.pmean(aux, SP_AXIS)
+                return out.reshape(b, *out.shape[2:]), aux
+
+            return run_blocks
 
         def logits_fn(pp_params, ids, step_i):
             outer = pp_params["outer"]
@@ -372,11 +421,12 @@ class JaxTransformerTagger(BaseModel):
                 {"params": outer["Embed_0"]}, ids)
             pe = jnp.asarray(_sinusoidal(max_len, d_model))
             x = x + pe[None, :ids.shape[1]].astype(x.dtype)
-            x = run_blocks(pp_params["stages"], x, mask, step_i)
+            x, aux = make_run_blocks(pp_params["stages"])(
+                pp_params["stages"], x, mask, step_i)
             x = nn.LayerNorm(dtype=jnp.float32).apply(
                 {"params": outer["LayerNorm_0"]}, x)
             return nn.Dense(n_tags, dtype=jnp.float32).apply(
-                {"params": outer["Dense_0"]}, x)
+                {"params": outer["Dense_0"]}, x), aux
 
         return logits_fn
 
@@ -451,13 +501,14 @@ class JaxTransformerTagger(BaseModel):
         else:
             params = shard_variables(variables, mesh)["params"]
 
-        cache_key = step_cache_key(self, "train", mesh, steps, max_epochs)
+        sched_epochs = schedule_epochs(kwargs, max_epochs)
+        cache_key = step_cache_key(self, "train", mesh, steps, sched_epochs)
         cached = _step_cache_get(cache_key)
         if cached is not None:
             tx, train_step = cached["tx"], cached["step"]
         else:
             lr = float(self.knobs.get("learning_rate", 1e-3))
-            total = max(1, steps * max_epochs)
+            total = max(1, steps * sched_epochs)
             sched = optax.warmup_cosine_decay_schedule(
                 init_value=lr * 0.1, peak_value=lr,
                 warmup_steps=max(1, total // 10), decay_steps=total,
@@ -471,23 +522,26 @@ class JaxTransformerTagger(BaseModel):
             def train_step(params, opt_state, ids, lengths, tags, step_i):
                 def loss_fn(p):
                     if pp_logits is not None:
-                        logits, mods = pp_logits(p, ids, step_i), {}
+                        # The pipelined forward carries the MoE router
+                        # loss in the microbatch stream and returns it
+                        # alongside the logits (0.0 for dense models).
+                        logits, aux = pp_logits(p, ids, step_i)
                     else:
                         logits, mods = module.apply(
                             {"params": p}, ids, attn, train=True,
                             rngs={"dropout": jax.random.fold_in(
                                 drop_key, step_i)},
                             mutable=["losses"])
+                        # Router load-balance terms sown by MoE blocks
+                        # (empty collection for dense models).
+                        aux = sum(jax.tree_util.tree_leaves(
+                            mods.get("losses", {})))
                     mask = (jnp.arange(logits.shape[1])[None, :]
                             < lengths[:, None]).astype(jnp.float32)
                     losses = optax.softmax_cross_entropy_with_integer_labels(
                         logits, tags)
                     loss = (losses * mask).sum() / jnp.maximum(mask.sum(),
                                                                1)
-                    # Router load-balance terms sown by MoE blocks
-                    # (empty collection for dense models).
-                    aux = sum(jax.tree_util.tree_leaves(
-                        mods.get("losses", {})))
                     loss = loss + 0.01 * aux
                     correct = ((logits.argmax(-1) == tags) * mask).sum() \
                         / jnp.maximum(mask.sum(), 1)
@@ -504,10 +558,16 @@ class JaxTransformerTagger(BaseModel):
         logger.define_plot("Training", ["loss", "token_acc"],
                            x_axis="epoch")
         x_shard = batch_sharding(mesh)
-        order_rng = np.random.default_rng(int(self.knobs.get("seed", 0)))
-        step_i = 0
-        for epoch in range(max_epochs):
-            order = order_rng.permutation(ds.size)
+        ckpt = LoopCheckpointer(kwargs)
+        (params, opt_state), start_epoch = ckpt.restore((params, opt_state))
+        seed = int(self.knobs.get("seed", 0))
+        last_epoch = None
+        # step_i drives the dropout fold_in (and the pp per-tick rng);
+        # resuming it at the epoch boundary keeps the resumed run's rng
+        # stream identical to an uninterrupted run's.
+        step_i = start_epoch * steps
+        for epoch in range(start_epoch, max_epochs):
+            order = epoch_rng(seed, epoch).permutation(ds.size)
             ep_loss = ep_acc = 0.0
             for s in range(steps):
                 sel = order[s * batch_size:(s + 1) * batch_size]
@@ -524,6 +584,9 @@ class JaxTransformerTagger(BaseModel):
                 ep_acc += float(acc)
             logger.log(epoch=epoch, loss=ep_loss / steps,
                        token_acc=ep_acc / steps)
+            last_epoch = epoch
+            ckpt.after_epoch(epoch, (params, opt_state), max_epochs)
+        ckpt.after_loop(last_epoch, (params, opt_state))
 
         if pp_mode:
             params = self._pp_merge(params)
@@ -577,7 +640,7 @@ class JaxTransformerTagger(BaseModel):
                     len(self._meta["tag_names"]), train=False)
                 self._predict_fn = jax.jit(
                     lambda v, ids: jax.nn.softmax(
-                        pp_logits(v["params"], ids, jnp.int32(0)), -1))
+                        pp_logits(v["params"], ids, jnp.int32(0))[0], -1))
             else:
                 module, attn = self._module, self._attn_fn()
                 self._predict_fn = jax.jit(

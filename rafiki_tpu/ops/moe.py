@@ -29,9 +29,20 @@ import jax
 import jax.numpy as jnp
 
 
-def _switch_group(x, mask, gate_w, w1, b1, w2, b2, *, capacity: int):
-    """Route one token group. x (G, D); mask (G,) True = real token."""
+def _switch_group(x, mask, gate_w, w1, b1, w2, b2, offset, *,
+                  capacity: int):
+    """Route one token group. x (G, D); mask (G,) True = real token.
+
+    ``offset`` is this rank's first expert id within the GLOBAL expert
+    range: routing/dispatch always run over all ``gate_w.shape[1]``
+    experts, but the expert FFN weights may be a LOCAL slice
+    (``w1.shape[0]`` experts starting at ``offset`` — the shard_map
+    expert-parallel path; the caller psums the partial outputs). The
+    single-rank case is ``offset == 0`` with the full stack, where the
+    slice below is the identity.
+    """
     e = gate_w.shape[1]
+    e_loc = w1.shape[0]
 
     logits = jnp.einsum("nd,de->ne", x.astype(jnp.float32),
                         gate_w.astype(jnp.float32))
@@ -51,18 +62,21 @@ def _switch_group(x, mask, gate_w, w1, b1, w2, b2, *, capacity: int):
     slots = jax.nn.one_hot(jnp.clip(position, 0, capacity - 1).astype(
         jnp.int32), capacity, dtype=jnp.float32)          # (G, E, C)
     disp = slots * dispatch[..., None]                    # (G, E, C)
+    # This rank's expert slice of the dispatch/combine tensors.
+    disp = jax.lax.dynamic_slice_in_dim(disp, offset, e_loc, axis=1)
 
     xe = jnp.einsum("nec,nd->ecd", disp, x.astype(jnp.float32))
     xe = xe.astype(x.dtype)
     h = jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None]
     h = jax.nn.gelu(h)
-    ye = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None]  # (E, C, D)
+    ye = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None]  # (Eloc, C, D)
     combine = disp * gate[:, None, None]
     out = jnp.einsum("nec,ecd->nd", combine,
                      ye.astype(jnp.float32)).astype(x.dtype)
 
     # Switch aux loss over REAL tokens: E · Σ_e (token fraction)·(prob
     # mass fraction); ≈1 at near-uniform routing (not a hard bound).
+    # Router statistics are global (identical on every expert rank).
     denom = jnp.maximum(mask.sum(), 1.0)
     frac_tokens = onehot.sum(axis=0) / denom
     frac_probs = (probs * mask[:, None]).sum(axis=0) / denom
@@ -74,19 +88,31 @@ def switch_moe(x, gate_w, w1, b1, w2, b2, *,
                capacity_factor: float = 1.25,
                token_mask: Optional[jnp.ndarray] = None,
                group_size: int = 1024,
+               expert_axis: Optional[str] = None,
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-1 routed expert FFN over flattened tokens.
 
     Args:
       x: (N, D) tokens (callers flatten batch × seq).
-      gate_w: (D, E) router weights (compute runs in f32).
+      gate_w: (D, E) router weights (compute runs in f32); E is always
+        the GLOBAL expert count.
       w1, b1: (E, D, F), (E, F) first expert layer.
-      w2, b2: (E, F, D), (E, D) second expert layer.
+      w2, b2: (E, F, D), (E, D) second expert layer. With
+        ``expert_axis`` set these are this rank's LOCAL slice
+        (E/ep, ...).
       capacity_factor: per-expert slot head-room over the uniform share.
       token_mask: (N,) bool, True = real token. Padding tokens are
         never routed: they claim no capacity, contribute nothing to the
         router statistics, and get zero output.
       group_size: routing-group length (capacity is per group).
+      expert_axis: when called INSIDE a shard_map (the pipeline-parallel
+        path, where GSPMD cannot partition for us), the mesh axis name
+        the expert stack is sharded over. Tokens are replicated across
+        that axis; each rank routes globally, computes its local
+        experts' outputs, and the partial results are psummed here.
+        None (the default) is the single-rank / GSPMD path, where
+        sharding ``w1..b2`` with ``PartitionSpec("ep", ...)`` under jit
+        makes XLA insert the dispatch/combine collectives instead.
 
     Returns ``(out, aux)``: ``out`` (N, D) combined expert outputs
     (zero rows for dropped/masked tokens), ``aux`` the mean Switch
@@ -105,10 +131,16 @@ def switch_moe(x, gate_w, w1, b1, w2, b2, *,
         token_mask = jnp.pad(token_mask, (0, pad))
     capacity = max(1, math.ceil(capacity_factor * g / e))
 
+    if expert_axis is not None:
+        offset = jax.lax.axis_index(expert_axis) * w1.shape[0]
+    else:
+        offset = jnp.int32(0)
     run = functools.partial(_switch_group, capacity=capacity)
     out, aux = jax.vmap(run, in_axes=(0, 0, None, None, None, None,
-                                      None))(
+                                      None, None))(
         x.reshape(n_groups, g, d),
         token_mask.reshape(n_groups, g).astype(jnp.float32),
-        gate_w, w1, b1, w2, b2)
+        gate_w, w1, b1, w2, b2, offset)
+    if expert_axis is not None:
+        out = jax.lax.psum(out, expert_axis)
     return out.reshape(n_groups * g, d)[:n], aux.mean()

@@ -107,19 +107,40 @@ def _rect_shapes(n: int):
     return sorted(shapes, key=lambda s: (max(s), abs(s[0] - s[1])))
 
 
+def _box_shapes(n: int):
+    """(d, h, w) factorizations of n, most cube-like first.
+
+    Ordering minimizes the box's ICI diameter: smallest max extent,
+    then smallest extent sum. On a z-flat (2-D) grid the d>1 shapes
+    simply never fit and the search degrades to the rectangle order.
+    """
+    shapes = []
+    for d in range(1, n + 1):
+        if n % d:
+            continue
+        for h in range(1, n // d + 1):
+            if (n // d) % h == 0:
+                shapes.append((d, h, n // (d * h)))
+    return sorted(shapes, key=lambda s: (max(s), sum(s)))
+
+
 class ChipAllocator:
     """Carves a device list into non-overlapping chip groups.
 
     The Admin-side resource manager: thread-safe. Placement is
     **topology-aware** when the backend exposes device coords (TPU): a
-    group of ``n`` chips is placed as the squarest free axis-aligned
-    rectangle on the slice's 2-D ICI torus, so every intra-group
-    collective rides single-hop ICI links (a linear index range can
-    straddle torus rows — adjacent indices, distant chips). Without
-    coords (virtual CPU meshes) placement is contiguous-first-fit on the
-    device index. ``allocate`` returns None when the request cannot be
-    satisfied — callers queue and retry (scheduler fairness is handled
-    one level up, in the ServicesManager).
+    group of ``n`` chips is placed as the most cube-like free
+    axis-aligned box on the slice's ICI torus — a rectangle on 2-D
+    slices (v5e), a genuine d×h×w box on 3-D tori (v4/v5p) — so every
+    intra-group collective rides single-hop ICI links (a linear index
+    range can straddle torus rows — adjacent indices, distant chips).
+    When fragmentation or an awkward size blocks every box, the group
+    falls back to a connected free blob (still ICI-internal, larger
+    diameter). Without coords (virtual CPU meshes) placement is
+    contiguous-first-fit on the device index. ``allocate`` returns None
+    when the request cannot be satisfied — callers queue and retry
+    (scheduler fairness is handled one level up, in the
+    ServicesManager).
     """
 
     def __init__(self, n_chips: Optional[int] = None,
@@ -157,12 +178,12 @@ class ChipAllocator:
         if topology is not None and len(topology) != n_chips:
             raise ValueError(f"topology has {len(topology)} entries for "
                              f"{n_chips} chips")
-        self._topology = [tuple(c) for c in topology] if topology else None
-        if self._topology and len({c[2:] for c in self._topology}) > 1:
-            # 3-D (z-varying) topologies have no 2-D rectangle story
-            # yet; fall back to linear placement rather than refusing
-            # every allocation.
-            self._topology = None
+        # Normalize coords to (x, y, z): v5e slices report z == 0
+        # everywhere; v4/v5p report a genuine 3-D torus position. The
+        # box search below handles both (a z-flat grid only ever fits
+        # depth-1 boxes, i.e. plain rectangles).
+        self._topology = ([tuple(c[:3]) + (0,) * (3 - min(len(c), 3))
+                           for c in topology] if topology else None)
         self._lock = threading.Lock()
         self._owner: List[Optional[str]] = [None] * n_chips
         self._groups: Dict[str, ChipGroup] = {}
@@ -177,13 +198,17 @@ class ChipAllocator:
                     f"group {name!r} already holds chips; release it first")
             # With a known topology, placements must be ICI-connected:
             # a linear index run can straddle torus rows, putting one
-            # group's collectives on other groups' ICI links. Rectangles
-            # first (minimal diameter); sizes with no rectangle that
-            # can EVER fit the grid (5 or 7 on a 2x4) fall back to a
-            # connected blob. Otherwise None -> callers queue/retry.
+            # group's collectives on other groups' ICI links. Axis-
+            # aligned boxes first (minimal diameter); when no box fits
+            # — the size has no box factorization (5 or 7 on a 2x4) or
+            # fragmentation blocks every feasible box — fall back to a
+            # connected free blob, which keeps every collective on
+            # group-internal links at the cost of a non-minimal
+            # diameter. Only a grid with no connected free region of n
+            # cells returns None -> callers queue/retry.
             if self._topology is not None:
-                idx = self._find_rectangle(n)
-                if idx is None and not self._rect_feasible(n):
+                idx = self._find_box(n)
+                if idx is None:
                     idx = self._find_blob(n)
             else:
                 idx = self._find_linear(n)
@@ -195,52 +220,57 @@ class ChipAllocator:
             self._groups[name] = group
             return group
 
-    def _find_rectangle(self, n: int) -> Optional[tuple]:
-        """Squarest free h×w rectangle on the (x, y) coord grid.
+    def _find_box(self, n: int) -> Optional[tuple]:
+        """Most cube-like free d×h×w box on the (x, y, z) coord grid.
 
         Returned indices are in BOUSTROPHEDON (snake) order — each row
-        reversed relative to the previous — so devices adjacent in
-        group order are physically adjacent on the torus at every hop
-        including the row turns; ``build_mesh``'s ring (``sp``) axis
-        ppermutes between group-order neighbours, and plain row-major
-        order would make the row boundaries 2-hop diagonals.
+        reversed relative to the previous, and each z-plane's whole
+        traversal reversed relative to the plane below — so devices
+        adjacent in group order are physically adjacent on the torus at
+        every hop including row turns and plane turns; ``build_mesh``'s
+        ring (``sp``) axis ppermutes between group-order neighbours,
+        and plain row-major order would make those boundaries
+        multi-hop diagonals. On a z-flat grid (v5e) only d == 1 boxes
+        fit and this is exactly the 2-D rectangle search.
         """
-        grid = {c[:2]: i for i, c in enumerate(self._topology)}
-        free = {xy for xy, i in grid.items() if self._owner[i] is None}
-        for h, w in _rect_shapes(n):
-            for (x0, y0) in sorted(free):
+        grid = {c: i for i, c in enumerate(self._topology)}
+        free = {c for c, i in grid.items() if self._owner[i] is None}
+        for d, h, w in _box_shapes(n):
+            for (x0, y0, z0) in sorted(free, key=lambda c: (c[2], c[1],
+                                                            c[0])):
                 cells = []
-                for dy in range(h):
-                    xs = range(w) if dy % 2 == 0 else range(w - 1, -1, -1)
-                    cells.extend((x0 + dx, y0 + dy) for dx in xs)
+                for dz in range(d):
+                    plane = []
+                    for dy in range(h):
+                        xs = (range(w) if dy % 2 == 0
+                              else range(w - 1, -1, -1))
+                        plane.extend((x0 + dx, y0 + dy, z0 + dz)
+                                     for dx in xs)
+                    if dz % 2 == 1:
+                        plane.reverse()
+                    cells.extend(plane)
                 if all(c in free for c in cells):
                     return tuple(grid[c] for c in cells)
         return None
 
-    def _rect_feasible(self, n: int) -> bool:
-        """Could SOME h×w factorization of n ever fit this grid?"""
-        xs = [c[0] for c in self._topology]
-        ys = [c[1] for c in self._topology]
-        gw = max(xs) - min(xs) + 1
-        gh = max(ys) - min(ys) + 1
-        return any(h <= gh and w <= gw for h, w in _rect_shapes(n))
-
     def _find_blob(self, n: int) -> Optional[tuple]:
-        """Connected free region of n cells (BFS, 4-neighbour).
+        """Connected free region of n cells (BFS, 6-neighbour).
 
-        Fallback for sizes with no feasible rectangle: the group stays
-        ICI-connected (every member reachable through group-internal
-        links) even though its diameter is not minimal.
+        Fallback when no axis-aligned box fits — whether because the
+        size has no feasible factorization or because fragmentation
+        blocks every feasible box: the group stays ICI-connected (every
+        member reachable through group-internal links) even though its
+        diameter is not minimal.
         """
-        grid = {c[:2]: i for i, c in enumerate(self._topology)}
-        free = {xy for xy, i in grid.items() if self._owner[i] is None}
+        grid = {c: i for i, c in enumerate(self._topology)}
+        free = {c for c, i in grid.items() if self._owner[i] is None}
         for anchor in sorted(free):
             blob, frontier = [anchor], [anchor]
             seen = {anchor}
             while frontier and len(blob) < n:
-                x, y = frontier.pop(0)
-                for nxt in ((x + 1, y), (x - 1, y), (x, y + 1),
-                            (x, y - 1)):
+                x, y, z = frontier.pop(0)
+                for nxt in ((x + 1, y, z), (x - 1, y, z), (x, y + 1, z),
+                            (x, y - 1, z), (x, y, z + 1), (x, y, z - 1)):
                     if nxt in free and nxt not in seen:
                         seen.add(nxt)
                         blob.append(nxt)
@@ -250,7 +280,7 @@ class ChipAllocator:
             if len(blob) == n:
                 return tuple(grid[c] for c in sorted(blob,
                                                      key=lambda c:
-                                                     (c[1], c[0])))
+                                                     (c[2], c[1], c[0])))
         return None
 
     def _find_linear(self, n: int) -> Optional[tuple]:

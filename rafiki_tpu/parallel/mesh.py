@@ -85,7 +85,9 @@ def param_spec(arr: Any, tp: int, ep: int = 1, name: str = "",
 
     Stage-stacked parameters — leaves whose tree path contains
     ``stage`` with a leading axis of length ``pp`` — shard that axis
-    over ``pp`` (each pipeline stage holds its layer span's params).
+    over ``pp`` (each pipeline stage holds its layer span's params); a
+    stage-stacked EXPERT leaf (pp × ep composition) additionally shards
+    its second axis — the expert stack — over ``ep``.
     Expert-stacked parameters — leaves whose tree path contains
     ``expert`` with a leading axis divisible by ``ep`` — shard that
     axis over ``ep`` (each ep group holds a slice of the expert stack).
@@ -96,6 +98,9 @@ def param_spec(arr: Any, tp: int, ep: int = 1, name: str = "",
     """
     shape = getattr(arr, "shape", ())
     if pp > 1 and "stage" in name and shape and shape[0] == pp:
+        if ep > 1 and "expert" in name and len(shape) > 1 \
+                and shape[1] % ep == 0:
+            return P(PP_AXIS, EP_AXIS, *([None] * (len(shape) - 2)))
         return P(PP_AXIS, *([None] * (len(shape) - 1)))
     if ep > 1 and "expert" in name and shape and shape[0] % ep == 0:
         return P(EP_AXIS, *([None] * (len(shape) - 1)))

@@ -71,11 +71,21 @@ def _compile(path: str) -> re.Pattern:
 class JsonHttpServer:
     """A route-table HTTP server. ``port=0`` picks a free port."""
 
+    # Request bodies are buffered in memory before dispatch (dataset
+    # uploads included), and the admin process also supervises every
+    # service — one unbounded upload (or a forged huge Content-Length)
+    # must not be able to OOM it. Oversized requests get 413 before a
+    # single body byte is read. Override via RAFIKI_TPU_MAX_UPLOAD_MB.
+    import os as _os
+    MAX_BODY = int(_os.environ.get("RAFIKI_TPU_MAX_UPLOAD_MB", "256")) \
+        * 1024 * 1024
+
     def __init__(self, routes: List[Tuple[str, str, Handler]],
                  host: str = "0.0.0.0", port: int = 0,
-                 name: str = "http"):
+                 name: str = "http", max_body: Optional[int] = None):
         self._routes = [(method.upper(), _compile(path), handler)
                         for method, path, handler in routes]
+        self.max_body = max_body if max_body is not None else self.MAX_BODY
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -89,21 +99,40 @@ class JsonHttpServer:
                 body = None
                 raw_body = None
                 length = int(self.headers.get("Content-Length") or 0)
+                if length > outer.max_body:
+                    # Reject before reading a byte; the client is still
+                    # mid-send, so the connection must close rather
+                    # than be reused with the unread body in the pipe.
+                    self.close_connection = True
+                    self._reply(413, {"error":
+                                      f"request body {length} bytes "
+                                      f"exceeds limit {outer.max_body}"})
+                    return
                 if length:
                     raw = self.rfile.read(length)
                     ctype = (self.headers.get("Content-Type") or "").lower()
-                    if "json" in ctype or not ctype:
-                        # JSON (or legacy clients that send none): the
-                        # body must parse.
+                    if any(t in ctype for t in ("octet-stream", "zip",
+                                                "multipart")):
+                        # A declared binary payload (file upload) passes
+                        # through verbatim for the handler — never
+                        # JSON-sniffed (a CSV/zip that happens to parse
+                        # as JSON must still reach the upload handler
+                        # as bytes).
+                        raw_body = raw
+                    else:
+                        # Everything else is expected to be JSON. The
+                        # parse attempt is independent of the declared
+                        # type: legacy clients (curl -d) send JSON
+                        # bodies under x-www-form-urlencoded, and
+                        # failing those with 400/500 would break them.
                         try:
                             body = json.loads(raw)
                         except json.JSONDecodeError:
-                            self._reply(400, {"error": "invalid JSON body"})
-                            return
-                    else:
-                        # A declared non-JSON payload (file upload)
-                        # passes through verbatim for the handler.
-                        raw_body = raw
+                            if "json" in ctype or not ctype:
+                                self._reply(400,
+                                            {"error": "invalid JSON body"})
+                                return
+                            raw_body = raw  # genuinely non-JSON text
                 ctx = RequestContext(self.headers, parse_qs(parsed.query),
                                      raw_body=raw_body)
                 for m, pattern, handler in outer._routes:

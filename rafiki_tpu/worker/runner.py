@@ -253,7 +253,10 @@ class TrialRunner:
         configuration resume each other — so nothing inside the trial
         loop may delete them. Without a terminal sweep they would grow
         one dir per halving configuration forever; the TrainWorker calls
-        this once its sub-job's budget is exhausted. Racing a still-
+        this once its sub-job's budget is exhausted, and the
+        ServicesManager sweeps equivalently on every job stop path
+        (explicit stop, error termination, wind-down), covering jobs
+        that never exhaust their budget. Racing a still-
         running sibling worker is benign: a trial that loses its scope
         dir mid-flight cold-starts its full proposed budget, which is
         the documented fallback and stays rung-comparable.

@@ -310,3 +310,39 @@ def test_asha_through_platform(tmp_path, synth_image_data):
         assert detail["sub_train_jobs"][0]["n_completed"] == 3
     finally:
         p.shutdown()
+
+
+def test_stop_train_services_sweeps_scoped_checkpoints(tmp_path):
+    """Review finding r4: a stopped or error-terminated job must not
+    leak scoped rung checkpoints. Every stop path funnels through
+    ServicesManager.stop_train_services, which sweeps each sub-job's
+    scoped dirs (the workers' own budget-exhausted sweep never runs for
+    such jobs)."""
+    import os
+
+    from rafiki_tpu.admin.services_manager import ServicesManager
+    from rafiki_tpu.constants import TrainJobStatus, UserType
+    from rafiki_tpu.container.manager import ThreadContainerManager
+    from rafiki_tpu.store import MetaStore
+
+    meta = MetaStore(":memory:")
+    user = meta.create_user("a@b.c", "x", UserType.MODEL_DEVELOPER)
+    model = meta.create_model(user["id"], "m", "IMAGE_CLASSIFICATION",
+                              "mod:Cls", {})
+    job = meta.create_train_job(user["id"], "app", "IMAGE_CLASSIFICATION",
+                                {}, "tr", "va",
+                                status=TrainJobStatus.RUNNING)
+    sub = meta.create_sub_train_job(job["id"], model["id"],
+                                    status="RUNNING")
+    params_dir = str(tmp_path / "params")
+    scoped = os.path.join(params_dir, "ckpt", f"{sub['id']}-asha-cfg-0")
+    other = os.path.join(params_dir, "ckpt", "othersub-asha-cfg-0")
+    os.makedirs(scoped)
+    os.makedirs(other)
+    # No services exist, so the container manager is never exercised;
+    # a None ctx keeps the test free of platform plumbing.
+    sm = ServicesManager(meta, ThreadContainerManager(ctx=None),
+                         params_dir=params_dir, node_id="n1")
+    sm.stop_train_services(job["id"])
+    assert not os.path.isdir(scoped)      # this job's dirs swept
+    assert os.path.isdir(other)           # other jobs' dirs untouched

@@ -137,6 +137,22 @@ def test_accuracy_parity_script():
 
 
 @pytest.mark.slow
+def test_accuracy_parity_fast_tier():
+    """VERDICT r3 item 8: the sub-minute parity rows (Sk models, FF,
+    CNN, tabular) gate the pre-commit tier, so a parity regression in a
+    default-tier change surfaces within minutes, not at the nightly
+    full run."""
+    r = _run("examples/scripts/accuracy_parity.py", "--fast", timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ACCURACY PARITY OK" in r.stdout
+    # The fast tier covers exactly the cheap rows.
+    for name in ("SkSvm", "SkDt", "JaxFeedForward", "JaxCnn",
+                 "JaxTabMlpClf"):
+        assert name in r.stdout
+    assert "JaxDenseNet" not in r.stdout  # nightly-only row
+
+
+@pytest.mark.slow
 def test_parallelism_tour():
     r = _run("examples/scripts/parallelism_tour.py", timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr

@@ -55,14 +55,27 @@ def test_allocator_topology_squares_on_2x4():
     assert abs(x0 - x1) + abs(y0 - y1) == 1
 
 
-def test_allocator_topology_fragmented():
-    """With the left 2×2 square taken, the remaining 2×2 column fits
-    pairs but not a 3-chip line — the allocator reports None (callers
-    queue and retry) instead of handing out a non-adjacent set whose
-    collectives would cross other groups' ICI paths."""
-    a = ChipAllocator(8, topology=_v5e_2x4())
+def _assert_connected(group, topology):
+    """Every member has an in-group torus neighbour (6-neighbour)."""
+    coords = [topology[i] for i in group.indices]
+    for c in coords:
+        assert any(sum(abs(a - b) for a, b in zip(c, c2)) == 1
+                   for c2 in coords if c2 != c), (c, coords)
+
+
+def test_allocator_topology_fragmented_blob():
+    """VERDICT r3 item 5: with the left 2×2 square taken, no 1×3 line
+    fits the remaining 2×2 column — but a connected 3-blob does, so
+    the allocator places one (ICI-internal, non-minimal diameter)
+    instead of queueing the trial forever."""
+    topo = _v5e_2x4()
+    a = ChipAllocator(8, topology=topo)
     a.allocate(4, "sq")                  # takes x∈{0,1} × y∈{0,1}
-    assert a.allocate(3, "odd") is None  # no 1x3 line, no linear run
+    g = a.allocate(3, "odd")             # no 1x3 line — blob fallback
+    assert g is not None
+    _assert_connected(g, topo)
+    assert a.allocate(2, "p1") is None   # only 1 chip left
+    a.release("odd")
     g1, g2 = a.allocate(2, "p1"), a.allocate(2, "p2")
     assert g1 is not None and g2 is not None
     assert a.free_chips == 0
@@ -71,14 +84,19 @@ def test_allocator_topology_fragmented():
 def test_allocator_topology_never_straddles_rows():
     """Review finding r2: with topology known there is NO linear
     fallback — an index run like (1,2,3,4) on a 2×4 grid crosses the
-    row boundary ((3,0)→(0,1) are not torus neighbours), so the
-    allocator must return None rather than hand it out."""
-    a = ChipAllocator(8, topology=_v5e_2x4())
+    row boundary ((3,0)→(0,1) are not torus neighbours). The blob
+    fallback (r4) means the allocation now succeeds, but only as a
+    CONNECTED region, never as that disconnected index run."""
+    topo = _v5e_2x4()
+    a = ChipAllocator(8, topology=topo)
     # Occupy (0,0)=idx0 and (2,1)=idx6: indices 1..4 stay free and
     # linearly contiguous, but no free 2x2 / 1x4 rectangle exists.
     a._owner[0] = "x"
     a._owner[6] = "y"
-    assert a.allocate(4, "t") is None
+    g = a.allocate(4, "t")
+    assert g is not None
+    assert set(g.indices) != {1, 2, 3, 4}  # the disconnected run
+    _assert_connected(g, topo)
 
 
 def test_allocator_full_slice_rectangle():
@@ -146,8 +164,53 @@ def test_allocator_blob_for_non_rectangular_sizes():
     for (x, y) in coords:
         assert any(abs(x - x2) + abs(y - y2) == 1 for (x2, y2) in coords
                    if (x2, y2) != (x, y))
-    # Rectangle sizes still refuse to blob (compactness preserved).
-    assert a.allocate(4, "sq") is None  # only 3 free, and 4 is 2x2-able
+    # Too few free chips still refuses outright.
+    assert a.allocate(4, "sq") is None  # only 3 free
     a.release("odd")
     assert a.allocate(4, "sq") is not None
     assert _rect_shapes(6)[0] == (2, 3) or _rect_shapes(6)[0] == (3, 2)
+
+
+def _v4_2x2x2():
+    """Coords of an 8-chip v4 cube: a genuine 3-D (z-varying) torus."""
+    return [(x, y, z) for z in range(2) for y in range(2) for x in range(2)]
+
+
+def test_allocator_3d_carves_cube_into_planes():
+    """VERDICT r3 item 4: a 2×2×2 v4 cube carves into two 2×2×1 plane
+    groups (most cube-like boxes for n=4), each fully ICI-adjacent —
+    not discarded to linear placement as before."""
+    topo = _v4_2x2x2()
+    a = ChipAllocator(8, topology=topo)
+    g1 = a.allocate(4, "t1")
+    g2 = a.allocate(4, "t2")
+    assert a.free_chips == 0
+    for g in (g1, g2):
+        coords = [topo[i] for i in g.indices]
+        assert len({c[2] for c in coords}) == 1  # one z-plane each
+        _assert_connected(g, topo)
+        # Snake order: every group-order hop is a single ICI link.
+        for c, c2 in zip(coords, coords[1:]):
+            assert sum(abs(u - v) for u, v in zip(c, c2)) == 1
+
+
+def test_allocator_3d_full_cube_snake():
+    """The whole cube allocates as one 2×2×2 box whose snake order is
+    single-hop at every step, including the z-plane turn."""
+    topo = _v4_2x2x2()
+    a = ChipAllocator(8, topology=topo)
+    g = a.allocate(8, "all")
+    assert sorted(g.indices) == list(range(8))
+    coords = [topo[i] for i in g.indices]
+    for c, c2 in zip(coords, coords[1:]):
+        assert sum(abs(u - v) for u, v in zip(c, c2)) == 1
+
+
+def test_allocator_3d_blob_spans_planes():
+    """An awkward size on the cube (5) comes back as a connected blob
+    spanning z-planes via vertical ICI links."""
+    topo = _v4_2x2x2()
+    a = ChipAllocator(8, topology=topo)
+    g = a.allocate(5, "odd")
+    assert g is not None and len(g.indices) == 5
+    _assert_connected(g, topo)

@@ -109,9 +109,11 @@ def test_pipeline_parallel_knob_validation():
     with pytest.raises(ValueError, match="divide n_layers"):
         JaxTransformerTagger(**dict(KNOBS, n_layers=3,
                                     pipeline_parallel=2)).mesh
-    with pytest.raises(ValueError, match="exclusive"):
-        JaxTransformerTagger(**dict(KNOBS, moe_experts=4,
-                                    pipeline_parallel=2)).mesh
+    # pp x ep composes since r4 — the mesh builds without complaint.
+    mesh = JaxTransformerTagger(**dict(KNOBS, moe_experts=4,
+                                       expert_parallel=2,
+                                       pipeline_parallel=2)).mesh
+    assert mesh.shape["pp"] == 2 and mesh.shape["ep"] == 2
 
 
 def test_pipeline_parallel_params_stored_stage_sharded(synth_corpus_data):
@@ -168,5 +170,78 @@ def test_pipeline_parallel_composes_with_sequence_parallel(
     base = JaxTransformerTagger(**dict(KNOBS, dropout=0.0))
     base.train(train_path)
     assert abs(score - base.evaluate(val_path)) < 0.05
+    model.destroy()
+    base.destroy()
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_step_identical(synth_corpus_data, tmp_path):
+    """The tagger honors the loop_ckpt contract with a NONZERO dropout:
+    a run checkpointed at epoch 3 and resumed to 6 must land on exactly
+    the params of an uninterrupted 6-epoch run — the resumed step_i
+    keeps the dropout fold_in stream identical."""
+    train_path, _ = synth_corpus_data
+    knobs = dict(KNOBS, dropout=0.1)
+    ck = str(tmp_path / "ck")
+
+    leg1 = JaxTransformerTagger(**JaxTransformerTagger.validate_knobs(
+        dict(knobs, max_epochs=3)))
+    leg1.train(train_path, checkpoint_dir=ck, checkpoint_final_epoch=True,
+               schedule_total_epochs=6)
+    leg2 = JaxTransformerTagger(**JaxTransformerTagger.validate_knobs(
+        dict(knobs, max_epochs=6)))
+    leg2.train(train_path, checkpoint_dir=ck, checkpoint_final_epoch=True,
+               schedule_total_epochs=6)
+
+    ref = JaxTransformerTagger(**JaxTransformerTagger.validate_knobs(
+        dict(knobs, max_epochs=6)))
+    ref.train(train_path, schedule_total_epochs=6)
+
+    resumed = jax.tree.leaves(leg2.dump_parameters())
+    wanted = jax.tree.leaves(ref.dump_parameters())
+    assert len(resumed) == len(wanted)
+    for a, b in zip(resumed, wanted):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for m in (leg1, leg2, ref):
+        m.destroy()
+
+@pytest.mark.slow
+def test_pipeline_parallel_composes_with_expert_parallel(
+        synth_corpus_data):
+    """pp=2 x ep=2 on one mesh (VERDICT r3 item 3): Switch-MoE blocks
+    pipelined over pp with each stage's expert stack sharded over ep.
+    Expert leaves are STORED P("pp", "ep", ...) — 1/4 per chip — and
+    training quality matches the unpipelined MoE model."""
+    train_path, val_path = synth_corpus_data
+    knobs = dict(KNOBS, n_layers=2, pipeline_parallel=2, moe_experts=4,
+                 expert_parallel=2, dropout=0.0)
+    model = JaxTransformerTagger(**knobs)
+    assert model.mesh.shape["pp"] == 2
+    assert model.mesh.shape["ep"] == 2
+    model.train(train_path)
+    score = model.evaluate(val_path)
+
+    # Storage: stage-stacked expert leaves shard over pp AND ep.
+    from rafiki_tpu.parallel import shard_variables
+
+    placed = shard_variables(
+        model._pp_split(model._variables["params"]), model.mesh)
+    expert_leaves = [
+        (path, leaf) for path, leaf in
+        jax.tree_util.tree_flatten_with_path(placed["stages"])[0]
+        if "expert" in "/".join(str(getattr(p, "key", p))
+                                for p in path).lower()]
+    assert expert_leaves
+    for _, leaf in expert_leaves:
+        shard = leaf.addressable_shards[0].data
+        assert shard.nbytes * 4 == leaf.nbytes, \
+            f"expert leaf not pp x ep sharded: {shard.shape} of {leaf.shape}"
+
+    # Quality: same recipe unpipelined (ep-only GSPMD path).
+    base = JaxTransformerTagger(**dict(KNOBS, n_layers=2, moe_experts=4,
+                                       expert_parallel=2, dropout=0.0))
+    base.train(train_path)
+    assert abs(score - base.evaluate(val_path)) < 0.07, \
+        (score, base.evaluate(val_path))
     model.destroy()
     base.destroy()

@@ -288,3 +288,43 @@ def test_dashboard_upload_and_log_elements(http_platform):
                "nm-src-file",                 # model .py file upload
                "services", "svclog"):         # per-service log view
         assert f'id="{el}"' in text, f"missing dashboard element #{el}"
+
+
+def test_oversized_upload_rejected_413(http_platform):
+    """Review finding r4: request bodies are buffered in memory, so an
+    oversized (or forged-huge Content-Length) upload must be rejected
+    with 413 BEFORE any body byte is read — one multi-GB POST must not
+    be able to OOM the admin process that supervises every service."""
+    base = f"http://127.0.0.1:{http_platform.app.port}"
+    # A forged Content-Length far over the cap: the server must answer
+    # 413 without waiting for (or reading) the body.
+    conn = socket.create_connection(("127.0.0.1",
+                                     http_platform.app.port), timeout=10)
+    try:
+        conn.sendall((
+            "POST /datasets?name=x&task=T HTTP/1.1\r\n"
+            f"Host: 127.0.0.1\r\n"
+            "Content-Type: application/octet-stream\r\n"
+            "Content-Length: 99999999999\r\n\r\n").encode())
+        reply = conn.recv(4096).decode()
+    finally:
+        conn.close()
+    assert " 413 " in reply.splitlines()[0]
+    # Within the cap still works (the normal-path guard is not overeager).
+    r = requests.post(base + "/datasets?name=x&task=T", data=b"zz",
+                      timeout=10,
+                      headers={"Content-Type": "application/octet-stream"})
+    assert r.status_code == 401  # small body reaches auth as before
+
+
+def test_legacy_content_type_json_still_parses(http_platform):
+    """Review finding r4: curl -d sends JSON bodies under
+    x-www-form-urlencoded; the Content-Type gate for uploads must not
+    break those legacy clients."""
+    base = f"http://127.0.0.1:{http_platform.app.port}"
+    r = requests.post(
+        base + "/tokens",
+        data='{"email": "superadmin@rafiki", "password": "rafiki"}',
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        timeout=10)
+    assert r.status_code == 200 and "token" in r.json()
