@@ -128,7 +128,7 @@ class _UtilProbe:
 def main() -> dict:
     import tempfile
 
-    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.advisor import PrefetchAdvisor, make_advisor
     from rafiki_tpu.datasets import make_synthetic_image_dataset
     from rafiki_tpu.models.feedforward import JaxFeedForward
 
@@ -137,20 +137,25 @@ def main() -> dict:
             tmp, n_train=N_TRAIN, n_val=N_VAL, image_shape=IMAGE_SHAPE,
             n_classes=N_CLASSES)
 
-        advisor = make_advisor(JaxFeedForward.get_knob_config(), seed=0)
+        # PrefetchAdvisor pipelines the GP refit (grows to O(seconds)
+        # of host time with trial history) behind the device compute —
+        # SURVEY §7's async proposal queue. The context manager flushes
+        # the dangling prefetch even when a trial errors out.
+        with PrefetchAdvisor(make_advisor(
+                JaxFeedForward.get_knob_config(), seed=0)) as advisor:
+            # Warm-up trial (outside the timed window): first XLA
+            # compile is ~20-40s and would otherwise dominate the
+            # measurement.
+            _run_trial(JaxFeedForward, advisor, train_path, val_path)
 
-        # Warm-up trial (outside the timed window): first XLA compile is
-        # ~20-40s and would otherwise dominate the measurement.
-        _run_trial(JaxFeedForward, advisor, train_path, val_path)
-
-        elapsed = float("inf")
-        with _UtilProbe() as probe:
-            for _ in range(2):  # best of two windows (module docstring)
-                t0 = time.time()
-                for _ in range(N_TRIALS):
-                    _run_trial(JaxFeedForward, advisor, train_path,
-                               val_path)
-                elapsed = min(elapsed, time.time() - t0)
+            elapsed = float("inf")
+            with _UtilProbe() as probe:
+                for _ in range(2):  # best of two windows (docstring)
+                    t0 = time.time()
+                    for _ in range(N_TRIALS):
+                        _run_trial(JaxFeedForward, advisor, train_path,
+                                   val_path)
+                    elapsed = min(elapsed, time.time() - t0)
 
     trials_per_hour = N_TRIALS / (elapsed / 3600.0)
     return _emit("automl_trials_per_hour", trials_per_hour,
@@ -627,6 +632,12 @@ def _main_cli() -> None:
         from rafiki_tpu.jaxenv import ensure_platform
 
         platform = ensure_platform()
+        # ensure_platform names the PLUGIN ("axon"); records name the
+        # backend jax actually reports ("tpu"). Use the backend name
+        # throughout so error records match success records.
+        import jax
+
+        platform = jax.default_backend()
     except Exception:
         platform = "unknown"
 

@@ -27,10 +27,12 @@ from .pbt import PbtAdvisor
 from .base import BaseAdvisor, Proposal
 from .bayes import BayesOptAdvisor
 from .enas import EnasAdvisor
+from .prefetch import PrefetchAdvisor
 from .random_advisor import RandomAdvisor
 from .registry import make_advisor
 
 __all__ = [
     "BaseAdvisor", "Proposal", "RandomAdvisor", "BayesOptAdvisor",
-    "EnasAdvisor", "AshaAdvisor", "PbtAdvisor", "make_advisor",
+    "EnasAdvisor", "AshaAdvisor", "PbtAdvisor", "PrefetchAdvisor",
+    "make_advisor",
 ]

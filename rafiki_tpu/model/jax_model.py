@@ -335,7 +335,7 @@ class JaxModel(BaseModel):
         # checkpoint-resume continues it, so the rung sequence is
         # step-for-step an uninterrupted full-budget run (ASHA warm
         # starts; see advisor/asha.py).
-        from .loop_ckpt import schedule_epochs
+        from .loop_ckpt import epoch_rng, schedule_epochs
 
         sched_epochs = schedule_epochs(kwargs, max_epochs)
 
@@ -514,9 +514,8 @@ class JaxModel(BaseModel):
         last_epoch = None
         step = start_epoch * steps_per_epoch
         for epoch in range(start_epoch, max_epochs):
-            ep_rng = np.random.default_rng(
-                (int(self.knobs.get("seed", 0)) + 1) * 100003 + epoch)
-            order = ep_rng.permutation(ds.size)
+            order = epoch_rng(int(self.knobs.get("seed", 0)),
+                              epoch).permutation(ds.size)
             need = steps_per_epoch * batch_size
             if need > ds.size:
                 # Tiny dataset: wrap so every epoch still takes real
@@ -616,8 +615,15 @@ class JaxModel(BaseModel):
     def _restore_ckpt(self, mgr, state):
         """Returns (state, start_epoch, best_loss, bad_epochs); falls back
         to a fresh start when the snapshot's structure doesn't match (e.g.
-        the checkpoint is from a different knob config)."""
-        saved_epoch, arrays = mgr.restore()
+        the checkpoint is from a different knob config) or the dir was
+        swept between latest_step() and the read (a sibling worker's
+        end-of-job scoped cleanup)."""
+        try:
+            saved_epoch, arrays = mgr.restore()
+        except OSError:
+            _log.warning("checkpoint in %s vanished mid-restore; "
+                         "starting fresh", mgr.ckpt_dir)
+            return state, 0, float("inf"), 0
         leaves, treedef = jax.tree.flatten(state)
         n_saved = sum(1 for k in arrays if k.startswith("leaf_"))
         if n_saved != len(leaves):

@@ -64,7 +64,16 @@ class LoopCheckpointer:
         """Returns ``(state, start_epoch)``; fresh start on mismatch."""
         if self._mgr is None or self._mgr.latest_step() is None:
             return state, 0
-        saved_epoch, arrays = self._mgr.restore()
+        try:
+            saved_epoch, arrays = self._mgr.restore()
+        except OSError:
+            # The scoped dir can be swept between latest_step() and the
+            # file read (a sibling worker's end-of-job cleanup); losing
+            # the snapshot means cold-start — the documented fallback —
+            # never an errored trial.
+            _log.warning("checkpoint in %s vanished mid-restore; "
+                         "starting fresh", self._dir)
+            return state, 0
         leaves, treedef = jax.tree.flatten(state)
         n_saved = sum(1 for k in arrays if k.startswith("leaf_"))
         if n_saved != len(leaves):

@@ -71,21 +71,24 @@ def _compile(path: str) -> re.Pattern:
 class JsonHttpServer:
     """A route-table HTTP server. ``port=0`` picks a free port."""
 
-    # Request bodies are buffered in memory before dispatch (dataset
-    # uploads included), and the admin process also supervises every
-    # service — one unbounded upload (or a forged huge Content-Length)
-    # must not be able to OOM it. Oversized requests get 413 before a
-    # single body byte is read. Override via RAFIKI_TPU_MAX_UPLOAD_MB.
-    import os as _os
-    MAX_BODY = int(_os.environ.get("RAFIKI_TPU_MAX_UPLOAD_MB", "256")) \
-        * 1024 * 1024
-
     def __init__(self, routes: List[Tuple[str, str, Handler]],
                  host: str = "0.0.0.0", port: int = 0,
                  name: str = "http", max_body: Optional[int] = None):
+        import os
+
         self._routes = [(method.upper(), _compile(path), handler)
                         for method, path, handler in routes]
-        self.max_body = max_body if max_body is not None else self.MAX_BODY
+        # Request bodies are buffered in memory before dispatch (dataset
+        # uploads included), and the admin process also supervises every
+        # service — one unbounded upload (or a forged huge
+        # Content-Length) must not be able to OOM it. Oversized requests
+        # get 413 before a single body byte is read. The env override
+        # (RAFIKI_TPU_MAX_UPLOAD_MB) is read per server construction so
+        # it works however late it is set.
+        if max_body is None:
+            max_body = int(os.environ.get("RAFIKI_TPU_MAX_UPLOAD_MB",
+                                          "256")) * 1024 * 1024
+        self.max_body = max_body
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -111,28 +114,31 @@ class JsonHttpServer:
                 if length:
                     raw = self.rfile.read(length)
                     ctype = (self.headers.get("Content-Type") or "").lower()
-                    if any(t in ctype for t in ("octet-stream", "zip",
-                                                "multipart")):
-                        # A declared binary payload (file upload) passes
-                        # through verbatim for the handler — never
-                        # JSON-sniffed (a CSV/zip that happens to parse
-                        # as JSON must still reach the upload handler
-                        # as bytes).
-                        raw_body = raw
-                    else:
-                        # Everything else is expected to be JSON. The
-                        # parse attempt is independent of the declared
-                        # type: legacy clients (curl -d) send JSON
-                        # bodies under x-www-form-urlencoded, and
-                        # failing those with 400/500 would break them.
+                    if "json" in ctype or not ctype:
+                        # JSON (or legacy clients that send no type):
+                        # the body must parse.
                         try:
                             body = json.loads(raw)
                         except json.JSONDecodeError:
-                            if "json" in ctype or not ctype:
-                                self._reply(400,
-                                            {"error": "invalid JSON body"})
-                                return
-                            raw_body = raw  # genuinely non-JSON text
+                            self._reply(400, {"error": "invalid JSON body"})
+                            return
+                    elif "x-www-form-urlencoded" in ctype:
+                        # curl -d's default type. Such clients (and only
+                        # such) routinely send JSON bodies under it, so
+                        # sniff: parse as JSON when possible, fall back
+                        # to raw bytes.
+                        try:
+                            body = json.loads(raw)
+                        except json.JSONDecodeError:
+                            raw_body = raw
+                    else:
+                        # Any other declared type (octet-stream, zip,
+                        # text/csv from a browser File upload, ...)
+                        # passes through verbatim for the handler —
+                        # never JSON-sniffed: a CSV that happens to
+                        # parse as JSON must still reach the upload
+                        # handler as bytes.
+                        raw_body = raw
                 ctx = RequestContext(self.headers, parse_qs(parsed.query),
                                      raw_body=raw_body)
                 for m, pattern, handler in outer._routes:

@@ -114,3 +114,46 @@ def test_make_advisor_selection():
     assert isinstance(make_advisor(CONFIG, advisor_type="random"), RandomAdvisor)
     with pytest.raises(ValueError):
         make_advisor(CONFIG, advisor_type="nope")
+
+
+def test_prefetch_advisor_pipelines_and_balances():
+    """PrefetchAdvisor (SURVEY §7 async proposal queue): proposal N+1
+    computes while trial N runs; delegation is transparent; close()
+    forgets the dangling prefetched proposal so budget slots balance."""
+    import threading
+    import time as _time
+
+    from rafiki_tpu.advisor import PrefetchAdvisor
+    from rafiki_tpu.advisor.base import BaseAdvisor
+    from rafiki_tpu.model.knobs import IntegerKnob
+
+    calls = {"propose": 0, "forgotten": []}
+
+    class SlowAdvisor(BaseAdvisor):
+        def _propose_knobs(self, trial_no):
+            calls["propose"] += 1
+            _time.sleep(0.15)
+            return {"width": 8 + trial_no}
+
+        def _forget(self, proposal):
+            calls["forgotten"].append(proposal.trial_no)
+
+    adv = PrefetchAdvisor(SlowAdvisor({"width": IntegerKnob(8, 64)},
+                                      seed=0, total_trials=4))
+    p1 = adv.propose()        # sync (nothing prefetched yet)
+    t0 = _time.time()
+    _time.sleep(0.2)          # "training" — prefetch runs during this
+    p2 = adv.propose()
+    waited = _time.time() - t0 - 0.2
+    assert waited < 0.12, waited  # p2 was ready, not computed inline
+    assert p2.trial_no == p1.trial_no + 1
+    adv.feedback(p1, 0.5)
+    adv.feedback(p2, 0.6)
+    # best() delegates through to the wrapped advisor.
+    knobs, score = adv.best()
+    assert score == 0.6
+    adv.close()
+    # close() forgot exactly the one prefetched-but-unused proposal.
+    assert len(calls["forgotten"]) == 1
+    with pytest.raises(RuntimeError):
+        adv.propose()
