@@ -631,10 +631,10 @@ def _main_cli() -> None:
     try:
         from rafiki_tpu.jaxenv import ensure_platform
 
-        platform = ensure_platform()
-        # ensure_platform names the PLUGIN ("axon"); records name the
-        # backend jax actually reports ("tpu"). Use the backend name
-        # throughout so error records match success records.
+        # ensure_platform runs for its probe/config side effect; the
+        # records name the backend jax actually reports ("tpu", not the
+        # plugin name "axon") so error records match success records.
+        ensure_platform()
         import jax
 
         platform = jax.default_backend()

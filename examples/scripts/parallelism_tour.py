@@ -13,6 +13,8 @@ strategy:
 - ep:          Switch-MoE FFN, expert stack sharded; XLA derives the
                dispatch/combine all-to-alls from parameter shardings.
 - pp:          GPipe microbatch pipeline over the encoder blocks.
+- pp x sp:     ring attention inside the pipelined stages.
+- pp x ep:     MoE stages with each stage's expert slice over ep.
 
 Run on the 8-device virtual CPU mesh (no TPU needed):
 
@@ -51,6 +53,14 @@ def main() -> None:
             ("ep moe", dict(moe_experts=4, expert_parallel=2)),
             ("pp gpipe", dict(pipeline_parallel=2)),
         ]
+        if n % 4 == 0:
+            # Composed modes need 4 mesh cells beyond dp.
+            modes += [
+                ("pp x sp", dict(pipeline_parallel=2,
+                                 sequence_parallel=2)),
+                ("pp x ep", dict(pipeline_parallel=2, moe_experts=4,
+                                 expert_parallel=2)),
+            ]
         for name, extra in modes:
             model = JaxTransformerTagger(**base, **extra)
             shape = dict(model.mesh.shape)

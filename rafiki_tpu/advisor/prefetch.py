@@ -24,11 +24,14 @@ budget slots) stay balanced.
 
 from __future__ import annotations
 
+import logging
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Optional
 
 from .base import Proposal
+
+_log = logging.getLogger(__name__)
 
 
 class PrefetchAdvisor:
@@ -66,17 +69,27 @@ class PrefetchAdvisor:
             forget(proposal)
 
     def close(self) -> None:
-        """Flush the dangling prefetch (refunding its budget slot)."""
+        """Flush the dangling prefetch (refunding its budget slot).
+
+        A background ``propose`` error is logged and dropped — the
+        proposal was never handed out, and close() often runs during
+        exception unwind (``__exit__``), where re-raising would mask
+        the primary error. The pool shuts down regardless."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             future, self._future = self._future, None
-        if future is not None:
-            leftover = future.result()
-            if leftover is not None:
-                self.forget(leftover)
-        self._pool.shutdown(wait=True)
+        try:
+            if future is not None:
+                leftover = future.result()
+                if leftover is not None:
+                    self.forget(leftover)
+        except Exception:
+            _log.warning("prefetched proposal failed during close; "
+                         "dropping it", exc_info=True)
+        finally:
+            self._pool.shutdown(wait=True)
 
     def __enter__(self) -> "PrefetchAdvisor":
         return self

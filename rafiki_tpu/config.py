@@ -50,7 +50,12 @@ class NodeConfig:
     process_id: Optional[int] = None
 
     # --- Service tunables (inherited by workers) ---
-    serving_pipeline: bool = True          # one-burst-in-flight overlap
+    # One-burst-in-flight serving overlap. None = "auto": each
+    # inference worker measures its device->host sync latency at
+    # startup and pipelines only when there is latency worth hiding
+    # (a tunneled chip's 100ms+ flush window) — on a directly attached
+    # chip the handoff would COST a few percent for nothing to hide.
+    serving_pipeline: Optional[bool] = None
     checkpoint_trials: bool = False        # mid-trial epoch snapshots
     trace_dir: str = ""                    # per-trial profiler traces
     probe_timeout: float = 60.0            # accelerator liveness probe
@@ -62,7 +67,8 @@ class NodeConfig:
         "trace_dir": "RAFIKI_TPU_TRACE_DIR",
         "probe_timeout": "RAFIKI_TPU_PROBE_TIMEOUT",
     }
-    _types_cache = None  # deliberately un-annotated: not a field
+    _types_cache = None  # deliberately un-annotated: not fields
+    _tristate_cache = None
 
     @classmethod
     def env_name(cls, field: str) -> str:
@@ -91,6 +97,15 @@ class NodeConfig:
         target = cls._field_types().get(name, str)
         try:
             if target is bool:
+                if raw.strip().lower() == "auto":
+                    # Only tri-state (Optional[bool]) fields accept
+                    # "auto"; on a plain bool it would silently become
+                    # a falsy None (RAFIKI_TPU_CKPT=auto used to parse
+                    # truthy) — reject loudly instead.
+                    if name in cls._tristate_bools():
+                        return None
+                    raise ValueError("'auto' is only valid for "
+                                     "tri-state fields")
                 return _parse_bool(raw)
             if target is int:
                 return int(raw)
@@ -109,6 +124,7 @@ class NodeConfig:
         being silently substring-matched to the wrong parser."""
         if cls._types_cache is None:
             resolved: Dict[str, type] = {}
+            tristate = set()
             hints = typing.get_type_hints(cls)
             import types as _types
 
@@ -121,9 +137,17 @@ class NodeConfig:
                     args = [a for a in typing.get_args(hint)
                             if a is not type(None)]
                     hint = args[0] if len(args) == 1 else str
+                    if hint is bool:
+                        tristate.add(f.name)  # Optional[bool] = auto-able
                 resolved[f.name] = hint if isinstance(hint, type) else str
             cls._types_cache = resolved
+            cls._tristate_cache = tristate
         return cls._types_cache
+
+    @classmethod
+    def _tristate_bools(cls) -> set:
+        cls._field_types()
+        return cls._tristate_cache
 
     def validate(self) -> "NodeConfig":
         if not (0 <= self.port <= 65535):
@@ -151,7 +175,8 @@ class NodeConfig:
         """Export the service tunables so in-process workers and spawned
         children resolve the same values this node validated."""
         os.environ[self.env_name("serving_pipeline")] = \
-            "1" if self.serving_pipeline else "0"
+            "auto" if self.serving_pipeline is None \
+            else ("1" if self.serving_pipeline else "0")
         if self.checkpoint_trials:
             os.environ[self.env_name("checkpoint_trials")] = "1"
         else:

@@ -63,6 +63,10 @@ class JaxPosTagger(BaseModel):
             "max_epochs": IntegerKnob(3, 20),
             "max_len": FixedKnob(64),
             "vocab_size": FixedKnob(16384),
+            # Deployment knob: pins init + per-epoch data order (and
+            # therefore checkpoint-resume step identity) for
+            # reproducibility tests and re-runs.
+            "seed": FixedKnob(0),
         }
 
     def __init__(self, **knobs: Any):

@@ -56,6 +56,10 @@ class _JaxTabBase(BaseModel):
             "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
             "batch_size": CategoricalKnob([32, 64, 128]),
             "max_epochs": IntegerKnob(5, 40),
+            # Deployment knob: pins init + per-epoch data order (and
+            # therefore checkpoint-resume step identity) for
+            # reproducibility tests and re-runs.
+            "seed": FixedKnob(0),
         }
 
     def __init__(self, **knobs: Any):

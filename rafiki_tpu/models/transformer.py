@@ -192,6 +192,10 @@ class JaxTransformerTagger(BaseModel):
             "pipeline_parallel": FixedKnob(1),
             # Microbatches per pipeline step; 0 = auto (~4·pp).
             "pp_microbatches": FixedKnob(0),
+            # Deployment knob: pins init, dropout streams, and
+            # per-epoch data order (and therefore checkpoint-resume
+            # step identity) for reproducibility tests and re-runs.
+            "seed": FixedKnob(0),
         }
 
     def __init__(self, **knobs: Any):

@@ -24,6 +24,39 @@ from ..utils.model_loader import load_model_class
 _log = logging.getLogger(__name__)
 
 
+def _sync_probe_fn():
+    """One process-wide jitted probe (a fresh lambda per call would
+    re-compile inside every worker's startup)."""
+    global _SYNC_PROBE
+    if _SYNC_PROBE is None:
+        import jax
+
+        _SYNC_PROBE = jax.jit(lambda a: (a + 1.0).sum())
+    return _SYNC_PROBE
+
+
+_SYNC_PROBE = None
+
+
+def _sync_latency(n: int = 3) -> float:
+    """Best-of-n device->host round-trip time for a tiny dispatch —
+    the constant the one-burst-in-flight overlap can hide."""
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = _sync_probe_fn()
+    x = jnp.zeros((8, 8), jnp.float32)
+    np.asarray(f(x))  # compile outside the timed window
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 class _PackedEnsemble:
     """Several trial models sharing one chip group, served as one unit.
 
@@ -110,15 +143,25 @@ class InferenceWorker:
         self.batch_timeout = batch_timeout
         self.max_batch = max_batch
         # One-burst-in-flight pipelining (overlap burst N's readback
-        # with burst N+1's device compute). Env-togglable so the bench
-        # can measure the win: RAFIKI_TPU_SERVING_PIPELINE=0 disables.
-        # Same falsy spellings as NodeConfig ("0"/"false"/"no"/"off").
+        # with burst N+1's device compute). Tri-state: True / False
+        # force it; None ("auto", the default) measures the device->
+        # host sync latency at startup and pipelines only when there is
+        # latency worth hiding — the tunneled chip's 100ms+ flush
+        # window is the win case; on a directly attached chip the
+        # handoff costs a few percent for nothing.
+        # RAFIKI_TPU_SERVING_PIPELINE=1/0/auto; falsy spellings as
+        # NodeConfig ("0"/"false"/"no"/"off").
         if pipeline is None:
             from ..config import _parse_bool
 
-            pipeline = _parse_bool(os.environ.get(
-                "RAFIKI_TPU_SERVING_PIPELINE", "1"))
+            raw = os.environ.get("RAFIKI_TPU_SERVING_PIPELINE", "auto")
+            pipeline = (None if raw.strip().lower() == "auto"
+                        else _parse_bool(raw))
         self.pipeline = pipeline
+        # Auto threshold: pipeline when a round-trip sync costs more
+        # than this many seconds (tunnel ~0.1-0.7s, direct chip ~1ms).
+        self.pipeline_sync_min = float(os.environ.get(
+            "RAFIKI_TPU_PIPELINE_SYNC_MIN", "0.02"))
         # The bus registration is a LEASE, not a one-shot: it is
         # re-asserted at this cadence so a broker restart (whose fresh
         # in-memory state forgot every registration) re-learns this
@@ -189,6 +232,13 @@ class InferenceWorker:
             warm = getattr(self._model, "warmup", None)
             if warm is not None:
                 warm()
+            if self.pipeline is None:
+                latency = _sync_latency()
+                self.pipeline = latency >= self.pipeline_sync_min
+                _log.info(
+                    "inference worker %s: sync latency %.1f ms -> "
+                    "pipelining %s", self.service_id, latency * 1e3,
+                    "ON" if self.pipeline else "OFF")
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.RUNNING)
             # The trial bin rides the registration so the Predictor can

@@ -19,6 +19,16 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# BOTH pins are required. The config update covers the already-imported
+# jax (the axon sitecustomize latched JAX_PLATFORMS=axon into jax.config
+# at interpreter startup). The env var covers jaxenv.ensure_platform,
+# which honors an explicit JAX_PLATFORMS=cpu but otherwise PROBES the
+# accelerator — with a live tunnel, a platform test constructing a
+# ChipAllocator before any other backend touch would resolve the one
+# real chip and see a 1-chip "slice" instead of the 8-device CPU mesh
+# (exactly how rounds 1-3 masked this: the dead tunnel degraded the
+# probe to CPU and the tests passed by accident).
+os.environ["JAX_PLATFORMS"] = "cpu"
 assert not jax._src.xla_bridge._backends, \
     "jax backends initialized before conftest could force CPU"
 

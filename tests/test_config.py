@@ -8,7 +8,9 @@ from rafiki_tpu.config import NodeConfig
 def test_defaults_validate():
     cfg = NodeConfig.from_env(env={})
     assert cfg.port == 3000 and cfg.workdir == "./rafiki_workdir"
-    assert cfg.serving_pipeline and not cfg.checkpoint_trials
+    # serving_pipeline defaults to None = auto (workers measure their
+    # sync latency at startup and decide).
+    assert cfg.serving_pipeline is None and not cfg.checkpoint_trials
     assert cfg.n_chips is None and cfg.bus_uri == ""
 
 

@@ -370,19 +370,33 @@ def test_supervisor_thread_sweeps_automatically(tmp_path, synth_image_data):
 
 
 def test_inference_pipeline_env_toggle(monkeypatch):
-    """RAFIKI_TPU_SERVING_PIPELINE=0 disables the one-burst-in-flight
-    overlap (the bench's on-vs-off comparison rides this)."""
+    """RAFIKI_TPU_SERVING_PIPELINE: 0/1 force the one-burst-in-flight
+    overlap off/on (the bench's on-vs-off comparison rides this);
+    the default "auto" defers to a startup sync-latency measurement
+    (pipeline is None until the worker's run() resolves it)."""
     from rafiki_tpu.bus import MemoryBus
     from rafiki_tpu.worker.inference import InferenceWorker
 
     bus = MemoryBus()
     w = InferenceWorker("s", "j", "t", None, None, bus)
-    assert w.pipeline  # default: pipelined
+    assert w.pipeline is None  # default: auto, resolved at startup
     monkeypatch.setenv("RAFIKI_TPU_SERVING_PIPELINE", "0")
-    assert not InferenceWorker("s", "j", "t", None, None, bus).pipeline
+    assert InferenceWorker("s", "j", "t", None, None, bus).pipeline \
+        is False
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_PIPELINE", "1")
+    assert InferenceWorker("s", "j", "t", None, None, bus).pipeline \
+        is True
     # An explicit constructor arg beats the env var.
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_PIPELINE", "0")
     assert InferenceWorker("s", "j", "t", None, None, bus,
-                           pipeline=True).pipeline
+                           pipeline=True).pipeline is True
+    # The auto measurement itself: a tiny dispatch round-trip, finite
+    # and non-negative (on the CPU test backend it is ~microseconds,
+    # which correctly resolves auto to pipelining OFF).
+    from rafiki_tpu.worker.inference import _sync_latency
+
+    lat = _sync_latency()
+    assert 0.0 <= lat < 5.0
 
 
 def test_predictor_round_robins_same_bin_replicas():
