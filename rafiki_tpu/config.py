@@ -28,6 +28,15 @@ def _parse_bool(raw: str) -> bool:
     return raw.strip().lower() not in ("0", "false", "no", "off", "")
 
 
+def parse_tristate_bool(raw: str) -> Optional[bool]:
+    """The ONE spelling of the tri-state env contract ("auto" -> None,
+    falsy spellings -> False, else True) — NodeConfig coercion and
+    direct env readers (InferenceWorker) must resolve identically."""
+    if raw.strip().lower() == "auto":
+        return None
+    return _parse_bool(raw)
+
+
 @dataclass(frozen=True)
 class NodeConfig:
     """Everything a ``python -m rafiki_tpu serve`` node needs.
@@ -97,13 +106,13 @@ class NodeConfig:
         target = cls._field_types().get(name, str)
         try:
             if target is bool:
+                if name in cls._tristate_bools():
+                    return parse_tristate_bool(raw)
                 if raw.strip().lower() == "auto":
                     # Only tri-state (Optional[bool]) fields accept
                     # "auto"; on a plain bool it would silently become
                     # a falsy None (RAFIKI_TPU_CKPT=auto used to parse
                     # truthy) — reject loudly instead.
-                    if name in cls._tristate_bools():
-                        return None
                     raise ValueError("'auto' is only valid for "
                                      "tri-state fields")
                 return _parse_bool(raw)

@@ -103,13 +103,20 @@ def step_cache_key(model: "BaseModel", kind: str, mesh, *parts: Any,
 
 
 def pad_crop_flip_graph(x: Any, rng: Any, pad: int = 4,
-                        min_size: int = 8) -> Any:
+                        min_size: int = 16) -> Any:
     """Reflect-pad random crop + horizontal flip (the CIFAR recipe) as
     XLA ops — augmentation runs ON DEVICE inside the train step, so the
     input pipeline ships uint8 indices instead of augmented float batches
-    over the host link. Images smaller than ``min_size`` pass through."""
+    over the host link.
+
+    Images smaller than ``min_size`` pass through UNAUGMENTED: a ±4
+    crop is half the content of an 8x8 scan, and measured on the UCI
+    digits it drives an otherwise-fine ENAS child from 0.93 to 0.21
+    accuracy — the CIFAR recipe's constants only make sense at CIFAR
+    scales (the 16 floor keeps 28x28 fashion-MNIST and 32x32 CIFAR
+    augmented)."""
     b, h, w, _ = x.shape
-    if h < min_size:
+    if min(h, w) < min_size:
         return x
     r_y, r_x, r_f = jax.random.split(rng, 3)
     padded = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),

@@ -152,11 +152,10 @@ class InferenceWorker:
         # RAFIKI_TPU_SERVING_PIPELINE=1/0/auto; falsy spellings as
         # NodeConfig ("0"/"false"/"no"/"off").
         if pipeline is None:
-            from ..config import _parse_bool
+            from ..config import parse_tristate_bool
 
-            raw = os.environ.get("RAFIKI_TPU_SERVING_PIPELINE", "auto")
-            pipeline = (None if raw.strip().lower() == "auto"
-                        else _parse_bool(raw))
+            pipeline = parse_tristate_bool(os.environ.get(
+                "RAFIKI_TPU_SERVING_PIPELINE", "auto"))
         self.pipeline = pipeline
         # Auto threshold: pipeline when a round-trip sync costs more
         # than this many seconds (tunnel ~0.1-0.7s, direct chip ~1ms).

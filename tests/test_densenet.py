@@ -72,8 +72,12 @@ def test_densenet_batchnorm_updates(synth_image_data):
 
 def test_densenet_augmentation_preserves_shape(rng):
     m = JaxDenseNet(**TINY_KNOBS)
-    imgs = jnp.asarray(rng.random((8, 12, 12, 1)).astype(np.float32))
+    imgs = jnp.asarray(rng.random((8, 16, 16, 1)).astype(np.float32))
     out = m.augment_in_graph(imgs, jax.random.key(0))
     assert out.shape == imgs.shape
     assert out.dtype == imgs.dtype
     assert not np.allclose(np.asarray(out), np.asarray(imgs))
+    # Below the 16-pixel floor the CIFAR crop recipe would destroy the
+    # content (±4 crop on an 8x8 scan) — tiny images pass through.
+    tiny = jnp.asarray(rng.random((8, 12, 12, 1)).astype(np.float32))
+    assert m.augment_in_graph(tiny, jax.random.key(0)) is tiny

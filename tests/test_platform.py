@@ -378,6 +378,9 @@ def test_inference_pipeline_env_toggle(monkeypatch):
     from rafiki_tpu.worker.inference import InferenceWorker
 
     bus = MemoryBus()
+    # The operator env tunable may be exported in the ambient shell;
+    # the default-behavior assertion needs it absent.
+    monkeypatch.delenv("RAFIKI_TPU_SERVING_PIPELINE", raising=False)
     w = InferenceWorker("s", "j", "t", None, None, bus)
     assert w.pipeline is None  # default: auto, resolved at startup
     monkeypatch.setenv("RAFIKI_TPU_SERVING_PIPELINE", "0")
