@@ -114,6 +114,11 @@ class ServicesManager:
         }
         if self.log_dir:
             env[EnvVars.LOG_DIR] = self.log_dir
+        # Operator tunables that must reach docker children (which do
+        # NOT inherit this process's environ) ride the service env.
+        if "RAFIKI_TPU_ADVISOR_PREFETCH" in os.environ:
+            env["RAFIKI_TPU_ADVISOR_PREFETCH"] = \
+                os.environ["RAFIKI_TPU_ADVISOR_PREFETCH"]
         return env
 
     def _stop_service(self, service_id: str) -> None:

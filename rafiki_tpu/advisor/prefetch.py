@@ -55,8 +55,16 @@ class PrefetchAdvisor:
         # in propose-call order, THEN kick off the next one — it
         # computes while the caller trains.
         p = self._advisor.propose() if future is None else future.result()
+        if p is None and future is not None:
+            # A buffered None is STALE: it was computed before any
+            # forget() refunds that may have landed since (an errored
+            # trial at the budget boundary re-proposes through exactly
+            # this path) — ask again live so the refund is honored.
+            p = self._advisor.propose()
         with self._lock:
-            if not self._closed and self._future is None:
+            # No further prefetch once the search reports exhausted:
+            # later refunds are served by the live re-ask above.
+            if not self._closed and self._future is None and p is not None:
                 self._future = self._pool.submit(self._advisor.propose)
         return p
 

@@ -53,6 +53,12 @@ class AdvisorWorker:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # A PrefetchAdvisor wrapper holds one precomputed proposal and
+        # a worker thread; flush both (refunds the dangling budget
+        # slot). Plain advisors have no close and skip this.
+        close = getattr(self.advisor, "close", None)
+        if close is not None:
+            close()
 
     def run(self) -> None:
         from ..utils.service_logs import bind_service_log

@@ -63,7 +63,8 @@ def _build(service_type: str, service_id: str, env: Dict[str, str],
                            ctx.meta, ctx.params, ctx.bus, chips=chips)
     if service_type == ServiceType.ADVISOR:
         return _build_advisor_service(service_id,
-                                      env[EnvVars.SUB_TRAIN_JOB_ID], ctx)
+                                      env[EnvVars.SUB_TRAIN_JOB_ID], ctx,
+                                      env)
     if service_type == ServiceType.INFERENCE:
         from ..worker.inference import InferenceWorker
 
@@ -80,7 +81,8 @@ def _build(service_type: str, service_id: str, env: Dict[str, str],
 
 
 def _build_advisor_service(service_id: str, sub_id: str,
-                           ctx: SystemContext) -> Any:
+                           ctx: SystemContext,
+                           env: Optional[Dict[str, str]] = None) -> Any:
     """AdvisorWorker wired to the sub-train-job's model + budget."""
     from ..advisor import make_advisor
     from ..advisor.worker import AdvisorWorker
@@ -96,6 +98,24 @@ def _build_advisor_service(service_id: str, sub_id: str,
     advisor = make_advisor(model_class.get_knob_config(),
                            advisor_type=sub.get("advisor_type"),
                            total_trials=total)
+    import os
+
+    from ..config import _parse_bool
+
+    # The SERVICE env dict is the contract every tunable here rides
+    # (docker children never inherit the admin's os.environ); the
+    # process env is the fallback for direct construction.
+    raw = (env or {}).get("RAFIKI_TPU_ADVISOR_PREFETCH") \
+        or os.environ.get("RAFIKI_TPU_ADVISOR_PREFETCH", "1")
+    if _parse_bool(raw):
+        # The bus-hosted advisor serves MANY workers, whose proposals
+        # already race feedback — prefetching the next proposal (so a
+        # GP refit never blocks a requesting TrainWorker's chip) adds
+        # no staleness that fan-out hasn't already introduced.
+        # RAFIKI_TPU_ADVISOR_PREFETCH=0 opts out.
+        from ..advisor import PrefetchAdvisor
+
+        advisor = PrefetchAdvisor(advisor)
     worker = AdvisorWorker(advisor, ctx.bus, sub_id)
     worker.service_id = service_id
     return worker

@@ -157,3 +157,28 @@ def test_prefetch_advisor_pipelines_and_balances():
     assert len(calls["forgotten"]) == 1
     with pytest.raises(RuntimeError):
         adv.propose()
+
+
+def test_prefetch_refreshes_stale_none_after_refund():
+    """Review finding r4: at the budget boundary the buffer can hold a
+    None computed BEFORE an errored trial's forget() refunded its slot;
+    propose() must re-ask live so the refund is honored and the search
+    does not end one trial short."""
+    from rafiki_tpu.advisor import PrefetchAdvisor, RandomAdvisor
+    from rafiki_tpu.model.knobs import IntegerKnob
+
+    adv = PrefetchAdvisor(RandomAdvisor({"width": IntegerKnob(8, 64)},
+                                        seed=0, total_trials=2))
+    p1 = adv.propose()
+    p2 = adv.propose()          # buffer now prefetches proposal #3: None
+    assert p1 is not None and p2 is not None
+    import time
+
+    time.sleep(0.1)             # let the None land in the buffer
+    adv.forget(p2)              # errored trial refunds its slot
+    p3 = adv.propose()          # must NOT serve the stale buffered None
+    assert p3 is not None, "stale buffered None ended the search early"
+    adv.feedback(p1, 0.5)
+    adv.feedback(p3, 0.6)
+    assert adv.propose() is None  # budget genuinely spent now
+    adv.close()

@@ -2,6 +2,8 @@
 
 import threading
 
+import pytest
+
 from rafiki_tpu.advisor import make_advisor
 from rafiki_tpu.advisor.worker import AdvisorWorker, RemoteAdvisor
 from rafiki_tpu.bus import MemoryBus
@@ -78,3 +80,37 @@ def test_remote_error_propagates():
             remote.propose()
     finally:
         worker.stop()
+
+
+def test_bus_advisor_with_prefetch_wrapper():
+    """The bus-hosted advisor composes with PrefetchAdvisor (the
+    platform wires it by default): proposals arrive in propose-call
+    order, feedback flows through, and stop() flushes the dangling
+    prefetched proposal so its budget slot is refunded."""
+    from rafiki_tpu.advisor import PrefetchAdvisor, RandomAdvisor
+
+    bus = MemoryBus()
+    inner = RandomAdvisor({"width": IntegerKnob(8, 64)}, seed=0,
+                          total_trials=10)
+    worker = AdvisorWorker(PrefetchAdvisor(inner), bus, "sub-pf").start()
+    try:
+        remote = RemoteAdvisor(bus, "sub-pf", timeout=10)
+        p1 = remote.propose()
+        p2 = remote.propose()
+        assert p2.trial_no == p1.trial_no + 1
+        remote.feedback(p1, 0.5)
+        remote.feedback(p2, 0.7)
+        import time
+
+        deadline = time.time() + 5
+        while inner.best() is None and time.time() < deadline:
+            time.sleep(0.05)  # feedback ops are fire-and-forget pushes
+        best = inner.best()
+        assert best is not None, "feedback never reached the advisor"
+        assert best[1] == 0.7
+    finally:
+        worker.stop()
+    # stop() closed the wrapper: a dangling prefetched proposal was
+    # forgotten, so the advisor's pending-state stays balanced.
+    with pytest.raises(RuntimeError):
+        worker.advisor.propose()
