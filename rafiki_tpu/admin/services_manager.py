@@ -136,6 +136,17 @@ class ServicesManager:
     def _release_chips_of(self, svc: Dict[str, Any]) -> None:
         self.allocator.release(self._alloc_name(svc["id"]))
 
+    def _sharing_ok(self) -> bool:
+        """Whether time-sliced chip co-ownership is safe here: only in
+        resident-runner (thread) mode, where every worker shares one
+        process and one jax backend. Sharing is a LIVENESS fallback —
+        used for a job's FIRST worker when exclusive placement fails,
+        so a full single-chip box still admits a second tenant
+        (BASELINE config[5]) — never for extra capacity.
+        RAFIKI_TPU_CHIP_SHARE=0 turns it off."""
+        return getattr(self.container, "supports_chip_sharing", False) \
+            and os.environ.get("RAFIKI_TPU_CHIP_SHARE", "1") != "0"
+
     # --- Train services (§3.1) ---
 
     def create_train_services(self, train_job_id: str) -> List[Dict[str, Any]]:
@@ -153,7 +164,14 @@ class ServicesManager:
             services.append(advisor_svc)
             launched = 0
             for _ in range(n_workers):
-                svc = self.add_train_worker(sub["id"], chips_per_trial)
+                # Sharing applies to the FIRST worker only: it keeps a
+                # new job live on a full slice (time-sliced with the
+                # incumbents); workers beyond the first are capacity,
+                # and stacking capacity onto co-owned chips would just
+                # thrash the device queue.
+                svc = self.add_train_worker(
+                    sub["id"], chips_per_trial,
+                    shared_ok=(launched == 0 and self._sharing_ok()))
                 if svc is None:
                     # Slice is full: run with what we got (≥1); trials
                     # queue behind fewer workers rather than failing.
@@ -170,6 +188,7 @@ class ServicesManager:
         return services
 
     def add_train_worker(self, sub_id: str, chips_per_trial: int = 1,
+                         shared_ok: bool = False,
                          ) -> Optional[Dict[str, Any]]:
         """Attach one train worker for ``sub_id`` on THIS node's chips.
 
@@ -178,13 +197,15 @@ class ServicesManager:
         ``join`` CLI) to add elastic capacity to a running job — its
         worker pulls proposals from the same bus-hosted advisor, so the
         search stays coordinated across nodes. Returns None when this
-        node's chips are exhausted.
+        node's chips are exhausted (``shared_ok`` admits the time-sliced
+        fallback — see ``_sharing_ok``).
         """
         svc_row = self.meta.create_service(ServiceType.TRAIN,
                                            ServiceStatus.DEPLOYING,
                                            node_id=self.node_id)
         group = self.allocator.allocate(chips_per_trial,
-                                        name=self._alloc_name(svc_row["id"]))
+                                        name=self._alloc_name(svc_row["id"]),
+                                        shared_ok=shared_ok)
         if group is None:
             self.meta.update_service(svc_row["id"],
                                      status=ServiceStatus.STOPPED)
@@ -325,8 +346,15 @@ class ServicesManager:
             svc_row = self.meta.create_service(ServiceType.INFERENCE,
                                                ServiceStatus.DEPLOYING,
                                                node_id=self.node_id)
+            # The FIRST group may be time-sliced (liveness fallback,
+            # mirrors train): a fully-subscribed slice still admits the
+            # job's serving as ONE worker on a co-owned group packing
+            # the whole ensemble. allocate() tries exclusive placement
+            # before sharing, so this changes nothing when chips are
+            # free.
             group = self.allocator.allocate(
-                chips_per_worker, name=self._alloc_name(svc_row["id"]))
+                chips_per_worker, name=self._alloc_name(svc_row["id"]),
+                shared_ok=(not grabbed and self._sharing_ok()))
             if group is None:
                 self.meta.update_service(svc_row["id"],
                                          status=ServiceStatus.STOPPED)
@@ -473,8 +501,13 @@ class ServicesManager:
             if not rows:
                 continue
             sub_id = rows[0]["sub_train_job_id"]
+            # shared_ok mirrors admission: a worker that was admitted
+            # time-sliced (full slice) could otherwise never restart —
+            # exclusive allocation on the still-full slice returns None
+            # and the job would keep an advisor but zero workers.
             new_svc = self.add_train_worker(
-                sub_id, chips_per_trial=len(svc.get("chips") or [1]))
+                sub_id, chips_per_trial=len(svc.get("chips") or [1]),
+                shared_ok=self._sharing_ok())
             if new_svc is not None:
                 restarted.append(new_svc["id"])
                 _log.warning("restarted dead train worker %s as %s",

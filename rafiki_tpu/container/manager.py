@@ -22,6 +22,13 @@ _log = logging.getLogger(__name__)
 
 
 class ContainerManager(abc.ABC):
+    # Whether two services this manager launches may co-own a chip
+    # (time-sliced tenancy). Only resident-runner threads can: they
+    # share one process and one jax backend, so their dispatches
+    # interleave on the device queue. Separate processes (subprocess /
+    # docker modes) cannot both open a TPU chip — sharing stays off.
+    supports_chip_sharing = False
+
     @abc.abstractmethod
     def create_service(self, service_id: str, environ: Dict[str, str]) -> str:
         """Launch a service; returns a runtime container id."""
@@ -43,6 +50,8 @@ class ThreadContainerManager(ContainerManager):
     a single host/slice and the substrate for integration tests
     (SURVEY.md §4: real multi-worker tests on one host, no mocks).
     """
+
+    supports_chip_sharing = True  # threads share one jax backend
 
     def __init__(self, ctx: SystemContext):
         self.ctx = ctx

@@ -141,6 +141,18 @@ class ChipAllocator:
     when the request cannot be satisfied — callers queue and retry
     (scheduler fairness is handled one level up, in the
     ServicesManager).
+
+    **Chip sharing (single-chip multi-tenancy).** ``allocate(...,
+    shared_ok=True)`` adds a fallback tier: when no exclusive placement
+    exists, the group may be placed on already-owned chips — least-
+    subscribed cells first, never exceeding ``max_share`` owners per
+    chip. In resident-runner mode every worker is a thread of ONE
+    process sharing one jax backend, so co-owned chips are legal: the
+    co-owners' dispatches interleave on the device queue (time-sliced
+    tenancy — how a v5e-1 runs two concurrent jobs, BASELINE config[5]).
+    Process/docker workers must NOT share (two processes cannot open
+    one TPU chip); the ServicesManager gates ``shared_ok`` on the
+    container manager's ``supports_chip_sharing``.
     """
 
     def __init__(self, n_chips: Optional[int] = None,
@@ -185,11 +197,19 @@ class ChipAllocator:
         self._topology = ([tuple(c[:3]) + (0,) * (3 - min(len(c), 3))
                            for c in topology] if topology else None)
         self._lock = threading.Lock()
-        self._owner: List[Optional[str]] = [None] * n_chips
+        # Co-ownership: each chip carries a list of owner names (shared
+        # tenancy appends; exclusive placement requires an empty list).
+        self._owners: List[List[str]] = [[] for _ in range(n_chips)]
         self._groups: Dict[str, ChipGroup] = {}
 
-    def allocate(self, n: int, name: str) -> Optional[ChipGroup]:
-        """Allocate ``n`` chips as an ICI-compact group; None if full."""
+    def allocate(self, n: int, name: str, *, shared_ok: bool = False,
+                 max_share: int = 4) -> Optional[ChipGroup]:
+        """Allocate ``n`` chips as an ICI-compact group; None if full.
+
+        ``shared_ok`` adds the time-sliced fallback tier (docstring
+        above): exclusive placement first, then least-subscribed shared
+        placement up to ``max_share`` owners per chip.
+        """
         if n <= 0:
             raise ValueError("n must be positive")
         with self._lock:
@@ -205,22 +225,34 @@ class ChipAllocator:
             # connected free blob, which keeps every collective on
             # group-internal links at the cost of a non-minimal
             # diameter. Only a grid with no connected free region of n
-            # cells returns None -> callers queue/retry.
-            if self._topology is not None:
-                idx = self._find_box(n)
-                if idx is None:
-                    idx = self._find_blob(n)
-            else:
-                idx = self._find_linear(n)
+            # cells returns None -> callers queue/retry. With
+            # ``shared_ok``, ever-more-subscribed cells are admitted one
+            # load tier at a time, so a shared group lands on the
+            # least-loaded chips that fit it.
+            idx = None
+            caps = range(max_share if shared_ok else 1)
+            for cap in caps:
+                allowed = {i for i, o in enumerate(self._owners)
+                           if len(o) <= cap}
+                if len(allowed) < n:
+                    continue
+                if self._topology is not None:
+                    idx = self._find_box(n, allowed)
+                    if idx is None:
+                        idx = self._find_blob(n, allowed)
+                else:
+                    idx = self._find_linear(n, allowed)
+                if idx is not None:
+                    break
             if idx is None:
                 return None
             for j in idx:
-                self._owner[j] = name
+                self._owners[j].append(name)
             group = ChipGroup(indices=idx, name=name)
             self._groups[name] = group
             return group
 
-    def _find_box(self, n: int) -> Optional[tuple]:
+    def _find_box(self, n: int, allowed: set) -> Optional[tuple]:
         """Most cube-like free d×h×w box on the (x, y, z) coord grid.
 
         Returned indices are in BOUSTROPHEDON (snake) order — each row
@@ -234,7 +266,7 @@ class ChipAllocator:
         fit and this is exactly the 2-D rectangle search.
         """
         grid = {c: i for i, c in enumerate(self._topology)}
-        free = {c for c, i in grid.items() if self._owner[i] is None}
+        free = {c for c, i in grid.items() if i in allowed}
         for d, h, w in _box_shapes(n):
             for (x0, y0, z0) in sorted(free, key=lambda c: (c[2], c[1],
                                                             c[0])):
@@ -253,7 +285,7 @@ class ChipAllocator:
                     return tuple(grid[c] for c in cells)
         return None
 
-    def _find_blob(self, n: int) -> Optional[tuple]:
+    def _find_blob(self, n: int, allowed: set) -> Optional[tuple]:
         """Connected free region of n cells (BFS, 6-neighbour).
 
         Fallback when no axis-aligned box fits — whether because the
@@ -263,7 +295,7 @@ class ChipAllocator:
         diameter is not minimal.
         """
         grid = {c: i for i, c in enumerate(self._topology)}
-        free = {c for c, i in grid.items() if self._owner[i] is None}
+        free = {c for c, i in grid.items() if i in allowed}
         for anchor in sorted(free):
             blob, frontier = [anchor], [anchor]
             seen = {anchor}
@@ -283,11 +315,11 @@ class ChipAllocator:
                                                      (c[2], c[1], c[0])))
         return None
 
-    def _find_linear(self, n: int) -> Optional[tuple]:
+    def _find_linear(self, n: int, allowed: set) -> Optional[tuple]:
         """First-fit contiguous index range (no-topology fallback)."""
         run_start, run_len = None, 0
         for i in range(self.n_chips):
-            if self._owner[i] is None:
+            if i in allowed:
                 run_start = i if run_len == 0 else run_start
                 run_len += 1
                 if run_len == n:
@@ -301,13 +333,13 @@ class ChipAllocator:
             group = self._groups.pop(name, None)
             if group:
                 for i in group.indices:
-                    if self._owner[i] == name:
-                        self._owner[i] = None
+                    if name in self._owners[i]:
+                        self._owners[i].remove(name)
 
     @property
     def free_chips(self) -> int:
         with self._lock:
-            return sum(1 for o in self._owner if o is None)
+            return sum(1 for o in self._owners if not o)
 
     def utilization(self) -> float:
         return 1.0 - self.free_chips / self.n_chips

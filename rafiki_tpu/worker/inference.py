@@ -231,8 +231,10 @@ class InferenceWorker:
             warm = getattr(self._model, "warmup", None)
             if warm is not None:
                 warm()
+            sync_ms = None
             if self.pipeline is None:
                 latency = _sync_latency()
+                sync_ms = round(latency * 1e3, 3)
                 self.pipeline = latency >= self.pipeline_sync_min
                 _log.info(
                     "inference worker %s: sync latency %.1f ms -> "
@@ -242,10 +244,18 @@ class InferenceWorker:
                                      status=ServiceStatus.RUNNING)
             # The trial bin rides the registration so the Predictor can
             # treat same-bin workers as REPLICAS (one is chosen per
-            # request) instead of extra ensemble members.
+            # request) instead of extra ensemble members. The pipeline
+            # decision (and the measured sync latency that drove an
+            # "auto" decision) rides along so artifact readers — the
+            # bench record in particular — can tell which serving mode
+            # was actually measured (r4 verdict: the auto decision was
+            # logged but unrecoverable from the bench artifact).
+            self._reg_info = {"trial_id": self.trial_id,
+                              "pipeline": bool(self.pipeline),
+                              "sync_latency_ms": sync_ms}
             self.cache.register_worker(self.inference_job_id,
                                        self.service_id,
-                                       info={"trial_id": self.trial_id})
+                                       info=self._reg_info)
         except Exception:
             _log.exception("inference worker %s failed to start",
                            self.service_id)
@@ -287,7 +297,7 @@ class InferenceWorker:
                             >= self.reregister_interval):
                         self.cache.register_worker(
                             self.inference_job_id, self.service_id,
-                            info={"trial_id": self.trial_id})
+                            info=self._reg_info)
                         last_reg = _time.monotonic()
                     items = self.cache.pop_queries(
                         self.service_id, max_items=self.max_batch,
@@ -323,7 +333,7 @@ class InferenceWorker:
                     try:
                         self.cache.register_worker(
                             self.inference_job_id, self.service_id,
-                            info={"trial_id": self.trial_id})
+                            info=self._reg_info)
                         last_reg = _time.monotonic()
                     except (ConnectionError, OSError, RuntimeError):
                         pass  # broker still down; retry next iteration

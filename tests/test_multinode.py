@@ -255,9 +255,29 @@ def test_jax_distributed_cpu_pair(tmp_path):
 def test_status_reports_cluster_nodes(tmp_path, synth_image_data,
                                       broker):
     """/status carries the per-node cluster view when several nodes
-    share the meta store: each node's service count + heartbeat age."""
+    share the meta store: each node's service count + heartbeat age.
+
+    Trials block on a gate file until the joined node has been observed
+    in /status — without the gate, node_a's workers can spend the whole
+    4-trial budget before node_b's worker ever reaches RUNNING, and the
+    poll below can never succeed (the r4 flake)."""
     train_path, val_path = synth_image_data
     shared = str(tmp_path / "shared")
+    gate = str(tmp_path / "gate")
+    gated_source = (
+        "import os, time\n"
+        "from rafiki_tpu.model import BaseModel, FixedKnob\n"
+        "class GatedFF(BaseModel):\n"
+        "    @staticmethod\n"
+        "    def get_knob_config():\n"
+        "        return {'k': FixedKnob(1)}\n"
+        "    def train(self, p, **kw):\n"
+        f"        while not os.path.exists({gate!r}):\n"
+        "            time.sleep(0.05)\n"
+        "    def evaluate(self, p): return 0.5\n"
+        "    def predict(self, qs): return [0.0 for _ in qs]\n"
+        "    def dump_parameters(self): return {}\n"
+        "    def load_parameters(self, p): pass\n")
     node_a = LocalPlatform(workdir=shared, bus_uri=broker.uri,
                            supervise_interval=0)
     node_b = None
@@ -265,7 +285,8 @@ def test_status_reports_cluster_nodes(tmp_path, synth_image_data,
         dev = node_a.admin.create_user("dev@x.c", "pw",
                                        UserType.MODEL_DEVELOPER)
         model = node_a.admin.create_model(
-            dev["id"], "ff", TaskType.IMAGE_CLASSIFICATION, FF_CLASS)
+            dev["id"], "ff", TaskType.IMAGE_CLASSIFICATION, "GatedFF",
+            model_source=gated_source)
         job = node_a.admin.create_train_job(
             dev["id"], "app", TaskType.IMAGE_CLASSIFICATION,
             [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 4},
@@ -275,7 +296,9 @@ def test_status_reports_cluster_nodes(tmp_path, synth_image_data,
                                stop_jobs_on_shutdown=False,
                                node_id="vm/join-status")
         assert node_b.admin.attach_workers(job["id"])
-        # The joined worker reaches RUNNING asynchronously — poll.
+        # The joined worker reaches RUNNING asynchronously — poll. It
+        # CANNOT exit early: every trial is blocked on the gate file, so
+        # the budget is still open when it starts.
         deadline = time.monotonic() + 120
         status = node_a.admin.get_status()
         while "vm/join-status" not in status["nodes"] \
@@ -288,9 +311,16 @@ def test_status_reports_cluster_nodes(tmp_path, synth_image_data,
         assert joined["services"] >= 1
         assert joined["heartbeat_age_s"] is not None
         assert joined["heartbeat_age_s"] < 60
+        with open(gate, "w"):
+            pass  # open the gate: let all trials complete
         assert node_a.admin.wait_until_train_job_done(job["id"],
                                                       timeout=600)
     finally:
+        # The gate must open even when an assertion above failed, or
+        # every blocked trial thread would spin on os.path.exists for
+        # the rest of the pytest session.
+        with open(gate, "w"):
+            pass
         if node_b is not None:
             node_b.shutdown()
         node_a.shutdown()

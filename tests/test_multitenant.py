@@ -90,9 +90,11 @@ def test_oversubscribed_job_degrades_gracefully(platform, synth_image_data):
     assert platform.allocator.free_chips == platform.allocator.n_chips
 
 
-def test_job_rejected_when_slice_full_no_leak(platform, synth_image_data):
-    """With zero free chips a new job fails fast — and leaks neither
-    chips nor running services."""
+def test_job_rejected_when_slice_full_no_leak(platform, synth_image_data,
+                                              monkeypatch):
+    """With zero free chips AND sharing disabled a new job fails fast —
+    and leaks neither chips nor running services."""
+    monkeypatch.setenv("RAFIKI_TPU_CHIP_SHARE", "0")
     train_path, val_path = synth_image_data
     hold = platform.allocator.allocate(platform.allocator.n_chips,
                                        name="hog")
@@ -111,3 +113,63 @@ def test_job_rejected_when_slice_full_no_leak(platform, synth_image_data):
         [model["id"]], dict(FAST_BUDGET), train_path, val_path)
     assert platform.admin.wait_until_train_job_done(job["id"], timeout=600)
     assert platform.allocator.free_chips == platform.allocator.n_chips
+
+
+def test_full_slice_admits_second_tenant_time_sliced(platform,
+                                                     synth_image_data):
+    """Sharing (the default in resident-runner mode): a job arriving at
+    a fully-subscribed slice is admitted on co-owned chips instead of
+    rejected — single-chip multi-tenancy (BASELINE config[5] on a
+    v5e-1). The shared group is a liveness fallback: one worker,
+    time-sliced against the incumbent."""
+    train_path, val_path = synth_image_data
+    hold = platform.allocator.allocate(platform.allocator.n_chips,
+                                       name="hog")
+    assert hold is not None
+    user, model = _tenant(platform, 0)
+    job = platform.admin.create_train_job(
+        user["id"], "shared", TaskType.IMAGE_CLASSIFICATION,
+        [model["id"]], dict(FAST_BUDGET), train_path, val_path)
+    # No exclusive chips existed, so the worker co-owns: free count is
+    # still zero and some chip carries two owners.
+    assert platform.allocator.free_chips == 0
+    assert any(len(o) >= 2 for o in platform.allocator._owners)
+    assert platform.admin.wait_until_train_job_done(job["id"], timeout=600)
+    detail = platform.admin.get_train_job(job["id"])
+    assert detail["sub_train_jobs"][0]["n_completed"] == \
+        FAST_BUDGET[BudgetOption.MODEL_TRIAL_COUNT]
+    platform.allocator.release("hog")
+    assert platform.allocator.free_chips == platform.allocator.n_chips
+
+
+@pytest.mark.slow
+def test_single_chip_two_tenants_fair_interleave(tmp_path,
+                                                 synth_image_data):
+    """Two tenants on a ONE-chip allocator (the v5e-1 shape): both jobs
+    complete, and their execution windows overlap — trials interleave
+    on the shared chip rather than job B waiting for job A to finish."""
+    train_path, val_path = synth_image_data
+    p = LocalPlatform(workdir=str(tmp_path / "plat1"), n_chips=1)
+    try:
+        jobs = []
+        for i in range(2):
+            user, model = _tenant(p, i)
+            jobs.append(p.admin.create_train_job(
+                user["id"], f"app{i}", TaskType.IMAGE_CLASSIFICATION,
+                [model["id"]], dict(FAST_BUDGET), train_path, val_path))
+        for j in jobs:
+            assert p.admin.wait_until_train_job_done(j["id"], timeout=600)
+        windows = []
+        for j in jobs:
+            trials = p.meta.get_trials_of_train_job(j["id"])
+            assert len(trials) == FAST_BUDGET[
+                BudgetOption.MODEL_TRIAL_COUNT]
+            starts = [t["started_at"] for t in trials]
+            ends = [t["finished_at"] for t in trials]
+            windows.append((min(starts), max(ends)))
+        # Overlap: each job started before the other finished.
+        (a0, a1), (b0, b1) = windows
+        assert a0 < b1 and b0 < a1, \
+            f"jobs serialized: {windows} (no time-slicing)"
+    finally:
+        p.shutdown()

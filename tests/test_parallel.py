@@ -91,8 +91,8 @@ def test_allocator_topology_never_straddles_rows():
     a = ChipAllocator(8, topology=topo)
     # Occupy (0,0)=idx0 and (2,1)=idx6: indices 1..4 stay free and
     # linearly contiguous, but no free 2x2 / 1x4 rectangle exists.
-    a._owner[0] = "x"
-    a._owner[6] = "y"
+    a._owners[0] = ["x"]
+    a._owners[6] = ["y"]
     g = a.allocate(4, "t")
     assert g is not None
     assert set(g.indices) != {1, 2, 3, 4}  # the disconnected run
