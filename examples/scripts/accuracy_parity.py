@@ -117,20 +117,21 @@ def main(fast: bool = False) -> None:
 
             # Sequence taggers on the bundled REAL English corpus
             # (examples/datasets/english_pos; hand-tagged Universal
-            # tagset). ~2.4k train tokens: published token accuracies
-            # for small taggers without pretraining on corpora this
-            # size are ~80-90%; bands hold margin for seed variance.
+            # tagset; 679 sentences / 6,599 tokens after the r5
+            # extension). Bands sit ~2-3 points under the worst of
+            # three measured data-split seeds (BiLSTM 0.913-0.920,
+            # Transformer 0.871-0.889) — they constrain, not decorate.
             ctr, cva = prepare_bundled_pos_corpus(tmp + "/pos")
             for cls, knobs, band in (
                     (JaxPosTagger,
                      {"embed_dim": 64, "hidden": 128,
                       "learning_rate": 1e-2, "batch_size": 32,
-                      "max_epochs": 20}, 0.78),
+                      "max_epochs": 20}, 0.89),
                     (JaxTransformerTagger,
                      {"d_model": 128, "n_heads": 4, "n_layers": 2,
                       "learning_rate": 3e-3, "batch_size": 32,
                       "max_epochs": 30, "max_len": 64, "dropout": 0.1},
-                     0.72)):
+                     0.84)):
                 model = cls(**cls.validate_knobs(knobs))
                 model.train(ctr)
                 acc = float(model.evaluate(cva))

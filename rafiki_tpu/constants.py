@@ -71,6 +71,7 @@ class ServiceStatus:
 class TaskType:
     IMAGE_CLASSIFICATION = "IMAGE_CLASSIFICATION"
     POS_TAGGING = "POS_TAGGING"
+    LANGUAGE_MODELING = "LANGUAGE_MODELING"
     TABULAR_CLASSIFICATION = "TABULAR_CLASSIFICATION"
     TABULAR_REGRESSION = "TABULAR_REGRESSION"
 

@@ -5,10 +5,11 @@ from .real import (prepare_bundled_pos_corpus, prepare_sklearn_digits,
                    prepare_sklearn_tabular)
 from .synth import (make_synthetic_corpus_dataset,
                     make_synthetic_image_dataset,
-                    make_synthetic_tabular_dataset)
+                    make_synthetic_tabular_dataset,
+                    make_synthetic_token_dataset)
 
 __all__ = ["make_synthetic_image_dataset", "make_synthetic_corpus_dataset",
-           "make_synthetic_tabular_dataset",
+           "make_synthetic_tabular_dataset", "make_synthetic_token_dataset",
            "prepare_fashion_mnist", "prepare_cifar10",
            "prepare_sklearn_digits", "prepare_sklearn_tabular",
            "prepare_bundled_pos_corpus"]

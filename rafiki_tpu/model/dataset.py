@@ -99,6 +99,23 @@ class CorpusDataset:
         return len(self.sentences)
 
 
+@dataclass
+class TokenDataset:
+    """A packed token-id stream (language modeling, LANGUAGE_MODELING
+    task): one flat id array a model windows into (seq_len+1)-long
+    training examples. No reference counterpart (upstream Rafiki has no
+    LM task — SURVEY.md §2 task list); the format exists because the
+    flagship ``JaxTransformerLM`` needs volume the sentence-per-row
+    corpus zip cannot express."""
+
+    ids: np.ndarray        # (n,) int32 token ids in [0, vocab_size)
+    vocab_size: int
+
+    @property
+    def size(self) -> int:
+        return int(self.ids.shape[0])
+
+
 # Hashing vocabulary shared by the sequence models (JaxPosTagger,
 # JaxTransformerTagger): tokens map to embedding rows via crc32 mod
 # vocab — no host-side vocab fitting, identical across processes, so
@@ -301,6 +318,30 @@ def write_corpus_dataset(sentences: List[List[str]], tags: List[List[str]],
             lines.append("")
         zf.writestr("corpus.tsv", "\n".join(lines) + "\n")
     return out_path
+
+
+def load_token_dataset(dataset_path: str) -> TokenDataset:
+    """Load a packed token-id dataset (.npz with ``ids`` +
+    ``vocab_size``)."""
+    if not os.path.exists(dataset_path):
+        raise FileNotFoundError(dataset_path)
+    with np.load(dataset_path) as z:
+        ids = np.asarray(z["ids"], dtype=np.int32)
+        vocab_size = int(z["vocab_size"])
+    if ids.ndim != 1:
+        raise ValueError(f"token dataset must be 1-D, got {ids.shape}")
+    if ids.size and (ids.min() < 0 or ids.max() >= vocab_size):
+        raise ValueError("token ids out of range for vocab_size "
+                         f"{vocab_size}")
+    return TokenDataset(ids=ids, vocab_size=vocab_size)
+
+
+def write_token_dataset(ids: np.ndarray, vocab_size: int,
+                        path: str) -> str:
+    ids = np.asarray(ids, dtype=np.int32)
+    np.savez_compressed(path if path.endswith(".npz") else path + ".npz",
+                        ids=ids, vocab_size=np.int64(vocab_size))
+    return path if path.endswith(".npz") else path + ".npz"
 
 
 def normalize_query(q: Any, expected_shape: Sequence[int]) -> np.ndarray:

@@ -4,6 +4,7 @@ from .cnn import JaxCnn
 from .densenet import JaxDenseNet
 from .enas import JaxEnas
 from .feedforward import JaxFeedForward
+from .lm import JaxTransformerLM
 from .pos_tagger import JaxPosTagger
 from .sk import SkDt, SkSvm
 from .tabular import JaxTabMlpClf, JaxTabMlpReg
@@ -12,4 +13,4 @@ from .vit import JaxViT
 
 __all__ = ["JaxFeedForward", "JaxCnn", "JaxDenseNet", "JaxEnas", "JaxViT",
            "JaxPosTagger", "SkDt", "SkSvm", "JaxTabMlpClf",
-           "JaxTabMlpReg", "JaxTransformerTagger"]
+           "JaxTabMlpReg", "JaxTransformerTagger", "JaxTransformerLM"]

@@ -64,7 +64,8 @@ def test_corpus_splits_share_tag_id_space(tmp_path):
 def test_bundled_english_pos_corpus(tmp_path):
     """The committed hand-tagged English corpus stays well-formed: every
     tag in the Universal tagset, both splits share one tag-id space,
-    and the size matches its README (329 sentences / 2,996 tokens)."""
+    and the size matches its README (679 sentences / 6,599 tokens —
+    round 5 grew it from the original 329/2,996)."""
     from rafiki_tpu.datasets import prepare_bundled_pos_corpus
 
     tr, va = prepare_bundled_pos_corpus(str(tmp_path))
@@ -75,7 +76,7 @@ def test_bundled_english_pos_corpus(tmp_path):
     assert set(dtr.tag_names) <= universal
     n_sents = dtr.size + dva.size
     n_tokens = sum(len(s) for s in dtr.sentences + dva.sentences)
-    assert n_sents == 329 and n_tokens == 2996, (n_sents, n_tokens)
+    assert n_sents == 679 and n_tokens == 6599, (n_sents, n_tokens)
     # Real language, not synthetic ids: a few high-frequency English
     # words must be present and consistently tagged.
     from collections import Counter
