@@ -41,7 +41,8 @@ from flax import traverse_util
 from flax.training import train_state
 
 from ..observe import MfuMeter, flops_of_compiled, flops_of_lowered
-from ..parallel import (batch_sharding, build_mesh, replicated,
+from ..parallel import (batch_sharding, build_mesh, device_get_tree,
+                        replicated,
                         shard_variables)
 from ..parallel.chips import ChipGroup
 from .base import BaseModel, Params
@@ -595,16 +596,23 @@ class JaxModel(BaseModel):
                 and last_epoch is not None:
             self._save_ckpt(mgr, last_epoch, state, best_loss, bad_epochs)
 
-        variables = {"params": jax.device_get(state.params)}
+        # Results stay DEVICE-RESIDENT: the device->host pull was the
+        # dominant cost of an ENAS trial (r5 profile). dump_parameters
+        # hands the device arrays to the ParamStore, whose write-behind
+        # flush does ONE packed background pull (store/params.py) while
+        # the next trial already computes; in-process warm starts reuse
+        # the device arrays with no transfer at all.
+        variables = {"params": state.params}
         if has_bs:
-            variables["batch_stats"] = jax.device_get(state.batch_stats)
+            variables["batch_stats"] = state.batch_stats
         self._variables = variables
         self._invalidate_compiled()
 
     def _save_ckpt(self, mgr, epoch: int, state, best_loss: float,
                    bad_epochs: int) -> None:
-        arrays = {f"leaf_{i}": np.asarray(jax.device_get(leaf))
-                  for i, leaf in enumerate(jax.tree.leaves(state))}
+        leaves = device_get_tree(jax.tree.leaves(state))  # ONE pull
+        arrays = {f"leaf_{i}": np.asarray(leaf)
+                  for i, leaf in enumerate(leaves)}
         arrays["es_best_loss"] = np.asarray(best_loss, np.float64)
         arrays["es_bad_epochs"] = np.asarray(bad_epochs, np.int64)
         try:
@@ -865,7 +873,12 @@ class JaxModel(BaseModel):
     def dump_parameters(self) -> Params:
         assert self._variables is not None
         flat = traverse_util.flatten_dict(self._variables, sep="/")
-        out: Params = {k: np.asarray(v) for k, v in flat.items()}
+        # Device leaves pass through AS DEVICE ARRAYS — the ParamStore
+        # write-behind (or any numpy consumer via np.asarray) decides
+        # when bytes actually cross to the host; host leaves (a loaded
+        # checkpoint) normalise to numpy as before.
+        out: Params = {k: v if isinstance(v, jax.Array) else np.asarray(v)
+                       for k, v in flat.items()}
         out["_meta/n_classes"] = np.asarray(self._meta["n_classes"])
         out["_meta/image_shape"] = np.asarray(self._meta["image_shape"])
         return out

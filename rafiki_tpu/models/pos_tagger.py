@@ -29,7 +29,8 @@ from ..model.jax_model import (_step_cache_get, _step_cache_put,
                                step_cache_key)
 from ..model.logger import logger
 from ..model.loop_ckpt import LoopCheckpointer, epoch_rng, schedule_epochs
-from ..parallel import batch_sharding, build_mesh, replicated
+from ..parallel import (batch_sharding, build_mesh, device_get_tree,
+                        replicated)
 from ..parallel.chips import ChipGroup
 
 
@@ -201,7 +202,7 @@ class JaxPosTagger(BaseModel):
             ckpt.after_epoch(epoch, (params, opt_state), max_epochs)
         ckpt.after_loop(last_epoch, (params, opt_state))
 
-        self._variables = {"params": jax.device_get(params)}
+        self._variables = {"params": device_get_tree(params)}
         self._invalidate_compiled()
 
     def evaluate(self, dataset_path: str) -> float:

@@ -45,6 +45,7 @@ from ..model.loop_ckpt import LoopCheckpointer, epoch_rng, schedule_epochs
 from ..ops import (default_attention, sequence_sharded_attention,
                    switch_moe)
 from ..parallel import (DP_AXIS, SP_AXIS, batch_sharding, build_mesh,
+                        device_get_tree,
                         replicated, shard_variables)
 from ..parallel.chips import ChipGroup
 
@@ -594,7 +595,7 @@ class JaxTransformerTagger(BaseModel):
 
         if pp_mode:
             params = self._pp_merge(params)
-        self._variables = {"params": jax.device_get(params)}
+        self._variables = {"params": device_get_tree(params)}
         self._invalidate_compiled()
 
     def evaluate(self, dataset_path: str) -> float:

@@ -1,6 +1,7 @@
 """Parallel execution layer: chip groups, meshes, sharding rules."""
 
 from .chips import ChipAllocator, ChipGroup
+from .transfer import device_get_tree
 from .mesh import (DP_AXIS, EP_AXIS, PP_AXIS, SP_AXIS, TP_AXIS,
                    batch_sharding,
                    build_mesh,
@@ -12,4 +13,5 @@ __all__ = [
     "DP_AXIS", "EP_AXIS", "PP_AXIS", "SP_AXIS", "TP_AXIS", "build_mesh",
     "batch_sharding",
     "replicated", "param_spec", "shard_variables", "variables_shardings",
+    "device_get_tree",
 ]

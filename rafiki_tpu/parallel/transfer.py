@@ -1,0 +1,73 @@
+"""Packed device→host transfer for pytrees.
+
+``jax.device_get`` on a pytree transfers LEAF BY LEAF, and on a
+proxied/tunneled TPU transport every readback pays a flush window
+(measured ~28 ms per leaf on the shared v5e tunnel). A ~220-leaf
+supernet therefore costs ~6 s per ``dump_parameters`` — which was the
+dominant cost of an ENAS trial (r5 profile: 37.7 of 43.3 s across six
+trials inside ``Array._value``).
+
+``device_get_tree`` packs instead: one jitted concat per dtype group
+(compiled once per tree signature, cached), ONE readback per dtype,
+then a host-side split. The same ~30 MB moves in 1-3 transfers instead
+of hundreds.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_PACK_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
+_PACK_CACHE_MAX = 32
+
+
+def device_get_tree(tree: Any) -> Any:
+    """Device→host for a whole pytree in one transfer per dtype group.
+
+    Returns a tree of numpy arrays with identical structure/shapes.
+    Host-side (numpy) leaves pass through unchanged.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    dev_idx = [i for i, leaf in enumerate(leaves)
+               if isinstance(leaf, jax.Array)]
+    if not dev_idx:
+        return jax.tree.map(np.asarray, tree)
+    sig = tuple((tuple(leaves[i].shape), str(leaves[i].dtype))
+                for i in dev_idx)
+    key = (treedef, sig)
+    entry = _PACK_CACHE.get(key)
+    if entry is None:
+        groups: Dict[str, List[int]] = {}
+        for i in dev_idx:
+            groups.setdefault(str(leaves[i].dtype), []).append(i)
+
+        def pack_fn(ls):
+            return {dt: jnp.concatenate(
+                        [ls[i].reshape(-1) for i in idxs])
+                    for dt, idxs in groups.items()}
+
+        entry = (jax.jit(pack_fn), groups)
+        _PACK_CACHE[key] = entry
+        _PACK_CACHE.move_to_end(key)
+        while len(_PACK_CACHE) > _PACK_CACHE_MAX:
+            _PACK_CACHE.popitem(last=False)
+    pack_fn, groups = entry
+    packed = pack_fn(leaves)
+    out: List[Any] = [np.asarray(leaf) if i not in set(dev_idx)
+                      else None for i, leaf in enumerate(leaves)]
+    for dt, idxs in groups.items():
+        flat = np.asarray(packed[dt])  # ONE readback per dtype
+        offset = 0
+        for i in idxs:
+            shape: Tuple[int, ...] = tuple(leaves[i].shape)
+            n = int(np.prod(shape)) if shape else 1
+            out[i] = flat[offset:offset + n].reshape(shape)
+            offset += n
+    return jax.tree.unflatten(treedef, out)

@@ -214,3 +214,29 @@ def test_allocator_3d_blob_spans_planes():
     g = a.allocate(5, "odd")
     assert g is not None and len(g.indices) == 5
     _assert_connected(g, topo)
+
+
+def test_device_get_tree_roundtrip():
+    """Packed single-transfer pull: values, shapes, dtypes and tree
+    structure must match a per-leaf jax.device_get exactly (mixed
+    dtypes, scalars, host leaves pass through)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rafiki_tpu.parallel import device_get_tree
+
+    tree = {
+        "a": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "b": {"c": jnp.ones((2, 2, 2), jnp.bfloat16) * 1.5,
+              "d": jnp.asarray(7, jnp.int32)},
+        "host": np.arange(3),
+        "e": [jnp.full((5,), -2.0, jnp.float32)],
+    }
+    got = device_get_tree(tree)
+    want = jax.tree.map(np.asarray, tree)
+    assert jax.tree.structure(got) == jax.tree.structure(want)
+    for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        assert g.shape == w.shape and g.dtype == w.dtype
+        assert np.array_equal(np.asarray(g, np.float64),
+                              np.asarray(w, np.float64))
