@@ -60,6 +60,9 @@ BASELINES = {
         "automl_trials_per_hour": 268.0,
         "ensemble_inference_qps": 1097.0,
         "serving_openloop_qps": None,
+        # r6: cross-request micro-batching config — first recorded run
+        # on each channel establishes the baseline.
+        "serving_concurrent_qps": None,
         # r5: single-chip time-sliced tenancy made this runnable on
         # one chip; the first recorded run establishes the baseline.
         "multitenant_trials_per_hour": None,
@@ -79,6 +82,9 @@ BASELINES = {
         "automl_trials_per_hour": 1411.6,
         "ensemble_inference_qps": 1704.5,
         "serving_openloop_qps": 3301.4,
+        # r6: cross-request micro-batching config — first recorded run
+        # on each channel establishes the baseline.
+        "serving_concurrent_qps": None,
         # r5: single-chip time-sliced tenancy made this runnable on
         # one chip; the first recorded run establishes the baseline.
         "multitenant_trials_per_hour": None,
@@ -514,6 +520,178 @@ def main_serving_openloop() -> dict:
         pipeline_speedup=round(qps_on / qps_off, 3))
 
 
+def main_serving_concurrent() -> dict:
+    """Closed-loop concurrent serving: N clients against the predictor
+    HTTP frontend, micro-batcher ON vs OFF (ISSUE r6).
+
+    The closed-loop config[3] (``serving``) hammers with 16 clients of
+    64-query batches — big enough that per-request scatter overhead
+    amortizes. Real app traffic is many SMALL requests, where the r5
+    frontend paid one worker scan + bus scatter + blocking gather per
+    request; this config measures exactly that regime (8 clients x
+    4-query requests) and the fix: ONE platform serves TWO inference
+    jobs of the same trained trial — one with the continuous
+    micro-batcher (the production default), one with
+    ``RAFIKI_TPU_SERVING_MICROBATCH=0`` (the r5 path) — windows
+    interleaved A/B/A/B so the ratio measures the batcher, not the
+    box's mood. The batcher job's ``/stats`` coalescing factor and both
+    modes' tail latencies ride the record, so the throughput win is
+    attributable, not asserted.
+    """
+    import tempfile
+    import threading
+
+    import requests
+
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.config import NodeConfig
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.platform import LocalPlatform
+
+    n_clients, per_request = 8, 4
+    window_s = 12.0
+    mb_env = NodeConfig.env_name("serving_microbatch")
+
+    def start_job(admin, cache, user_id, job_id, warm_batch):
+        inf = admin.create_inference_job(user_id, job_id, max_models=1)
+        deadline = time.time() + 600
+        while not cache.running_workers(inf["id"]) \
+                and time.time() < deadline:
+            time.sleep(0.5)
+        assert cache.running_workers(inf["id"]), "no workers registered"
+        host = admin.get_inference_job(inf["id"])["predictor_host"]
+        url = f"http://{host}/predict"
+        r = requests.post(url, json={"queries": warm_batch}, timeout=300)
+        r.raise_for_status()
+        return inf["id"], host
+
+    def one_window(url, batch, duration=None):
+        counts = [0] * n_clients
+        lat: list = []
+        lat_lock = threading.Lock()
+        errors: list = []
+        stop = threading.Event()
+
+        def client(i: int) -> None:
+            session = requests.Session()
+            my_lat = []
+            try:
+                while not stop.is_set():
+                    t0 = time.time()
+                    r = session.post(url, json={"queries": batch},
+                                     timeout=300)
+                    r.raise_for_status()
+                    my_lat.append(time.time() - t0)
+                    counts[i] += len(batch)
+            except Exception as e:
+                errors.append(e)
+                stop.set()
+            with lat_lock:
+                lat.extend(my_lat)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(duration if duration is not None else window_s)
+        stop.set()
+        for t in threads:
+            t.join()
+        elapsed = time.time() - t0
+        if errors:
+            raise RuntimeError(f"bench client failed: {errors[0]}")
+        lat_ms = np.percentile(np.asarray(lat) * 1e3, [50, 95, 99])
+        return sum(counts) / elapsed, [round(x, 2) for x in lat_ms]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256)
+        os.environ.pop(mb_env, None)
+        platform = LocalPlatform(workdir=f"{tmp}/plat")
+        try:
+            admin = platform.admin
+            cache = Cache(platform.bus)
+            user = admin.create_user("cc@x.c", "pw",
+                                     UserType.MODEL_DEVELOPER)
+            model = admin.create_model(
+                user["id"], "ff-cc", TaskType.IMAGE_CLASSIFICATION,
+                "rafiki_tpu.models.feedforward:JaxFeedForward")
+            job = admin.create_train_job(
+                user["id"], "cc", TaskType.IMAGE_CLASSIFICATION,
+                [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 1},
+                train_path, val_path)
+            assert admin.wait_until_train_job_done(job["id"],
+                                                   timeout=1200)
+            val = load_image_dataset(val_path)
+            batch = [encode_payload(val.images[i % val.size])
+                     for i in range(per_request)]
+
+            # Job A: micro-batcher on (production default).
+            inf_a, host_a = start_job(admin, cache, user["id"],
+                                      job["id"], batch)
+            # Job B: the r5 one-scatter-per-request path.
+            os.environ[mb_env] = "0"
+            try:
+                inf_b, host_b = start_job(admin, cache, user["id"],
+                                          job["id"], batch)
+            finally:
+                os.environ.pop(mb_env, None)
+            # The forcing must have taken, or the A/B ratio is fiction.
+            stats_b = requests.get(f"http://{host_b}/stats",
+                                   timeout=30).json()
+            assert stats_b.get("microbatch") is False, stats_b
+
+            url_a, url_b = (f"http://{host_a}/predict",
+                            f"http://{host_b}/predict")
+            # Warm windows (untimed): the workers AOT-compile per
+            # power-of-two batch bucket, and only the coalesced load
+            # decides which buckets the timed windows will hit — run
+            # the real concurrency pattern once per mode so no XLA
+            # compile lands inside a measurement.
+            one_window(url_a, batch, duration=5.0)
+            one_window(url_b, batch, duration=5.0)
+            vals_a: list = []
+            vals_b: list = []
+            lat_a = lat_b = None
+            for _ in range(4):
+                qps, lat = one_window(url_a, batch)
+                if not vals_a or qps > max(vals_a):
+                    lat_a = lat  # tail latency of the BEST window
+                vals_a.append(qps)
+                qps, lat = one_window(url_b, batch)
+                if not vals_b or qps > max(vals_b):
+                    lat_b = lat
+                vals_b.append(qps)
+                if _settled(vals_a) and _settled(vals_b):
+                    break
+            stats_a = requests.get(f"http://{host_a}/stats",
+                                   timeout=30).json()
+            admin.stop_inference_job(inf_a)
+            admin.stop_inference_job(inf_b)
+        finally:
+            platform.shutdown()
+
+    best_a, best_b = max(vals_a), max(vals_b)
+    return _emit(
+        "serving_concurrent_qps", best_a, "queries/s",
+        n_windows=len(vals_a),
+        spread=round((best_a - min(vals_a)) / best_a, 3),
+        windows_microbatch=[round(v, 2) for v in vals_a],
+        windows_direct=[round(v, 2) for v in vals_b],
+        n_clients=n_clients,
+        queries_per_request=per_request,
+        qps_microbatch_on=round(best_a, 2),
+        qps_microbatch_off=round(best_b, 2),
+        microbatch_speedup=round(best_a / best_b, 3),
+        coalescing_factor=stats_a.get("coalescing_factor"),
+        mean_batch_queries=stats_a.get("mean_batch_queries"),
+        rejected_429=stats_a.get("rejected"),
+        latency_ms_p50_p95_p99_on=lat_a,
+        latency_ms_p50_p95_p99_off=lat_b)
+
+
 def main_multitenant() -> dict:
     """Config[4]: aggregate trials/hour, two jobs contending for chips.
 
@@ -770,6 +948,8 @@ _CONFIGS = {
     "serving": (main_serving, "ensemble_inference_qps", "queries/s"),
     "serving-openloop": (main_serving_openloop, "serving_openloop_qps",
                          "queries/s"),
+    "serving-concurrent": (main_serving_concurrent,
+                           "serving_concurrent_qps", "queries/s"),
     "multitenant": (main_multitenant, "multitenant_trials_per_hour",
                     "trials/hour"),
     "densenet": (main_densenet, "densenet_train_images_per_sec",
@@ -786,7 +966,8 @@ _CONFIGS = {
 # stacks, then multitenant (runnable on any device count since r5 —
 # one chip runs it time-sliced).
 _SWEEP_ORDER = ["trials", "densenet", "enas", "roofline", "attention",
-                "serving", "serving-openloop", "multitenant"]
+                "serving", "serving-openloop", "serving-concurrent",
+                "multitenant"]
 
 
 def _run_config(name: str, platform: str) -> dict:

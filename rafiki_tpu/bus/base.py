@@ -9,7 +9,7 @@ the bus by design — ICI/HBM is for tensors, the bus is for control.
 from __future__ import annotations
 
 import abc
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Sequence, Tuple
 
 
 class BaseBus(abc.ABC):
@@ -18,6 +18,14 @@ class BaseBus(abc.ABC):
     @abc.abstractmethod
     def push(self, queue: str, value: Any) -> None:
         """Append ``value`` to ``queue`` (FIFO)."""
+
+    def push_many(self, items: Sequence[Tuple[str, Any]]) -> None:
+        """Append each ``(queue, value)`` pair, in order. Backends
+        override to do it in one lock hold / one broker round-trip —
+        the serving scatter pushes one frame per worker, and W
+        round-trips per request is the frontend's QPS ceiling."""
+        for queue, value in items:
+            self.push(queue, value)
 
     @abc.abstractmethod
     def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:

@@ -42,6 +42,12 @@ class MemoryBus(BaseBus):
             self._queues[queue].append(value)
             self._cond.notify_all()
 
+    def push_many(self, items) -> None:
+        with self._cond:
+            for queue, value in items:
+                self._queues[queue].append(value)
+            self._cond.notify_all()
+
     def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:
         deadline = time.monotonic() + timeout
         with self._cond:

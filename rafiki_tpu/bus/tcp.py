@@ -88,6 +88,10 @@ class _Handler(socketserver.BaseRequestHandler):
         if op == "push":
             bus.push(req["queue"], req["value"])
             return None
+        if op == "push_many":
+            bus.push_many([(i["queue"], i["value"])
+                           for i in req["items"]])
+            return None
         if op == "pop":
             return bus.pop(req["queue"], float(req.get("timeout", 0.0)))
         if op == "pop_all":
@@ -224,6 +228,32 @@ class BusClient(BaseBus):
 
     def push(self, queue: str, value: Any) -> None:
         self._call({"op": "push", "queue": queue, "value": value})
+
+    def push_many(self, items) -> None:
+        """One round-trip for a multi-queue scatter. An older broker
+        (the cached native binary predating the op) reports an unknown
+        op; that negotiates a permanent per-item fallback rather than
+        failing the scatter."""
+        items = list(items)
+        if not items:
+            return
+        if getattr(self, "_no_push_many", False):
+            for queue, value in items:
+                self.push(queue, value)
+            return
+        try:
+            self._call({"op": "push_many",
+                        "items": [{"queue": q, "value": v}
+                                  for q, v in items]})
+        except BusOpError as e:
+            # Fall back ONLY on "unknown op" (nothing executed). Any
+            # other reported failure may have pushed a prefix of the
+            # items; re-pushing would duplicate frames.
+            if "unknown op" not in str(e):
+                raise
+            self._no_push_many = True
+            for queue, value in items:
+                self.push(queue, value)
 
     def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:
         return self._call({"op": "pop", "queue": queue, "timeout": timeout})

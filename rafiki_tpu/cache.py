@@ -16,6 +16,7 @@ once and batch onto the chip themselves.
 from __future__ import annotations
 
 import base64
+import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -60,15 +61,19 @@ class Cache:
     def __init__(self, bus: BaseBus):
         self.bus = bus
         self._reap_later: List[tuple] = []  # (monotonic_ts, queue_key)
+        # One Cache is shared by every handler thread of a predictor
+        # frontend (and by the micro-batcher's scatter/gather threads);
+        # the deferred-reap list is the only mutable state.
+        self._reap_lock = threading.Lock()
 
     def _reap_stale(self, now: float) -> None:
-        keep = []
-        for ts, key in self._reap_later:
-            if now - ts >= self._REAP_DELAY:
-                self.bus.delete_queue(key)
-            else:
-                keep.append((ts, key))
-        self._reap_later = keep
+        with self._reap_lock:
+            due = [key for ts, key in self._reap_later
+                   if now - ts >= self._REAP_DELAY]
+            self._reap_later = [(ts, key) for ts, key in self._reap_later
+                                if now - ts < self._REAP_DELAY]
+        for key in due:
+            self.bus.delete_queue(key)
 
     def _gather(self, queue_key: str, n_workers: int, timeout: float,
                 decode: Any) -> List[Dict[str, Any]]:
@@ -90,7 +95,8 @@ class Cache:
             out.append(decode(item))
         self.bus.delete_queue(queue_key)
         if len(out) < n_workers:
-            self._reap_later.append((time.monotonic(), queue_key))
+            with self._reap_lock:
+                self._reap_later.append((time.monotonic(), queue_key))
         return out
 
     # --- Worker registry ---
@@ -152,6 +158,21 @@ class Cache:
             queries = [encode_payload(q) for q in queries]
         self.bus.push(f"q:{worker_id}", {
             "batch_id": batch_id, "queries": queries})
+        return batch_id
+
+    def send_query_batch_fanout(self, worker_ids: List[str],
+                                encoded_queries: List[Any],
+                                batch_id: Optional[str] = None) -> str:
+        """Scatter ONE pre-encoded batch to every worker in one bus
+        call (``push_many``). The encoded payload list is SHARED across
+        the per-worker frames — encode once, serialize per queue, no
+        per-worker deep copies; only the outer frame dict is fresh per
+        worker (consumers decode by *replacing* the ``queries`` key, so
+        the shared list itself is never mutated)."""
+        batch_id = batch_id or uuid.uuid4().hex
+        self.bus.push_many([
+            (f"q:{w}", {"batch_id": batch_id, "queries": encoded_queries})
+            for w in worker_ids])
         return batch_id
 
     def gather_prediction_batches(self, batch_id: str, n_workers: int,

@@ -69,6 +69,16 @@ class NodeConfig:
     trace_dir: str = ""                    # per-trial profiler traces
     probe_timeout: float = 60.0            # accelerator liveness probe
 
+    # --- Serving frontend: continuous cross-request micro-batching ---
+    # The predictor coalesces every /predict arriving within one fill
+    # window into ONE scatter-gather super-batch (predictor/batcher.py).
+    serving_microbatch: bool = True        # off = one scatter per request
+    serving_fill_window: float = 0.005     # seconds a window stays open
+    serving_max_batch: int = 1024          # queries per super-batch
+    serving_max_inflight: int = 2          # scattered-ungathered batches
+    serving_queue_cap: int = 4096          # admission bound (queries);
+    #                                        beyond it: 429 + Retry-After
+
     # Fields whose env names predate this layer (back-compat).
     _ENV_MAP = {
         "serving_pipeline": "RAFIKI_TPU_SERVING_PIPELINE",
@@ -167,6 +177,12 @@ class NodeConfig:
             raise ValueError("supervise_interval must be >= 0")
         if self.probe_timeout <= 0:
             raise ValueError("probe_timeout must be positive")
+        if self.serving_fill_window < 0:
+            raise ValueError("serving_fill_window must be >= 0")
+        if self.serving_max_batch < 1 or self.serving_max_inflight < 1 \
+                or self.serving_queue_cap < 1:
+            raise ValueError("serving_max_batch, serving_max_inflight "
+                             "and serving_queue_cap must be >= 1")
         if self.log_level.upper() not in (
                 "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
             raise ValueError(f"unknown log_level {self.log_level!r}")
@@ -193,3 +209,11 @@ class NodeConfig:
         if self.trace_dir:
             os.environ[self.env_name("trace_dir")] = self.trace_dir
         os.environ[self.env_name("probe_timeout")] = str(self.probe_timeout)
+        # Micro-batcher knobs: the PredictorService reads these at
+        # construction (it may be built in a spawned child or an
+        # in-process thread — env is the one transport both inherit).
+        os.environ[self.env_name("serving_microbatch")] = \
+            "1" if self.serving_microbatch else "0"
+        for f in ("serving_fill_window", "serving_max_batch",
+                  "serving_max_inflight", "serving_queue_cap"):
+            os.environ[self.env_name(f)] = str(getattr(self, f))

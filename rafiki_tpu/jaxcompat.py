@@ -1,0 +1,51 @@
+"""Version-tolerant aliases for jax APIs that moved between releases.
+
+The deployment targets current jax on TPU, but CI/sandbox environments
+can lag by several minor versions; every renamed-or-relocated API the
+codebase touches resolves HERE, once, instead of try/except blocks
+scattered through kernels and models.
+
+- ``shard_map``: promoted from ``jax.experimental.shard_map`` to
+  ``jax.shard_map``; the replication-check kwarg was renamed
+  ``check_rep`` → ``check_vma`` in the same move. The wrapper accepts
+  the NEW spelling and translates down.
+- ``pallas_compiler_params``: ``pltpu.TPUCompilerParams`` was renamed
+  ``pltpu.CompilerParams``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # pre-promotion location
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f: Any = None, *, check_vma: Any = None, **kw: Any):
+    """``jax.shard_map`` with the current-jax signature on any jax.
+
+    Usable exactly like the real one, including the
+    ``functools.partial(shard_map, mesh=..., ...)`` decorator idiom
+    (calling without ``f`` returns a decorator).
+    """
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    if f is None:
+        return lambda fn: _shard_map(fn, **kw)
+    return _shard_map(f, **kw)
+
+
+def pallas_compiler_params(**kw: Any):
+    """``pltpu.CompilerParams(**kw)`` under whichever name this jax
+    ships it."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)

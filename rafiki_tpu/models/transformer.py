@@ -305,9 +305,9 @@ class JaxTransformerTagger(BaseModel):
         where ``aux`` is the mean MoE load-balance loss (0.0 for dense
         models).
         """
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        from ..jaxcompat import shard_map
         from ..ops import pipeline_apply, ring_attention, ulysses_attention
         from ..parallel import EP_AXIS, PP_AXIS
 

@@ -9,6 +9,8 @@ north-star "≥90% chip utilization" metric (BASELINE.md).
 
 from .profiling import (MfuMeter, device_peak_flops, flops_of_compiled,
                         flops_of_lowered, trace_session, trial_trace_dir)
+from .serving import ServingStats
 
 __all__ = ["trace_session", "trial_trace_dir", "device_peak_flops",
-           "flops_of_lowered", "flops_of_compiled", "MfuMeter"]
+           "flops_of_lowered", "flops_of_compiled", "MfuMeter",
+           "ServingStats"]

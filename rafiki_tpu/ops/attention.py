@@ -39,9 +39,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..jaxcompat import pallas_compiler_params, shard_map
 from ..parallel.mesh import DP_AXIS, SP_AXIS
 
 # Large-negative instead of -inf: exp(NEG_INF - NEG_INF) must be finite
@@ -245,6 +245,15 @@ def _flash_blocking(q, k, bias, block_q, block_kv):
     tkv = k.shape[2]
     block_q = min(block_q, max(tq, 8))
     block_kv = min(block_kv, max(tkv, 8))
+    if tq > block_q and block_q % 128 != 0:
+        # The backward kernels read the lse/delta residuals through
+        # (1, 1, block_q) row blocks — block_q is their LANE dim, which
+        # Mosaic requires to be 128-divisible unless a single block
+        # spans the whole (padded) array. Round up (never past one
+        # whole-q block) so jax.grad lowers for ANY requested block_q;
+        # the forward shares this clamp, keeping the saved lse layout
+        # (nq * block_q) consistent between the passes.
+        block_q = min(-(-block_q // 128) * 128, -(-tq // 128) * 128)
     if bias is not None and tkv > block_kv and block_kv % 128 != 0:
         # The bias block's lane dim must be 128-divisible (TPU tiling)
         # unless a single block spans the whole (padded) kv length.
@@ -316,7 +325,7 @@ def _flash_forward(q, k, v, bias, causal, block_q, block_kv, interpret,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*inputs)
@@ -523,7 +532,7 @@ def _flash_backward(q, k, v, bias, out, lse, g, causal, block_q,
         out_shape=jax.ShapeDtypeStruct((b * h, nq * block_q, dp),
                                        q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*inputs)
@@ -541,7 +550,7 @@ def _flash_backward(q, k, v, bias, out, lse, g, causal, block_q,
         ],
         scratch_shapes=[pltpu.VMEM((block_kv, dp), jnp.float32),
                         pltpu.VMEM((block_kv, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*inputs)

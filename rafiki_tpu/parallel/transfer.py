@@ -41,7 +41,12 @@ def device_get_tree(tree: Any) -> Any:
         return jax.tree.map(np.asarray, tree)
     sig = tuple((tuple(leaves[i].shape), str(leaves[i].dtype))
                 for i in dev_idx)
-    key = (treedef, sig)
+    # WHICH leaves are device-resident is part of the signature: two
+    # trees with the same treedef and coinciding device-leaf
+    # (shape, dtype) sequences but a different device/host mix must not
+    # share a cached pack plan (the cached groups would pack the wrong
+    # leaves, leaving None holes in the output tree).
+    key = (treedef, tuple(dev_idx), sig)
     entry = _PACK_CACHE.get(key)
     if entry is None:
         groups: Dict[str, List[int]] = {}

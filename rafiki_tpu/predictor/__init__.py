@@ -3,6 +3,8 @@
 Parity: SURVEY.md §2 "Predictor" + §3.3.
 """
 
+from .batcher import Backpressure, MicroBatcher
 from .predictor import Predictor, ensemble_predictions
 
-__all__ = ["Predictor", "ensemble_predictions"]
+__all__ = ["Predictor", "ensemble_predictions", "MicroBatcher",
+           "Backpressure"]

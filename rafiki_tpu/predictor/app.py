@@ -4,35 +4,80 @@ Parity: SURVEY.md §3.3 — upstream's predictor is a Flask app with
 ``POST /predict``; app consumers send queries and receive the ensembled
 result. Routes:
 
-- ``GET  /``          → health + running worker count
+- ``GET  /``          → health + running worker count + queue depth
 - ``POST /predict``   → ``{"query": ...}`` or ``{"queries": [...]}``;
   numpy-array queries use the cache's base64 frame encoding
   (``{"__nd__": ..., "dtype": ..., "shape": ...}``) or plain nested lists.
+  Overload answers ``429`` with a ``Retry-After`` header.
+- ``GET  /stats``     → micro-batcher counters (coalescing factor,
+  queue depth, per-stage latency; ``observe.ServingStats``).
+
+Concurrent requests do NOT each pay their own worker scan + bus
+scatter: a continuous micro-batcher (``predictor/batcher.py``)
+coalesces everything arriving within one fill window into a single
+scatter-gather super-batch and slices the ensembled results back out
+per request. ``RAFIKI_TPU_SERVING_MICROBATCH=0`` restores the direct
+one-scatter-per-request path (the bench's A/B comparison rides this).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, Optional
 
 from ..bus import BaseBus
 from ..cache import decode_payload
+from ..config import NodeConfig, _parse_bool
 from ..constants import ServiceStatus
+from ..observe import ServingStats
 from ..store import MetaStore
 from ..utils.service import JsonHttpServer
+from .batcher import Backpressure, MicroBatcher
 from .predictor import Predictor
+
+
+def _env_knob(field: str, default: str) -> str:
+    return os.environ.get(NodeConfig.env_name(field), default)
 
 
 class PredictorService:
     def __init__(self, service_id: str, inference_job_id: str,
                  meta: MetaStore, bus: BaseBus, host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0, microbatch: Optional[bool] = None,
+                 fill_window: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 queue_cap: Optional[int] = None):
         self.service_id = service_id
         self.inference_job_id = inference_job_id
         self.meta = meta
         self.predictor = Predictor(inference_job_id, bus)
+        self.stats = ServingStats()
+        # Knob precedence matches NodeConfig: explicit constructor arg >
+        # RAFIKI_TPU_SERVING_* env (apply_env exports them) > default.
+        if microbatch is None:
+            microbatch = _parse_bool(_env_knob("serving_microbatch", "1"))
+        self.microbatch = microbatch
+        self.batcher: Optional[MicroBatcher] = None
+        if microbatch:
+            self.batcher = MicroBatcher(
+                self.predictor,
+                fill_window=float(fill_window
+                                  if fill_window is not None else
+                                  _env_knob("serving_fill_window",
+                                            "0.005")),
+                max_batch=int(max_batch if max_batch is not None else
+                              _env_knob("serving_max_batch", "1024")),
+                max_inflight=int(max_inflight
+                                 if max_inflight is not None else
+                                 _env_knob("serving_max_inflight", "2")),
+                queue_cap=int(queue_cap if queue_cap is not None else
+                              _env_knob("serving_queue_cap", "4096")),
+                stats=self.stats)
         self._http = JsonHttpServer([
             ("GET", "/", self._health),
+            ("GET", "/stats", self._stats),
             ("POST", "/predict", self._predict),
         ], host=host, port=port, name=f"predictor-{service_id[:8]}")
         self.port = self._http.port
@@ -40,6 +85,8 @@ class PredictorService:
     # --- Service lifecycle (ContainerManager contract) ---
 
     def start(self) -> "PredictorService":
+        if self.batcher is not None:
+            self.batcher.start()
         self._http.start()
         host = f"127.0.0.1:{self.port}"
         self.meta.update_service(self.service_id,
@@ -51,6 +98,8 @@ class PredictorService:
 
     def stop(self) -> None:
         self._http.stop()
+        if self.batcher is not None:
+            self.batcher.stop()
         self.meta.update_service(self.service_id,
                                  status=ServiceStatus.STOPPED)
 
@@ -69,16 +118,51 @@ class PredictorService:
     def _health(self, params, body, ctx):
         return 200, {"status": "ok",
                      "inference_job_id": self.inference_job_id,
-                     "n_workers": len(self.predictor.workers())}
+                     "n_workers": len(self.predictor.workers()),
+                     "microbatch": self.microbatch,
+                     "queue_depth": self.stats.queue_depth}
+
+    def _stats(self, params, body, ctx):
+        snap = self.stats.snapshot()
+        snap["microbatch"] = self.microbatch
+        if self.batcher is not None:
+            snap["knobs"] = {
+                "fill_window": self.batcher.fill_window,
+                "max_batch": self.batcher.max_batch,
+                "max_inflight": self.batcher.max_inflight,
+                "queue_cap": self.batcher.queue_cap,
+            }
+        return 200, snap
+
+    def _run_queries(self, encoded_queries) -> list:
+        """One request's queries → ensembled predictions, through the
+        shared micro-batcher when enabled (frames stay wire-encoded all
+        the way to the bus — no decode/re-encode on the hot path)."""
+        if self.batcher is not None:
+            # Bound the handler's wait by the worst honest path: worker
+            # warm-up wait + gather timeout + batching slack. A wedged
+            # batcher then surfaces as a 500, not a hung socket.
+            timeout = (self.predictor.worker_wait_timeout
+                       + self.predictor.gather_timeout + 60.0)
+            return self.batcher.submit(encoded_queries, timeout=timeout)
+        self.stats.admitted(len(encoded_queries))
+        return self.predictor.predict(
+            [decode_payload(q) for q in encoded_queries])
 
     def _predict(self, params, body, ctx):
         if not body:
             return 400, {"error": "missing JSON body"}
-        if "queries" in body:
-            queries = [decode_payload(q) for q in body["queries"]]
-            preds = self.predictor.predict(queries)
-            return 200, {"predictions": preds}
-        if "query" in body:
-            preds = self.predictor.predict([decode_payload(body["query"])])
-            return 200, {"prediction": preds[0]}
+        try:
+            if "queries" in body:
+                preds = self._run_queries(body["queries"])
+                return 200, {"predictions": preds}
+            if "query" in body:
+                preds = self._run_queries([body["query"]])
+                return 200, {"prediction": preds[0]}
+        except Backpressure as e:
+            return (429,
+                    {"error": str(e), "queue_depth": e.depth,
+                     "queue_cap": e.cap,
+                     "retry_after": e.retry_after},
+                    {"Retry-After": str(int(e.retry_after))})
         return 400, {"error": "body needs 'query' or 'queries'"}

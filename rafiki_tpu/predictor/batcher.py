@@ -1,0 +1,331 @@
+"""Continuous cross-request micro-batching for the serving frontend.
+
+Parity+: upstream's Predictor scatter-gathers once per incoming request
+(SURVEY.md §3.3); the reproduction kept that shape, so every concurrent
+``/predict`` paid its own worker scan + bus scatter + blocking gather —
+the r5 bench showed the serving configs frontend-bound (window spread
+0.4-0.6 vs ~0.001 for compute-bound configs). This module puts ONE
+shared admission queue between the HTTP handlers and the Predictor:
+
+- **Coalescing.** All requests arriving within a short fill window (or
+  up to a query cap) ride ONE scatter-gather super-batch; per-request
+  slices come back out via futures. N concurrent clients cost one
+  worker scan and one bus round-trip per window, not N of each.
+- **Keep-N-in-flight.** Super-batch K+1 is filled and scattered while
+  K's gather is still blocking (a dedicated gather thread completes
+  batches in dispatch order), mirroring the InferenceWorker's
+  one-burst-in-flight overlap from the other side of the bus.
+- **Backpressure.** The admission queue is bounded in QUERIES; when
+  it is full, ``submit`` raises :class:`Backpressure` immediately and
+  the HTTP route turns that into ``429 Retry-After`` — overload shows
+  up as fast rejections, not unbounded handler-thread pileup.
+
+Knobs (``NodeConfig`` fields, ``RAFIKI_TPU_SERVING_*`` env parity):
+``serving_microbatch`` (on/off), ``serving_fill_window`` (seconds),
+``serving_max_batch`` (queries per super-batch), ``serving_max_inflight``
+(scattered-ungathered super-batches), ``serving_queue_cap`` (admission
+bound, queries). Observability rides :class:`observe.ServingStats`.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import math
+import threading
+import time
+from typing import Any, List, Optional
+
+from ..observe import ServingStats
+
+_log = logging.getLogger(__name__)
+
+
+class Backpressure(RuntimeError):
+    """Admission queue full; retry after ``retry_after`` seconds."""
+
+    def __init__(self, retry_after: float, depth: int, cap: int):
+        super().__init__(
+            f"serving queue full ({depth}/{cap} queries); "
+            f"retry after {retry_after:.1f}s")
+        self.retry_after = retry_after
+        self.depth = depth
+        self.cap = cap
+
+
+class _Request:
+    """One caller's slice of a super-batch."""
+
+    __slots__ = ("queries", "event", "result", "error")
+
+    def __init__(self, queries: List[Any]):
+        self.queries = queries
+        self.event = threading.Event()
+        self.result: Optional[List[Any]] = None
+        self.error: Optional[BaseException] = None
+
+    def resolve(self, result: List[Any]) -> None:
+        self.result = result
+        self.event.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.event.set()
+
+
+class MicroBatcher:
+    """Shared admission queue + batcher/gather thread pair in front of
+    one :class:`~rafiki_tpu.predictor.predictor.Predictor`.
+
+    ``submit`` blocks the calling (handler) thread until its slice of
+    the ensembled results is ready; the batcher thread owns scatter,
+    the gather thread owns gather — at most ``max_inflight``
+    super-batches are scattered-but-ungathered at any moment.
+    """
+
+    def __init__(self, predictor: Any, *, fill_window: float = 0.005,
+                 max_batch: int = 1024, max_inflight: int = 2,
+                 queue_cap: int = 4096, pre_encoded: bool = True,
+                 stats: Optional[ServingStats] = None):
+        if fill_window < 0:
+            raise ValueError("fill_window must be >= 0")
+        if max_batch < 1 or max_inflight < 1 or queue_cap < 1:
+            raise ValueError("max_batch, max_inflight and queue_cap "
+                             "must be >= 1")
+        self.predictor = predictor
+        self.fill_window = fill_window
+        self.max_batch = max_batch
+        self.max_inflight = max_inflight
+        self.queue_cap = queue_cap
+        self.pre_encoded = pre_encoded
+        self.stats = stats or ServingStats()
+
+        self._cond = threading.Condition()
+        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._pending_queries = 0
+        self._inflight_sem = threading.Semaphore(max_inflight)
+        self._inflight = 0  # gauge only; _inflight_sem is the limiter
+        self._inflight_lock = threading.Lock()
+        # Scattered-but-ungathered super-batches, completed in dispatch
+        # order: (finisher, [requests]). Unbounded by construction —
+        # the semaphore above already caps how much lands here.
+        self._completions: "collections.deque" = collections.deque()
+        self._completions_cond = threading.Condition()
+        # The batch the gather thread is currently blocked on (guarded
+        # by _completions_cond): stop() must be able to fail its
+        # requests promptly instead of leaving them to the gather
+        # timeout.
+        self._gathering: Optional[List[_Request]] = None
+        self._stop = threading.Event()
+        self._batcher = threading.Thread(
+            target=self._batch_loop, name="micro-batcher", daemon=True)
+        self._gatherer = threading.Thread(
+            target=self._gather_loop, name="micro-gather", daemon=True)
+        self._started = False
+
+    # --- Lifecycle ---
+
+    def start(self) -> "MicroBatcher":
+        with self._cond:  # idempotent under concurrent first submits
+            if self._started:
+                return self
+            self._started = True
+        self._batcher.start()
+        self._gatherer.start()
+        return self
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        with self._completions_cond:
+            self._completions_cond.notify_all()
+        for t in (self._batcher, self._gatherer):
+            if t.is_alive():
+                t.join(timeout=join_timeout)
+        # Fail whatever is still queued — AND any super-batch the
+        # batcher scattered after the gather thread already exited — so
+        # no handler thread hangs on a dead batcher.
+        with self._cond:
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._pending_queries = 0
+        with self._completions_cond:
+            stranded.extend(req for _, batch in self._completions
+                            for req in batch)
+            self._completions.clear()
+            # The in-gather batch may stay blocked on worker replies for
+            # the remaining gather timeout; its callers must not. A late
+            # finisher return then resolves already-failed requests,
+            # which is harmless (their waiters are gone).
+            if self._gathering:
+                stranded.extend(self._gathering)
+        for req in stranded:
+            req.fail(RuntimeError("micro-batcher stopped"))
+
+    # --- Caller side ---
+
+    def submit(self, queries: List[Any],
+               timeout: Optional[float] = None) -> List[Any]:
+        """Enqueue one request's queries; block until its slice of the
+        super-batch results is ready. Raises :class:`Backpressure` when
+        the admission queue is full (the caller maps it to HTTP 429)."""
+        if not self._started:
+            self.start()
+        n = len(queries)
+        if n == 0:
+            return []
+        req = _Request(queries)
+        with self._cond:
+            # Checked under the lock: a request admitted after stop()'s
+            # queue drain would sit in a queue no thread reads, blocking
+            # its handler for the full timeout.
+            if self._stop.is_set():
+                raise RuntimeError("micro-batcher stopped")
+            # A request larger than the whole cap is only admitted when
+            # the queue is empty (otherwise it could never be served);
+            # everything else bounces as soon as the bound is crossed.
+            if self._pending_queries > 0 and \
+                    self._pending_queries + n > self.queue_cap:
+                self.stats.backpressured()
+                raise Backpressure(self._retry_after(),
+                                   self._pending_queries, self.queue_cap)
+            self._queue.append(req)
+            self._pending_queries += n
+            self.stats.admitted(n)
+            self.stats.set_queue_depth(self._pending_queries)
+            self._cond.notify_all()
+        if not req.event.wait(timeout):
+            raise TimeoutError(
+                f"micro-batched predict did not complete in {timeout}s")
+        if req.error is not None:
+            raise req.error
+        return req.result if req.result is not None else []
+
+    def _retry_after(self) -> float:
+        """Advisory drain estimate for the 429 ``Retry-After`` header:
+        a full queue is ~(cap / max_batch) super-batches, each at least
+        one fill window deep. Clamped to whole seconds >= 1 (the header
+        is integer seconds)."""
+        batches = max(1.0, self.queue_cap / self.max_batch)
+        return max(1.0, math.ceil(batches * max(self.fill_window, 0.05)))
+
+    # --- Batcher thread: fill + scatter ---
+
+    def _drain_into(self, batch: List[_Request], total: int) -> int:
+        """Pop whole queued requests into ``batch`` while they fit under
+        the super-batch query cap (an oversized request is admitted
+        only as the FIRST of a batch); returns the new query total.
+        Caller holds ``self._cond``."""
+        while self._queue and total < self.max_batch:
+            nxt = len(self._queue[0].queries)
+            if batch and total + nxt > self.max_batch:
+                break
+            req = self._queue.popleft()
+            self._pending_queries -= nxt
+            batch.append(req)
+            total += nxt
+        self.stats.set_queue_depth(self._pending_queries)
+        return total
+
+    def _take_batch(self):
+        """Block for the first request, then keep filling until the
+        fill window closes or the query cap is hit. Returns
+        ``(batch, t_first)`` where ``t_first`` is when filling began —
+        idle time spent waiting for the first request is not fill
+        time."""
+        batch: List[_Request] = []
+        total = 0
+        with self._cond:
+            while not self._queue:
+                if self._stop.is_set():
+                    return batch, time.monotonic()
+                self._cond.wait(0.1)
+            t_first = time.monotonic()
+            deadline = t_first + self.fill_window
+            while True:
+                total = self._drain_into(batch, total)
+                remaining = deadline - time.monotonic()
+                if total >= self.max_batch or remaining <= 0 \
+                        or self._stop.is_set():
+                    break
+                self._cond.wait(remaining)
+        return batch, t_first
+
+    def _top_up(self, batch: List[_Request]) -> None:
+        """After waiting for an in-flight slot, absorb whatever queued
+        up meanwhile (still under the query cap) — under overload the
+        slot wait IS the fill window, so coalescing scales with load."""
+        with self._cond:
+            self._drain_into(batch, sum(len(r.queries) for r in batch))
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            batch, t0 = self._take_batch()
+            if not batch:
+                continue
+            # Wait for an in-flight slot (keep-N-in-flight), topping the
+            # batch up with anything that arrived during the wait.
+            while not self._inflight_sem.acquire(timeout=0.5):
+                if self._stop.is_set():
+                    for req in batch:
+                        req.fail(RuntimeError("micro-batcher stopped"))
+                    return
+            self._top_up(batch)
+            fill_s = time.monotonic() - t0
+            flat: List[Any] = []
+            for req in batch:
+                flat.extend(req.queries)
+            t1 = time.monotonic()
+            try:
+                finisher = self.predictor.predict_submit(
+                    flat, pre_encoded=self.pre_encoded)
+            except BaseException as e:  # noqa: BLE001 - forwarded to callers
+                self._inflight_sem.release()
+                for req in batch:
+                    req.fail(e)
+                continue
+            scatter_s = time.monotonic() - t1
+            with self._inflight_lock:
+                self._inflight += 1
+                inflight = self._inflight
+            self.stats.dispatched(len(batch), len(flat), fill_s,
+                                  scatter_s, inflight=inflight)
+            with self._completions_cond:
+                self._completions.append((finisher, batch))
+                self._completions_cond.notify_all()
+
+    # --- Gather thread: finish + slice ---
+
+    def _gather_loop(self) -> None:
+        while True:
+            with self._completions_cond:
+                while not self._completions:
+                    if self._stop.is_set():
+                        return
+                    self._completions_cond.wait(0.1)
+                finisher, batch = self._completions.popleft()
+                self._gathering = batch
+            t0 = time.monotonic()
+            results = error = None
+            try:
+                results = finisher()
+            except BaseException as e:  # noqa: BLE001 - forwarded to callers
+                error = e
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    inflight = self._inflight
+                self._inflight_sem.release()
+                self.stats.gathered(time.monotonic() - t0,
+                                    inflight=inflight)
+            offset = 0
+            for req in batch:
+                if error is not None:
+                    req.fail(error)
+                    continue
+                n = len(req.queries)
+                req.resolve(results[offset:offset + n])
+                offset += n
+            with self._completions_cond:
+                self._gathering = None

@@ -10,7 +10,8 @@ HTTP frontend lives in ``rafiki_tpu.predictor.app``.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional
+import threading
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -75,6 +76,11 @@ class Predictor:
         # immutable per worker id, and per-request bus.get fan-out
         # would put O(workers) round-trips on the serving hot path.
         self._bins: Dict[str, str] = {}
+        # ThreadingHTTPServer handler threads (batcher-off mode) and
+        # the micro-batcher's scatter thread all route through
+        # _choose_workers; the rr cursor and bin memo are guarded so
+        # concurrent requests can't lose rotations or corrupt the memo.
+        self._state_lock = threading.Lock()
 
     def workers(self) -> List[str]:
         return self.cache.running_workers(self.inference_job_id)
@@ -93,6 +99,9 @@ class Predictor:
             time.sleep(0.2)
 
     def _bin_of(self, worker_id: str) -> str:
+        """Caller holds ``_state_lock``. The memoized bus.get is a
+        round-trip, but only the FIRST request after a worker appears
+        pays it; steady-state requests never leave the memo."""
         bin_id = self._bins.get(worker_id)
         if bin_id is None:
             info = self.cache.bus.get(
@@ -108,52 +117,72 @@ class Predictor:
         ensemble, so each request picks one per bin, rotating across
         requests for load balance. The hot path costs one registry
         keys() scan; per-worker info reads are memoized."""
-        workers = sorted(self._wait_workers())
-        # Prune memo entries for departed workers once the map clearly
-        # outgrows the live set — long-lived predictors otherwise
-        # accumulate a row per worker restart, forever.
-        if len(self._bins) > 2 * len(workers) + 8:
-            live = set(workers)
-            self._bins = {w: b for w, b in self._bins.items()
-                          if w in live}
-        groups: Dict[str, List[str]] = {}
-        for w in workers:
-            groups.setdefault(self._bin_of(w), []).append(w)
-        self._rr += 1
-        return [members[self._rr % len(members)]
-                for _, members in sorted(groups.items())]
+        workers = sorted(self._wait_workers())  # may block; lock-free
+        with self._state_lock:
+            # Prune memo entries for departed workers once the map
+            # clearly outgrows the live set — long-lived predictors
+            # otherwise accumulate a row per worker restart, forever.
+            if len(self._bins) > 2 * len(workers) + 8:
+                live = set(workers)
+                self._bins = {w: b for w, b in self._bins.items()
+                              if w in live}
+            groups: Dict[str, List[str]] = {}
+            for w in workers:
+                groups.setdefault(self._bin_of(w), []).append(w)
+            self._rr += 1
+            return [members[self._rr % len(members)]
+                    for _, members in sorted(groups.items())]
 
-    def predict(self, queries: List[Any]) -> List[Optional[Any]]:
-        """Scatter-gather-ensemble a batch of queries.
+    def predict_submit(self, queries: List[Any], *,
+                       pre_encoded: bool = False,
+                       ) -> Callable[[], List[Optional[Any]]]:
+        """Scatter a batch of queries NOW; returns a finisher that
+        gathers + ensembles when called.
 
         Batch-granular frames: ONE bus message per worker carries the
         whole request, and each worker replies once — the scatter/gather
-        cost is O(workers), not O(queries x workers).
+        cost is O(workers), not O(queries x workers). The split lets the
+        micro-batcher overlap super-batch K's gather with K+1's scatter
+        (the frontend mirror of the worker's one-burst-in-flight trick).
+
+        ``pre_encoded=True`` means the queries are already bus-safe
+        frames (e.g. straight off the HTTP body) — no decode/re-encode
+        round-trip on the hot path.
         """
+        n = len(queries)
+        if not n:
+            return lambda: []
         workers = self._choose_workers()
         if not workers:
             raise RuntimeError(
                 f"no running inference workers for job "
                 f"{self.inference_job_id}")
-        if not queries:
-            return []
-        from ..cache import encode_payload
+        if pre_encoded:
+            encoded = queries
+        else:
+            from ..cache import encode_payload
 
-        encoded = [encode_payload(q) for q in queries]  # once, not per worker
-        batch_id = None
-        for w in workers:
-            batch_id = self.cache.send_query_batch(w, encoded,
-                                                   batch_id=batch_id,
-                                                   pre_encoded=True)
-        replies = self.cache.gather_prediction_batches(
-            batch_id, n_workers=len(workers), timeout=self.gather_timeout)
-        if len(replies) < len(workers):
-            _log.warning("batch %s: %d/%d workers replied", batch_id,
-                         len(replies), len(workers))
-        results: List[Optional[Any]] = []
-        for i in range(len(queries)):
-            live = [r for r in replies if i < len(r["predictions"])]
-            results.append(ensemble_predictions(
-                [r["predictions"][i] for r in live],
-                weights=[int(r.get("weight", 1)) for r in live]))
-        return results
+            encoded = [encode_payload(q) for q in queries]  # once total
+        batch_id = self.cache.send_query_batch_fanout(workers, encoded)
+
+        def finish() -> List[Optional[Any]]:
+            replies = self.cache.gather_prediction_batches(
+                batch_id, n_workers=len(workers),
+                timeout=self.gather_timeout)
+            if len(replies) < len(workers):
+                _log.warning("batch %s: %d/%d workers replied", batch_id,
+                             len(replies), len(workers))
+            results: List[Optional[Any]] = []
+            for i in range(n):
+                live = [r for r in replies if i < len(r["predictions"])]
+                results.append(ensemble_predictions(
+                    [r["predictions"][i] for r in live],
+                    weights=[int(r.get("weight", 1)) for r in live]))
+            return results
+
+        return finish
+
+    def predict(self, queries: List[Any], *,
+                pre_encoded: bool = False) -> List[Optional[Any]]:
+        """Scatter-gather-ensemble a batch of queries (blocking)."""
+        return self.predict_submit(queries, pre_encoded=pre_encoded)()

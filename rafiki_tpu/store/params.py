@@ -12,10 +12,11 @@ Scoping: LOCAL policies resolve within one worker's saves; GLOBAL within
 the whole session (a sub-train-job). Matches upstream's worker-local vs
 cross-worker sharing semantics.
 
-**Write-behind (r5).** ``save`` accepts trees whose leaves are still
-jax device arrays and flushes them to disk on a background writer
-thread (packed single-transfer pull, ``parallel.device_get_tree``),
-with read-your-writes semantics in-process:
+**Write-behind (r5, ordering fixed r6).** ``save`` accepts trees whose
+leaves are still jax device arrays and flushes them to disk on a
+background writer thread (packed single-transfer pull,
+``parallel.device_get_tree``), with read-your-writes semantics
+in-process:
 
 - ``retrieve``/the policy queries see a pending save immediately and
   return the IN-MEMORY tree — for the ENAS weight-sharing loop this
@@ -27,6 +28,18 @@ with read-your-writes semantics in-process:
 - ``load`` (the durable path: serving workers, cross-process readers)
   waits for the flush and then reads the file, keeping its strict
   numpy contract.
+
+The sqlite index row is inserted by the WRITER thread, after
+``save_file`` lands (r5 inserted it in ``save``, so a cross-process
+reader on a shared volume could see the row seconds before the file
+existed and crash on ``FileNotFoundError``). In-process visibility
+during the flush window comes from the ``_pending`` map instead: the
+policy queries merge pending saves (with their session/worker/score
+metadata) into the sqlite candidates. File-then-row also closes the
+``delete``-vs-writer race: the writer re-checks ``_pending`` under the
+lock after the flush and unlinks its own file when the save was
+deleted mid-flight — no orphaned ``.safetensors``, no row without a
+file.
 
 Durability is unchanged in kind: a crash between ``save`` returning
 and the flush landing loses that save — exactly the window a crash
@@ -55,9 +68,12 @@ class ParamStore:
     def __init__(self, params_dir: str):
         self.params_dir = params_dir
         os.makedirs(params_dir, exist_ok=True)
-        # Write-behind state: params_id -> (tree, flushed-event). The
-        # writer thread is started lazily on the first async save.
-        self._pending: Dict[str, Tuple[Params, threading.Event]] = {}
+        # Write-behind state: params_id -> (tree, flushed-event,
+        # index-row values). The writer thread is started lazily on the
+        # first async save; it inserts the index row AFTER the file
+        # lands (module docstring).
+        self._pending: Dict[str, Tuple[Params, threading.Event,
+                                       tuple]] = {}
         self._pending_lock = threading.Lock()
         self._write_queue: "queue.Queue" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
@@ -102,12 +118,13 @@ class ParamStore:
         without any device→host transfer.
         """
         params_id = uuid.uuid4().hex
+        row = (params_id, session_id, worker_id, float(score), time.time())
         async_ok = os.environ.get(
             "RAFIKI_TPU_PARAMS_WRITE_BEHIND", "1") != "0"
         if async_ok and self._has_device_leaves(params):
             event = threading.Event()
             with self._pending_lock:
-                self._pending[params_id] = (dict(params), event)
+                self._pending[params_id] = (dict(params), event, row)
                 if self._writer is None or not self._writer.is_alive():
                     self._writer = threading.Thread(
                         target=self._writer_loop, name="params-writer",
@@ -116,13 +133,15 @@ class ParamStore:
             self._write_queue.put(params_id)
         else:
             self._flush_to_disk(params_id, params)
+            self._insert_row(row)
+        return params_id
+
+    def _insert_row(self, row: tuple) -> None:
         with self._lock:
             self._db.execute(
                 "INSERT INTO params (id, session_id, worker_id, score, "
-                "created_at) VALUES (?, ?, ?, ?, ?)",
-                (params_id, session_id, worker_id, float(score), time.time()))
+                "created_at) VALUES (?, ?, ?, ?, ?)", row)
             self._db.commit()
-        return params_id
 
     @staticmethod
     def _has_device_leaves(params: Params) -> bool:
@@ -151,23 +170,41 @@ class ParamStore:
                 entry = self._pending.get(params_id)
             if entry is None:  # deleted before flush
                 continue
-            tree, event = entry
+            tree, event, row = entry
+            flushed = False
             try:
                 self._flush_to_disk(params_id, tree)
+                flushed = True
             except Exception:  # pragma: no cover - disk full etc.
                 import logging
 
                 logging.getLogger(__name__).exception(
                     "write-behind flush failed for %s", params_id)
-            finally:
-                event.set()
-                with self._pending_lock:
-                    self._pending.pop(params_id, None)
+            # File-then-row, atomically vs delete(): holding the
+            # pending lock across the presence re-check AND the row
+            # insert means a concurrent delete() either ran before (no
+            # entry -> the file we just wrote is ours to unlink) or
+            # runs after (sees the row and the file; removes both).
+            deleted_mid_flight = False
+            with self._pending_lock:
+                if params_id in self._pending:
+                    if flushed:
+                        self._insert_row(row)
+                else:
+                    deleted_mid_flight = True
+            if deleted_mid_flight and flushed:
+                try:
+                    os.remove(self._path(params_id))
+                except FileNotFoundError:  # pragma: no cover
+                    pass
+            event.set()
+            with self._pending_lock:
+                self._pending.pop(params_id, None)
 
     def flush(self, timeout: float = 120.0) -> None:
         """Block until every pending write-behind save is on disk."""
         with self._pending_lock:
-            events = [e for _, e in self._pending.values()]
+            events = [entry[1] for entry in self._pending.values()]
         for e in events:
             e.wait(timeout)
 
@@ -218,7 +255,8 @@ class ParamStore:
             return None
         local = params_type in (ParamsType.LOCAL_RECENT, ParamsType.LOCAL_BEST)
         best = params_type in (ParamsType.LOCAL_BEST, ParamsType.GLOBAL_BEST)
-        sql = "SELECT id FROM params WHERE session_id = ?"
+        sql = ("SELECT id, score, created_at FROM params "
+               "WHERE session_id = ?")
         args = [session_id]
         if local:
             sql += " AND worker_id = ?"
@@ -228,17 +266,30 @@ class ParamStore:
         sql += " LIMIT 1"
         with self._lock:
             row = self._db.execute(sql, tuple(args)).fetchone()
-        if row is None:
+        # Pending write-behind saves are not in the index yet (the
+        # writer thread inserts the row after the file lands), so the
+        # policy compares the sqlite winner against matching pending
+        # candidates — in-process read-your-writes across the flush
+        # window.
+        candidates = [tuple(row)] if row is not None else []
+        with self._pending_lock:
+            for pid, (_, _, prow) in self._pending.items():
+                if prow[1] == session_id and \
+                        (not local or prow[2] == worker_id):
+                    candidates.append((pid, prow[3], prow[4]))
+        if not candidates:
             return None
+        rank = (lambda c: (c[1], c[2])) if best else (lambda c: c[2])
+        winner = max(candidates, key=rank)[0]
         # Read-your-writes fast path: a pending write-behind save is
         # served straight from memory — possibly as device arrays, so
         # an in-process warm start (the ENAS weight-sharing loop) skips
         # BOTH host round-trips.
-        mem = self.get_in_memory(row[0])
+        mem = self.get_in_memory(winner)
         if mem is not None:
             return mem
         try:
-            return self.load(row[0])
+            return self.load(winner)
         except FileNotFoundError:
             # Indexed but evicted (GC, cleanup): absence, not an error —
             # the caller cold-starts, exactly as if nothing was saved.
@@ -247,6 +298,15 @@ class ParamStore:
     def session_params_ids(self, session_id: str) -> list:
         with self._lock:
             rows = self._db.execute(
-                "SELECT id FROM params WHERE session_id = ? "
+                "SELECT id, created_at FROM params WHERE session_id = ? "
                 "ORDER BY created_at", (session_id,)).fetchall()
-        return [r[0] for r in rows]
+        entries = [(r[1], r[0]) for r in rows]
+        # Pending write-behind saves are visible in-process before
+        # their index row lands (same contract as retrieve()).
+        indexed = {pid for _, pid in entries}
+        with self._pending_lock:
+            entries.extend(
+                (prow[4], pid) for pid, (_, _, prow)
+                in self._pending.items()
+                if prow[1] == session_id and pid not in indexed)
+        return [pid for _, pid in sorted(entries)]

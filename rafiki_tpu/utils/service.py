@@ -19,7 +19,8 @@ from urllib.parse import parse_qs, urlparse
 
 _log = logging.getLogger(__name__)
 
-# handler(params: dict, body: dict|None, ctx: RequestContext) -> (status, obj)
+# handler(params: dict, body: dict|None, ctx: RequestContext)
+#   -> (status, obj) or (status, obj, extra_headers)
 Handler = Callable[[Dict[str, str], Optional[Dict[str, Any]],
                     "RequestContext"], Tuple[int, Any]]
 
@@ -47,10 +48,13 @@ class RequestContext:
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        # Extra response headers (e.g. Retry-After on a 429).
+        self.headers = headers
 
 
 class RawResponse:
@@ -147,10 +151,16 @@ class JsonHttpServer:
                     match = pattern.match(parsed.path)
                     if match is None:
                         continue
+                    headers = None
                     try:
-                        status, obj = handler(match.groupdict(), body, ctx)
+                        result = handler(match.groupdict(), body, ctx)
+                        if len(result) == 3:
+                            status, obj, headers = result
+                        else:
+                            status, obj = result
                     except HttpError as e:
                         status, obj = e.status, {"error": e.message}
+                        headers = e.headers
                     except PermissionError as e:
                         status = getattr(e, "status", 401)
                         obj = {"error": str(e)}
@@ -160,11 +170,12 @@ class JsonHttpServer:
                         _log.exception("%s %s failed", method, parsed.path)
                         status, obj = 500, {
                             "error": f"{type(e).__name__}: {e}"}
-                    self._reply(status, obj)
+                    self._reply(status, obj, headers)
                     return
                 self._reply(404, {"error": f"no route {method} {parsed.path}"})
 
-            def _reply(self, status: int, obj: Any):
+            def _reply(self, status: int, obj: Any,
+                       headers: Optional[Dict[str, str]] = None):
                 if isinstance(obj, RawResponse):
                     data, ctype = obj.data, obj.content_type
                 else:
@@ -172,6 +183,8 @@ class JsonHttpServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -59,6 +59,19 @@ class TestBus:
         assert bus.pop_all("q3", timeout=1.0) == [0, 1, 2, 3, 4]
         assert bus.pop_all("q3", timeout=0.05) == []
 
+    def test_push_many_multi_queue_fanout(self, bus):
+        """One call scatters to several queues in order (the serving
+        scatter path). Against the native broker — which predates the
+        batched op — this also exercises the unknown-op fallback."""
+        bus.push_many([("qa", 1), ("qb", {"x": 2}), ("qa", 3)])
+        assert bus.pop("qa", timeout=1.0) == 1
+        assert bus.pop("qa", timeout=1.0) == 3
+        assert bus.pop("qb", timeout=1.0) == {"x": 2}
+        bus.push_many([])  # no-op, must not error
+        # a second call goes down whichever path was negotiated
+        bus.push_many([("qc", "v")])
+        assert bus.pop("qc", timeout=1.0) == "v"
+
     def test_pop_all_max_items(self, bus):
         for i in range(5):
             bus.push("q4", i)

@@ -82,6 +82,54 @@ def test_apply_env_round_trip(monkeypatch):
     assert w.pipeline is False
 
 
+def test_serving_microbatch_knobs(monkeypatch):
+    """Micro-batcher knobs: env parsing, validation bounds, and the
+    apply_env -> PredictorService handoff."""
+    cfg = NodeConfig.from_env(env={})
+    assert cfg.serving_microbatch is True
+    assert cfg.serving_fill_window == 0.005
+    assert cfg.serving_max_inflight == 2
+    cfg = NodeConfig.from_env(env={
+        "RAFIKI_TPU_SERVING_MICROBATCH": "0",
+        "RAFIKI_TPU_SERVING_FILL_WINDOW": "0.02",
+        "RAFIKI_TPU_SERVING_MAX_BATCH": "256",
+        "RAFIKI_TPU_SERVING_MAX_INFLIGHT": "3",
+        "RAFIKI_TPU_SERVING_QUEUE_CAP": "512",
+    })
+    assert cfg.serving_microbatch is False
+    assert cfg.serving_fill_window == 0.02
+    assert cfg.serving_max_batch == 256
+    assert cfg.serving_max_inflight == 3
+    assert cfg.serving_queue_cap == 512
+    with pytest.raises(ValueError, match="serving_fill_window"):
+        NodeConfig.from_env(env={}, serving_fill_window=-0.1)
+    with pytest.raises(ValueError, match="serving_max_batch"):
+        NodeConfig.from_env(env={}, serving_queue_cap=0)
+
+    # apply_env exports the knobs; a PredictorService constructed after
+    # (in-process or spawned) resolves the node's validated values.
+    for var in ("RAFIKI_TPU_SERVING_MICROBATCH",
+                "RAFIKI_TPU_SERVING_FILL_WINDOW",
+                "RAFIKI_TPU_SERVING_MAX_BATCH",
+                "RAFIKI_TPU_SERVING_MAX_INFLIGHT",
+                "RAFIKI_TPU_SERVING_QUEUE_CAP"):
+        monkeypatch.setenv(var, "unset-sentinel")
+    NodeConfig.from_env(env={}, serving_fill_window=0.03,
+                        serving_queue_cap=128).apply_env()
+    import os
+
+    assert os.environ["RAFIKI_TPU_SERVING_MICROBATCH"] == "1"
+    assert os.environ["RAFIKI_TPU_SERVING_FILL_WINDOW"] == "0.03"
+    assert os.environ["RAFIKI_TPU_SERVING_QUEUE_CAP"] == "128"
+    from rafiki_tpu.bus import MemoryBus
+    from rafiki_tpu.predictor.app import PredictorService
+
+    svc = PredictorService("s", "j", None, MemoryBus())
+    assert svc.batcher is not None
+    assert svc.batcher.fill_window == 0.03
+    assert svc.batcher.queue_cap == 128
+
+
 def test_from_config_platform(tmp_path):
     from rafiki_tpu.platform import LocalPlatform
 

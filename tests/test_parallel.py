@@ -240,3 +240,28 @@ def test_device_get_tree_roundtrip():
         assert g.shape == w.shape and g.dtype == w.dtype
         assert np.array_equal(np.asarray(g, np.float64),
                               np.asarray(w, np.float64))
+
+
+def test_device_get_tree_cache_keyed_on_device_leaf_mix():
+    """Two trees with the SAME treedef and coinciding device-leaf
+    (shape, dtype) sequences but a different device/host mix must not
+    share a pack-cache entry (ADVICE r5: the cached groups packed the
+    wrong leaves, leaving None holes in the unflattened tree)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rafiki_tpu.parallel import device_get_tree
+
+    # mix 1: 'a' host, 'b' device — primes the cache
+    t1 = {"a": np.arange(4, dtype=np.float32),
+          "b": jnp.full((4,), 2.0, jnp.float32)}
+    g1 = device_get_tree(t1)
+    np.testing.assert_array_equal(g1["a"], t1["a"])
+    np.testing.assert_array_equal(g1["b"], np.full(4, 2.0, np.float32))
+    # mix 2: identical treedef + device-leaf signature, swapped mix
+    t2 = {"a": jnp.full((4,), 3.0, jnp.float32),
+          "b": np.arange(4, dtype=np.float32)}
+    g2 = device_get_tree(t2)
+    assert g2["a"] is not None and g2["b"] is not None
+    np.testing.assert_array_equal(g2["a"], np.full(4, 3.0, np.float32))
+    np.testing.assert_array_equal(g2["b"], t2["b"])

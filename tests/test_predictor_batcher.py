@@ -1,0 +1,325 @@
+"""Continuous micro-batching in the serving path (predictor/batcher.py).
+
+Real components, no mocks: a MemoryBus, a worker thread speaking the
+cache protocol, the actual PredictorService HTTP frontend. The
+invariants under test are the ones concurrency breaks silently:
+per-request slicing (no cross-request result bleed), bounded admission
+(429 + Retry-After instead of unbounded pileup), and a race-free
+replica rotation.
+"""
+
+import threading
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu.bus import MemoryBus
+from rafiki_tpu.cache import Cache
+from rafiki_tpu.predictor import Backpressure, MicroBatcher, Predictor
+from rafiki_tpu.predictor.app import PredictorService
+
+
+class EchoWorker:
+    """Minimal InferenceWorker stand-in: pops query batches off the bus
+    and replies ``[value, value + 0.5]`` per query (so a reply is
+    attributable to its query). ``delay`` simulates model latency."""
+
+    def __init__(self, bus, worker_id="w1", job_id="job", delay=0.0):
+        self.cache = Cache(bus)
+        self.worker_id = worker_id
+        self.delay = delay
+        self.stop_flag = threading.Event()
+        self.served_batches = 0
+        self.cache.register_worker(job_id, worker_id,
+                                   info={"trial_id": "t1"})
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self.stop_flag.is_set():
+            items = self.cache.pop_queries(self.worker_id, timeout=0.1)
+            for it in items:
+                if self.delay:
+                    time.sleep(self.delay)
+                self.served_batches += 1
+                self.cache.send_prediction_batch(
+                    it["batch_id"], self.worker_id,
+                    [[float(q), float(q) + 0.5] for q in it["queries"]])
+
+    def stop(self):
+        self.stop_flag.set()
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture()
+def bus():
+    return MemoryBus()
+
+
+def _predictor(bus, **kw):
+    kw.setdefault("worker_wait_timeout", 5.0)
+    kw.setdefault("gather_timeout", 5.0)
+    return Predictor("job", bus, **kw)
+
+
+def _service(bus, **kw):
+    """PredictorService on a free port, lifecycle managed by the test
+    (meta is not exercised: the routes under test never touch it)."""
+    svc = PredictorService("svc", "job", meta=None, bus=bus,
+                           host="127.0.0.1", **kw)
+    svc.predictor.worker_wait_timeout = 5.0
+    svc.predictor.gather_timeout = 5.0
+    if svc.batcher is not None:
+        svc.batcher.start()
+    svc._http.start()
+    return svc
+
+
+def _teardown(svc):
+    svc._http.stop()
+    if svc.batcher is not None:
+        svc.batcher.stop()
+
+
+def test_concurrent_predict_no_cross_request_bleed(bus):
+    """N handler threads hammering one PredictorService must each get
+    exactly their own slice of the coalesced super-batch."""
+    worker = EchoWorker(bus)
+    svc = _service(bus)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    results = {}
+    errors = []
+
+    def client(i):
+        try:
+            qs = [i * 100 + j for j in range(1 + i % 4)]  # ragged sizes
+            r = requests.post(url, json={"queries": qs}, timeout=30)
+            r.raise_for_status()
+            results[i] = (qs, r.json()["predictions"])
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(16)]
+    try:
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert not errors, errors
+        assert len(results) == 16
+        for i, (qs, preds) in results.items():
+            assert preds == [[float(q), float(q) + 0.5] for q in qs], \
+                f"client {i} got another request's slice"
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_microbatcher_coalesces_concurrent_requests(bus):
+    """Concurrent submits within one fill window ride ONE scatter-gather
+    super-batch (requests >> batches; worker sees few batch frames)."""
+    worker = EchoWorker(bus)
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window=0.05, max_batch=256,
+                      max_inflight=2, queue_cap=1024).start()
+    try:
+        out = {}
+        barrier = threading.Barrier(12)
+
+        def client(i):
+            barrier.wait()
+            out[i] = mb.submit([i, i + 1000], timeout=15)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert len(out) == 12
+        for i in range(12):
+            assert out[i] == [[float(i), float(i) + 0.5],
+                              [float(i + 1000), float(i + 1000) + 0.5]]
+        snap = mb.stats.snapshot()
+        assert snap["requests"] == 12
+        assert snap["batches"] < 12, "no coalescing happened"
+        assert snap["coalescing_factor"] > 1.5
+        # the worker saw one frame per super-batch, not one per request
+        assert worker.served_batches == snap["batches"]
+    finally:
+        mb.stop()
+        worker.stop()
+
+
+def test_keep_n_in_flight_overlaps_gather_with_next_scatter(bus):
+    """With a slow worker and max_inflight=2, super-batch K+1 must be
+    scattered while K's gather is still blocking."""
+    worker = EchoWorker(bus, delay=0.15)
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window=0.01, max_batch=2,
+                      max_inflight=2, queue_cap=1024).start()
+    try:
+        threads = [threading.Thread(
+            target=lambda i=i: mb.submit([i], timeout=30))
+            for i in range(8)]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        snap = mb.stats.snapshot()
+        assert snap["inflight_peak"] == 2, snap
+    finally:
+        mb.stop()
+        worker.stop()
+
+
+def test_backpressure_returns_429_with_retry_after(bus):
+    """Sustained overload must bounce with 429 + Retry-After while the
+    admission queue stays bounded — not grow latency without bound."""
+    worker = EchoWorker(bus, delay=0.25)  # each super-batch is slow
+    svc = _service(bus, queue_cap=6, max_inflight=1, fill_window=0.01,
+                   max_batch=4)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    codes = []
+    codes_lock = threading.Lock()
+
+    def client(i):
+        r = requests.post(url, json={"queries": [i, i, i]}, timeout=60)
+        with codes_lock:
+            codes.append((r.status_code, r.headers.get("Retry-After"),
+                          r.json()))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(24)]
+    try:
+        [t.start() for t in threads]
+        [t.join(timeout=60) for t in threads]
+        assert len(codes) == 24
+        rejected = [c for c in codes if c[0] == 429]
+        served = [c for c in codes if c[0] == 200]
+        assert rejected, "overload never produced a 429"
+        assert served, "every request was rejected"
+        for status, retry_after, body in rejected:
+            assert retry_after is not None and int(retry_after) >= 1
+            assert body["queue_cap"] == 6
+        # bounded queue: admitted depth never exceeded the cap
+        assert svc.stats.queue_depth_peak <= 6
+        assert svc.stats.rejected == len(rejected)
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_microbatch_disabled_restores_direct_path(bus):
+    """RAFIKI_TPU_SERVING_MICROBATCH=0: no batcher, requests scatter
+    directly — the bench's A/B baseline."""
+    worker = EchoWorker(bus)
+    svc = _service(bus, microbatch=False)
+    url = f"http://127.0.0.1:{svc.port}"
+    try:
+        assert svc.batcher is None
+        r = requests.post(f"{url}/predict", json={"queries": [1, 2]},
+                          timeout=30)
+        assert r.status_code == 200
+        assert r.json()["predictions"] == [[1.0, 1.5], [2.0, 2.5]]
+        stats = requests.get(f"{url}/stats", timeout=10).json()
+        assert stats["microbatch"] is False
+        assert stats["batches"] == 0 and stats["requests"] == 1
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_microbatch_env_toggle(bus, monkeypatch):
+    monkeypatch.delenv("RAFIKI_TPU_SERVING_MICROBATCH", raising=False)
+    assert PredictorService("s", "j", None, bus).batcher is not None
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_MICROBATCH", "0")
+    assert PredictorService("s", "j", None, bus).batcher is None
+    # constructor arg beats env
+    assert PredictorService("s", "j", None, bus,
+                            microbatch=True).batcher is not None
+    # knob envs reach the batcher
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_MICROBATCH", "1")
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_FILL_WINDOW", "0.02")
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_QUEUE_CAP", "99")
+    b = PredictorService("s", "j", None, bus).batcher
+    assert b.fill_window == 0.02 and b.queue_cap == 99
+
+
+def test_choose_workers_race_free(bus):
+    """_rr/_bins are mutated from every handler thread in batcher-off
+    mode; concurrent rotation must lose no increments and the per-bin
+    replica pick must stay valid throughout."""
+    cache = Cache(bus)
+    cache.register_worker("job", "wA1", info={"trial_id": "tA"})
+    cache.register_worker("job", "wA2", info={"trial_id": "tA"})
+    cache.register_worker("job", "wB", info={"trial_id": "tB"})
+    p = _predictor(bus)
+    bad = []
+
+    def spin():
+        for _ in range(50):
+            pick = p._choose_workers()
+            if len(pick) != 2 or "wB" not in pick or \
+                    (("wA1" in pick) == ("wA2" in pick)):
+                bad.append(pick)
+
+    threads = [threading.Thread(target=spin) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join(timeout=30) for t in threads]
+    assert not bad, bad[:3]
+    assert p._rr == 8 * 50, "lost round-robin increments under races"
+
+
+def test_backpressure_exception_fields():
+    e = Backpressure(2.0, depth=10, cap=8)
+    assert e.retry_after == 2.0 and e.depth == 10 and e.cap == 8
+    assert "retry after" in str(e)
+
+
+def test_stop_fails_waiters_fast_and_rejects_late_submits(bus):
+    """stop() must promptly fail BOTH queued requests and already-
+    scattered super-batches (never leave a handler blocked until its
+    full timeout), and submits after stop must raise immediately."""
+    cache = Cache(bus)
+    cache.register_worker("job", "w1", info={"trial_id": "t1"})
+    # no worker thread: scattered batches never get replies
+    p = _predictor(bus, gather_timeout=30.0)
+    mb = MicroBatcher(p, fill_window=0.01, max_batch=2, max_inflight=1,
+                      queue_cap=64).start()
+    outcomes = []
+
+    def client(i):
+        t0 = time.time()
+        try:
+            mb.submit([i], timeout=60)
+            outcomes.append(("ok", time.time() - t0))
+        except RuntimeError as e:
+            outcomes.append((str(e), time.time() - t0))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(4)]
+    [t.start() for t in threads]
+    time.sleep(0.5)  # first batch scattered + in flight, rest queued
+    mb.stop()
+    [t.join(timeout=10) for t in threads]
+    assert len(outcomes) == 4
+    for msg, elapsed in outcomes:
+        assert "micro-batcher stopped" in msg
+        assert elapsed < 15, "waiter hung past stop()"
+    with pytest.raises(RuntimeError, match="stopped"):
+        mb.submit([1], timeout=5)
+
+
+def test_empty_and_oversized_requests(bus):
+    """Empty submit returns []; a single request larger than the whole
+    queue cap is still admitted when the queue is empty (it could never
+    be served otherwise)."""
+    worker = EchoWorker(bus)
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window=0.01, max_batch=4, max_inflight=1,
+                      queue_cap=4).start()
+    try:
+        assert mb.submit([], timeout=5) == []
+        big = list(range(10))  # > queue_cap AND > max_batch
+        out = mb.submit(big, timeout=15)
+        assert out == [[float(q), float(q) + 0.5] for q in big]
+    finally:
+        mb.stop()
+        worker.stop()

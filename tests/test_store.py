@@ -151,3 +151,113 @@ class TestParamStore:
         pstore.delete(pid)
         assert not pstore.exists(pid)
         assert pstore.retrieve(ParamsType.GLOBAL_RECENT, session_id="s") is None
+
+    def test_write_behind_row_lands_after_file(self, pstore):
+        """Cross-process contract (ADVICE r5): the sqlite index row must
+        never exist before its .safetensors file — a shared-volume
+        reader that sees the row and load()s must find the file. The
+        in-process view keeps read-your-writes throughout the flush
+        window via _pending."""
+        import os
+
+        import jax.numpy as jnp
+
+        orig = pstore._flush_to_disk
+        gate = threading.Event()
+
+        def slow_flush(pid, tree):
+            gate.wait(10)
+            orig(pid, tree)
+
+        pstore._flush_to_disk = slow_flush
+        pid = pstore.save({"w": jnp.full((3,), 2.0)}, session_id="wb",
+                          worker_id="w0", score=0.7)
+        try:
+            # flush window: no row, no file — but full in-process
+            # visibility (retrieve + listing + exists)
+            with pstore._lock:
+                n = pstore._db.execute(
+                    "SELECT COUNT(*) FROM params WHERE id = ?",
+                    (pid,)).fetchone()[0]
+            assert n == 0, "index row committed before the file landed"
+            assert not os.path.exists(pstore._path(pid))
+            got = pstore.retrieve(ParamsType.GLOBAL_BEST, session_id="wb")
+            assert got is not None and float(np.asarray(got["w"])[0]) == 2.0
+            assert pstore.session_params_ids("wb") == [pid]
+            assert pstore.exists(pid)
+        finally:
+            gate.set()
+        pstore.flush()
+        assert os.path.exists(pstore._path(pid))
+        with pstore._lock:
+            n = pstore._db.execute(
+                "SELECT COUNT(*) FROM params WHERE id = ?",
+                (pid,)).fetchone()[0]
+        assert n == 1
+        assert pstore.session_params_ids("wb") == [pid]
+        np.testing.assert_array_equal(pstore.load(pid)["w"],
+                                      np.full((3,), 2.0, np.float32))
+
+    def test_write_behind_policy_ranks_pending_against_indexed(self, pstore):
+        """A pending (unflushed) save must compete in the sharing
+        policies exactly as an indexed one: BEST by score, RECENT by
+        creation order."""
+        import jax.numpy as jnp
+
+        mk = lambda v: {"w": np.asarray([v], np.float32)}
+        pstore.save(mk(1.0), session_id="s", worker_id="w0", score=0.9)
+        orig = pstore._flush_to_disk
+        gate = threading.Event()
+        pstore._flush_to_disk = \
+            lambda pid, tree: (gate.wait(10), orig(pid, tree))
+        pstore.save({"w": jnp.full((1,), 2.0)}, session_id="s",
+                    worker_id="w0", score=0.3)
+        try:
+            # RECENT -> the pending save; BEST -> the indexed one
+            got = pstore.retrieve(ParamsType.GLOBAL_RECENT, session_id="s")
+            assert float(np.asarray(got["w"])[0]) == 2.0
+            got = pstore.retrieve(ParamsType.GLOBAL_BEST, session_id="s")
+            assert float(np.asarray(got["w"])[0]) == 1.0
+        finally:
+            gate.set()
+        pstore.flush()
+
+    def test_delete_racing_writer_leaves_no_orphan(self, pstore):
+        """delete() while the writer thread is mid-save_file must leave
+        neither an orphaned .safetensors nor an index row (ADVICE r5:
+        the flushed file used to land after delete's os.remove)."""
+        import os
+        import time
+
+        import jax.numpy as jnp
+
+        orig = pstore._flush_to_disk
+        in_flush = threading.Event()
+        gate = threading.Event()
+
+        def slow_flush(pid, tree):
+            in_flush.set()
+            gate.wait(10)
+            orig(pid, tree)
+
+        pstore._flush_to_disk = slow_flush
+        pid = pstore.save({"w": jnp.zeros((2,))}, session_id="race")
+        assert in_flush.wait(10), "writer never started the flush"
+        pstore.delete(pid)          # mid-save_file
+        gate.set()
+        # delete() already removed pid from _pending, so flush() cannot
+        # wait on it; a follow-up save is processed FIFO after the raced
+        # item — once IT is flushed, the raced item is fully settled.
+        pstore.save({"w": jnp.zeros((2,))}, session_id="race2")
+        pstore.flush()
+        deadline = time.monotonic() + 10
+        while pstore._pending and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not os.path.exists(pstore._path(pid)), \
+            "orphaned .safetensors after delete raced the writer"
+        with pstore._lock:
+            n = pstore._db.execute(
+                "SELECT COUNT(*) FROM params WHERE id = ?",
+                (pid,)).fetchone()[0]
+        assert n == 0
+        assert not pstore.exists(pid)
