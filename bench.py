@@ -534,9 +534,15 @@ def main_serving_concurrent() -> dict:
     micro-batcher (the production default), one with
     ``RAFIKI_TPU_SERVING_MICROBATCH=0`` (the r5 path) — windows
     interleaved A/B/A/B so the ratio measures the batcher, not the
-    box's mood. The batcher job's ``/stats`` coalescing factor and both
-    modes' tail latencies ride the record, so the throughput win is
-    attributable, not asserted.
+    box's mood. The batcher job's ``/stats`` coalescing factor rides
+    the record, so the throughput win is attributable, not asserted.
+
+    Latency reporting (r7): percentiles come from the predictors' OWN
+    ``/metrics`` histograms (``rafiki_tpu_http_request_seconds`` for
+    end-to-end, ``rafiki_tpu_serving_stage_seconds`` per stage) instead
+    of client-side per-request timing — the bench reads the same
+    numbers a production scrape would, at bucket resolution, cumulative
+    over warm + timed windows.
     """
     import tempfile
     import threading
@@ -547,6 +553,8 @@ def main_serving_concurrent() -> dict:
     from rafiki_tpu.config import NodeConfig
     from rafiki_tpu.constants import BudgetOption, TaskType, UserType
     from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.observe.metrics import (histogram_percentiles_ms,
+                                            parse_exposition)
     from rafiki_tpu.platform import LocalPlatform
 
     n_clients, per_request = 8, 4
@@ -568,42 +576,55 @@ def main_serving_concurrent() -> dict:
 
     def one_window(url, batch, duration=None):
         counts = [0] * n_clients
-        lat: list = []
-        lat_lock = threading.Lock()
         errors: list = []
         stop = threading.Event()
 
         def client(i: int) -> None:
             session = requests.Session()
-            my_lat = []
             try:
                 while not stop.is_set():
-                    t0 = time.time()
                     r = session.post(url, json={"queries": batch},
                                      timeout=300)
                     r.raise_for_status()
-                    my_lat.append(time.time() - t0)
                     counts[i] += len(batch)
             except Exception as e:
                 errors.append(e)
                 stop.set()
-            with lat_lock:
-                lat.extend(my_lat)
 
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(n_clients)]
-        t0 = time.time()
+        t0 = time.monotonic()
         for t in threads:
             t.start()
         time.sleep(duration if duration is not None else window_s)
         stop.set()
         for t in threads:
             t.join()
-        elapsed = time.time() - t0
+        elapsed = time.monotonic() - t0
         if errors:
             raise RuntimeError(f"bench client failed: {errors[0]}")
-        lat_ms = np.percentile(np.asarray(lat) * 1e3, [50, 95, 99])
-        return sum(counts) / elapsed, [round(x, 2) for x in lat_ms]
+        return sum(counts) / elapsed
+
+    def server_latency(host, stats):
+        """End-to-end /predict percentiles from the predictor's own
+        /metrics histogram — the number production scrapes read."""
+        metrics = parse_exposition(
+            requests.get(f"http://{host}/metrics", timeout=30).text)
+        return histogram_percentiles_ms(
+            metrics.get("rafiki_tpu_http_request_seconds_bucket", []),
+            service=stats.get("http_service", ""), route="/predict")
+
+    def stage_latency(host, stats):
+        """Per-stage (fill/scatter/gather) percentiles from the
+        unified registry's stage histogram."""
+        metrics = parse_exposition(
+            requests.get(f"http://{host}/metrics", timeout=30).text)
+        buckets = metrics.get("rafiki_tpu_serving_stage_seconds_bucket",
+                              [])
+        return {stage: histogram_percentiles_ms(
+                    buckets, service=stats.get("service", ""),
+                    stage=stage)
+                for stage in ("fill", "scatter", "gather")}
 
     with tempfile.TemporaryDirectory() as tmp:
         train_path, val_path = make_synthetic_image_dataset_compat(
@@ -654,20 +675,21 @@ def main_serving_concurrent() -> dict:
             one_window(url_b, batch, duration=5.0)
             vals_a: list = []
             vals_b: list = []
-            lat_a = lat_b = None
             for _ in range(4):
-                qps, lat = one_window(url_a, batch)
-                if not vals_a or qps > max(vals_a):
-                    lat_a = lat  # tail latency of the BEST window
-                vals_a.append(qps)
-                qps, lat = one_window(url_b, batch)
-                if not vals_b or qps > max(vals_b):
-                    lat_b = lat
-                vals_b.append(qps)
+                vals_a.append(one_window(url_a, batch))
+                vals_b.append(one_window(url_b, batch))
                 if _settled(vals_a) and _settled(vals_b):
                     break
             stats_a = requests.get(f"http://{host_a}/stats",
                                    timeout=30).json()
+            stats_b = requests.get(f"http://{host_b}/stats",
+                                   timeout=30).json()
+            # Server-side histograms (the unified registry), not
+            # client-side re-derivation: bench and production read the
+            # same numbers.
+            lat_a = server_latency(host_a, stats_a)
+            lat_b = server_latency(host_b, stats_b)
+            stages_a = stage_latency(host_a, stats_a)
             admin.stop_inference_job(inf_a)
             admin.stop_inference_job(inf_b)
         finally:
@@ -688,8 +710,12 @@ def main_serving_concurrent() -> dict:
         coalescing_factor=stats_a.get("coalescing_factor"),
         mean_batch_queries=stats_a.get("mean_batch_queries"),
         rejected_429=stats_a.get("rejected"),
+        # From the predictors' /metrics histograms (bucket-resolution,
+        # cumulative over warm + timed windows) — the same series a
+        # production scrape reads.
         latency_ms_p50_p95_p99_on=lat_a,
-        latency_ms_p50_p95_p99_off=lat_b)
+        latency_ms_p50_p95_p99_off=lat_b,
+        stage_ms_p50_p95_p99=stages_a)
 
 
 def main_multitenant() -> dict:

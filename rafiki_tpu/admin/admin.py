@@ -426,6 +426,47 @@ class Admin:
                           ) -> Dict[str, Any]:
         return dict(self._owned_inference_job(inference_job_id, claims))
 
+    def get_inference_job_stats(self, inference_job_id: str,
+                                claims: Optional[Dict[str, Any]] = None,
+                                ) -> Dict[str, Any]:
+        """The job's predictor ``/stats`` snapshot, proxied server-side
+        so the dashboard (same-origin against admin) can render queue
+        depth / coalescing / per-stage latency without CORS and with
+        the same ownership check every other job read gets."""
+        import json as _json
+        from urllib.request import urlopen
+
+        job = self._owned_inference_job(inference_job_id, claims)
+        host = job.get("predictor_host")
+        if not host:
+            raise ValueError(
+                f"inference job {inference_job_id} has no predictor yet")
+        try:
+            with urlopen(f"http://{host}/stats", timeout=5) as resp:
+                stats = _json.loads(resp.read())
+        except OSError as e:
+            raise ValueError(
+                f"predictor at {host} unreachable: {e}") from None
+        stats["inference_job_id"] = inference_job_id
+        return stats
+
+    def get_trace(self, trace_id: str,
+                  claims: Optional[Dict[str, Any]] = None,
+                  ) -> Dict[str, Any]:
+        """Stitch one trace's span events (collected from the service
+        log dir's ``spans.jsonl``) into an ordered timeline — the
+        answer to "why was this /predict slow" as one call."""
+        # Spans carry timing + service/trial ids only; visible to any
+        # authenticated user (the trace id itself is an unguessable
+        # 128-bit capability handed to the caller that issued the
+        # traced request).
+        from ..observe import trace as trace_mod
+
+        log_dir = self.services.log_dir
+        if not log_dir:
+            return {"trace_id": trace_id, "n_spans": 0, "spans": []}
+        return trace_mod.collect_trace(log_dir, trace_id)
+
     def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
         return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
 
@@ -461,6 +502,17 @@ class Admin:
                     node["heartbeat_age_s"] = age
         nodes.setdefault(this_node, {"services": 0,
                                      "heartbeat_age_s": 0.0})
+        # Per-trial chip utilization: the train loop publishes an MFU
+        # gauge into the process registry (resident-runner mode puts
+        # the workers in THIS process; subprocess workers expose the
+        # same series on their own /metrics).
+        from ..observe import metrics as obs_metrics
+
+        mfu: Dict[str, float] = {}
+        gauge = obs_metrics.registry().find("rafiki_tpu_train_mfu_ratio")
+        if gauge is not None:
+            for labels, value in gauge.samples():
+                mfu[labels.get("trial", "(unlabeled)")] = round(value, 4)
         return {
             "n_chips": alloc.n_chips,
             "free_chips": alloc.free_chips,
@@ -468,6 +520,7 @@ class Admin:
             "services_running": by_type,
             "node_id": this_node,
             "nodes": nodes,
+            "mfu": mfu,
         }
 
     # --- User administration (ADMIN-only; enforced by the REST layer) ---

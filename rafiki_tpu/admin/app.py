@@ -16,7 +16,13 @@ route-compatible so reference quickstart scripts port 1:1:
 - ``GET  /trials/<id>/logs``         TrialLog rows
 - ``POST /inference_jobs``           deploy best trials behind a predictor
 - ``GET  /inference_jobs/<id>``      incl. ``predictor_host``
+- ``GET  /inference_jobs/<id>/stats``  predictor serving stats (proxied
+                                     server-side for the dashboard)
 - ``POST /inference_jobs/<id>/stop``
+- ``GET  /trace/<trace_id>``         stitched span timeline of one trace
+- ``GET  /metrics``                  Prometheus exposition (auto-wired
+                                     by ``JsonHttpServer``; no auth,
+                                     like any scrape endpoint)
 - ``POST /datasets``                 upload a dataset file (raw bytes body,
                                      ``?name=&task=&filename=``)
 - ``GET  /datasets``                 list own uploaded datasets
@@ -56,8 +62,11 @@ class AdminApp:
             ("POST", "/inference_jobs", self._create_inference_job),
             ("GET", "/inference_jobs", self._list_inference_jobs),
             ("GET", "/inference_jobs/<job_id>", self._get_inference_job),
+            ("GET", "/inference_jobs/<job_id>/stats",
+             self._inference_job_stats),
             ("POST", "/inference_jobs/<job_id>/stop",
              self._stop_inference_job),
+            ("GET", "/trace/<trace_id>", self._get_trace),
             ("GET", "/users", self._list_users),
             ("POST", "/users/<user_id>/ban", self._ban_user),
             ("GET", "/status", self._status),
@@ -186,6 +195,15 @@ class AdminApp:
     def _list_inference_jobs(self, params, body, ctx):
         claims = self._auth(ctx)
         return 200, self.admin.get_inference_jobs(claims["user_id"])
+
+    def _inference_job_stats(self, params, body, ctx):
+        claims = self._auth(ctx)
+        return 200, self.admin.get_inference_job_stats(params["job_id"],
+                                                       claims=claims)
+
+    def _get_trace(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_trace(params["trace_id"])
 
     def _status(self, params, body, ctx):
         self._auth(ctx)

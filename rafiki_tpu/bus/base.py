@@ -11,6 +11,34 @@ from __future__ import annotations
 import abc
 from typing import Any, List, Optional, Sequence, Tuple
 
+from ..observe import metrics
+
+
+def queue_kind(queue: Optional[str]) -> str:
+    """Low-cardinality label for a queue name: queue names embed uuids
+    (``r:{batch_id}``) and worker ids (``q:{worker_id}``), so metrics
+    label by the serving-protocol KIND, never the raw name."""
+    if not queue:
+        return "other"
+    if queue.startswith("q:"):
+        return "query"
+    if queue.startswith("r:"):
+        return "reply"
+    return "other"
+
+
+def bus_op_histogram() -> Optional["metrics.Histogram"]:
+    """The shared per-op bus latency histogram, or None when metrics
+    are disabled (checked once, at backend construction — not per op).
+    For blocking ``pop``/``pop_all`` the recorded time INCLUDES the
+    time spent waiting for an item to arrive."""
+    if not metrics.metrics_enabled():
+        return None
+    return metrics.registry().histogram(
+        "rafiki_tpu_bus_op_seconds",
+        "Bus operation latency (backend x op x queue kind; blocking "
+        "pops include wait time)")
+
 
 class BaseBus(abc.ABC):
     # --- Queues ---

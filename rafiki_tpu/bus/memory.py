@@ -7,7 +7,7 @@ import time
 from collections import defaultdict, deque
 from typing import Any, List, Optional
 
-from .base import BaseBus
+from .base import BaseBus, bus_op_histogram, queue_kind
 
 
 class MemoryBus(BaseBus):
@@ -34,21 +34,39 @@ class MemoryBus(BaseBus):
         self._cond = threading.Condition(self._lock)
         self._queues: dict = defaultdict(deque)
         self._kv: dict = {}
+        # None when RAFIKI_TPU_METRICS=0 (decided at construction).
+        self._hist = bus_op_histogram()
+
+    def _record(self, op: str, queue: str, t0: float) -> None:
+        if self._hist is not None:
+            self._hist.observe(time.monotonic() - t0, backend="memory",
+                               op=op, kind=queue_kind(queue))
 
     # --- Queues ---
 
     def push(self, queue: str, value: Any) -> None:
+        t0 = time.monotonic()
         with self._cond:
             self._queues[queue].append(value)
             self._cond.notify_all()
+        self._record("push", queue, t0)
 
     def push_many(self, items) -> None:
+        items = list(items)
+        t0 = time.monotonic()
         with self._cond:
             for queue, value in items:
                 self._queues[queue].append(value)
             self._cond.notify_all()
+        self._record("push_many", items[0][0] if items else "", t0)
 
     def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:
+        t0 = time.monotonic()
+        value = self._pop(queue, timeout)
+        self._record("pop", queue, t0)
+        return value
+
+    def _pop(self, queue: str, timeout: float) -> Optional[Any]:
         deadline = time.monotonic() + timeout
         with self._cond:
             while not self._queues[queue]:
@@ -63,8 +81,10 @@ class MemoryBus(BaseBus):
 
     def pop_all(self, queue: str, max_items: int = 0,
                 timeout: float = 0.0) -> List[Any]:
-        first = self.pop(queue, timeout)
+        t0 = time.monotonic()
+        first = self._pop(queue, timeout)
         if first is None:
+            self._record("pop_all", queue, t0)
             return []
         out = [first]
         with self._cond:
@@ -72,6 +92,7 @@ class MemoryBus(BaseBus):
             while q and (max_items == 0 or len(out) < max_items):
                 out.append(q.popleft())
             self._reap(queue)
+        self._record("pop_all", queue, t0)
         return out
 
     def _reap(self, queue: str) -> None:

@@ -129,14 +129,29 @@ def serve_broker(host: str = "127.0.0.1", port: int = 0, *,
     binary exists, Python ``BusServer`` otherwise. Returns the started
     server object (``.uri``, ``.stop()``).
     """
+    from ..observe import metrics
     from .tcp import BusServer
+
+    def _mark(backend: str) -> None:
+        # Which broker actually serves (the auto-pick is otherwise only
+        # in a log line); clients' rafiki_tpu_bus_op_seconds series
+        # carry backend="tcp" either way, so this is the disambiguator.
+        if metrics.metrics_enabled():
+            metrics.registry().gauge(
+                "rafiki_tpu_bus_broker_info",
+                "1 for the broker backend this process started"
+            ).set(1, backend=backend)
 
     if native is None:
         native = NativeBusServer.available()
     if native:
         try:
-            return NativeBusServer(host, port).start()
+            server = NativeBusServer(host, port).start()
+            _mark("native")
+            return server
         except RuntimeError:
             _log.warning("native broker unavailable; using Python broker",
                          exc_info=True)
-    return BusServer(host, port).start()
+    server = BusServer(host, port).start()
+    _mark("python")
+    return server

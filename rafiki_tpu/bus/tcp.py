@@ -19,7 +19,9 @@ import struct
 import threading
 from typing import Any, List, Optional
 
-from .base import BaseBus
+import time
+
+from .base import BaseBus, bus_op_histogram, queue_kind
 from .memory import MemoryBus
 
 _HDR = struct.Struct(">I")
@@ -183,6 +185,11 @@ class BusClient(BaseBus):
         # server, not the transport, decides when a pop gives up.
         self._sock_timeout = timeout
         self._local = threading.local()
+        # One timing site (_call) covers every op against EITHER broker
+        # (Python BusServer or the C++ native one — the client is the
+        # only Python-side hop the native path has). None when
+        # RAFIKI_TPU_METRICS=0, decided at construction.
+        self._hist = bus_op_histogram()
 
     def _sock(self) -> socket.socket:
         sock = getattr(self._local, "sock", None)
@@ -194,6 +201,23 @@ class BusClient(BaseBus):
         return sock
 
     def _call(self, req: dict) -> Any:
+        if self._hist is None:
+            return self._call_inner(req)
+        # push_many carries its queues inside "items"; label by the
+        # first one so the serving scatter records kind="query" exactly
+        # as the memory backend does.
+        queue = req.get("queue")
+        if queue is None and req.get("items"):
+            queue = req["items"][0].get("queue")
+        t0 = time.monotonic()
+        try:
+            return self._call_inner(req)
+        finally:
+            self._hist.observe(
+                time.monotonic() - t0, backend="tcp",
+                op=str(req.get("op")), kind=queue_kind(queue))
+
+    def _call_inner(self, req: dict) -> Any:
         # Retry ONLY when the send itself failed (a stale cached socket —
         # the broker never saw a complete frame, so resending is safe).
         # Once the frame is fully sent, the op may have executed: retrying

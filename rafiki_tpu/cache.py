@@ -11,6 +11,13 @@ a running-worker registry. Same contract here over ``rafiki_tpu.bus``:
 Numpy query payloads (images) are framed as base64 so the bus stays
 JSON-only; tensors at scale never ride the bus — InferenceWorkers decode
 once and batch onto the chip themselves.
+
+Query frames additionally carry the requests' trace contexts under a
+``"_trace"`` envelope key (``observe.trace``): senders inject the
+explicit contexts a micro-batcher collected, or the calling thread's
+ambient context on the direct path. Old frames simply lack the key and
+old consumers ignore it — version skew in either direction degrades to
+"no trace", never a failed query.
 """
 
 from __future__ import annotations
@@ -23,6 +30,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .bus import BaseBus
+from .observe import trace as _trace
 
 
 def encode_payload(value: Any) -> Any:
@@ -38,6 +46,17 @@ def encode_payload(value: Any) -> Any:
     if isinstance(value, (np.integer, np.floating)):
         return value.item()
     return value
+
+
+def _trace_envelope(trace_ctxs: Optional[List] = None) -> Optional[Dict]:
+    """The ``_trace`` field for an outgoing query frame: the explicit
+    contexts when given (micro-batcher scatter), else the calling
+    thread's ambient context (direct predict path), else None (the
+    frame stays byte-identical to a pre-trace frame)."""
+    if trace_ctxs is None:
+        cur = _trace.current()
+        trace_ctxs = [cur] if cur is not None else []
+    return _trace.inject(trace_ctxs)
 
 
 def decode_payload(value: Any) -> Any:
@@ -128,8 +147,11 @@ class Cache:
     def send_query(self, worker_id: str, query: Any,
                    query_id: Optional[str] = None) -> str:
         query_id = query_id or uuid.uuid4().hex
-        self.bus.push(f"q:{worker_id}", {
-            "query_id": query_id, "query": encode_payload(query)})
+        frame = {"query_id": query_id, "query": encode_payload(query)}
+        env = _trace_envelope()
+        if env is not None:
+            frame[_trace.ENVELOPE_KEY] = env
+        self.bus.push(f"q:{worker_id}", frame)
         return query_id
 
     def gather_predictions(self, query_id: str, n_workers: int,
@@ -149,30 +171,43 @@ class Cache:
 
     def send_query_batch(self, worker_id: str, queries: List[Any],
                          batch_id: Optional[str] = None,
-                         pre_encoded: bool = False) -> str:
+                         pre_encoded: bool = False,
+                         trace_ctxs: Optional[List] = None) -> str:
         """``pre_encoded=True`` lets a caller scattering the same batch
         to many workers pay ``encode_payload`` once, not once per
         worker (the serving hot path)."""
         batch_id = batch_id or uuid.uuid4().hex
         if not pre_encoded:
             queries = [encode_payload(q) for q in queries]
-        self.bus.push(f"q:{worker_id}", {
-            "batch_id": batch_id, "queries": queries})
+        frame = {"batch_id": batch_id, "queries": queries}
+        env = _trace_envelope(trace_ctxs)
+        if env is not None:
+            frame[_trace.ENVELOPE_KEY] = env
+        self.bus.push(f"q:{worker_id}", frame)
         return batch_id
 
     def send_query_batch_fanout(self, worker_ids: List[str],
                                 encoded_queries: List[Any],
-                                batch_id: Optional[str] = None) -> str:
+                                batch_id: Optional[str] = None,
+                                trace_ctxs: Optional[List] = None) -> str:
         """Scatter ONE pre-encoded batch to every worker in one bus
         call (``push_many``). The encoded payload list is SHARED across
         the per-worker frames — encode once, serialize per queue, no
         per-worker deep copies; only the outer frame dict is fresh per
         worker (consumers decode by *replacing* the ``queries`` key, so
-        the shared list itself is never mutated)."""
+        the shared list itself is never mutated). ``trace_ctxs`` are
+        the coalesced requests' trace contexts (the shared ``_trace``
+        envelope rides every per-worker frame)."""
         batch_id = batch_id or uuid.uuid4().hex
-        self.bus.push_many([
-            (f"q:{w}", {"batch_id": batch_id, "queries": encoded_queries})
-            for w in worker_ids])
+        env = _trace_envelope(trace_ctxs)
+        frames = []
+        for w in worker_ids:
+            frame: Dict[str, Any] = {"batch_id": batch_id,
+                                     "queries": encoded_queries}
+            if env is not None:
+                frame[_trace.ENVELOPE_KEY] = env
+            frames.append((f"q:{w}", frame))
+        self.bus.push_many(frames)
         return batch_id
 
     def gather_prediction_batches(self, batch_id: str, n_workers: int,

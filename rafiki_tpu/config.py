@@ -79,6 +79,13 @@ class NodeConfig:
     serving_queue_cap: int = 4096          # admission bound (queries);
     #                                        beyond it: 429 + Retry-After
 
+    # --- Observability (docs/observability.md) ---
+    metrics: bool = True                   # /metrics route + bus/http
+    #                                        instrumentation wiring
+    trace_sample: float = 1.0              # fresh-trace sample rate 0..1
+    #                                        (incoming X-Trace-Id always
+    #                                        honored)
+
     # Fields whose env names predate this layer (back-compat).
     _ENV_MAP = {
         "serving_pipeline": "RAFIKI_TPU_SERVING_PIPELINE",
@@ -183,6 +190,8 @@ class NodeConfig:
                 or self.serving_queue_cap < 1:
             raise ValueError("serving_max_batch, serving_max_inflight "
                              "and serving_queue_cap must be >= 1")
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ValueError("trace_sample must be within [0, 1]")
         if self.log_level.upper() not in (
                 "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
             raise ValueError(f"unknown log_level {self.log_level!r}")
@@ -217,3 +226,9 @@ class NodeConfig:
         for f in ("serving_fill_window", "serving_max_batch",
                   "serving_max_inflight", "serving_queue_cap"):
             os.environ[self.env_name(f)] = str(getattr(self, f))
+        # Observability: the /metrics route and bus/http instrumentation
+        # check RAFIKI_TPU_METRICS at construction; the trace edges read
+        # RAFIKI_TPU_TRACE_SAMPLE per request.
+        os.environ[self.env_name("metrics")] = \
+            "1" if self.metrics else "0"
+        os.environ[self.env_name("trace_sample")] = str(self.trace_sample)

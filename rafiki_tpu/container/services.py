@@ -124,6 +124,7 @@ def _build_advisor_service(service_id: str, sub_id: str,
 def main() -> None:
     """Subprocess entrypoint: build from os.environ, run in the
     foreground until the process is signalled."""
+    import logging
     import os
     import signal
 
@@ -138,15 +139,51 @@ def main() -> None:
     # thread-bound handler is for resident-runner mode).
     env = dict(os.environ)
     if env.get(EnvVars.LOG_DIR):
+        from ..observe import trace
         from ..utils.service_logs import attach_process_log, \
             service_log_path
 
         attach_process_log(service_log_path(
             env[EnvVars.LOG_DIR], env[EnvVars.SERVICE_ID]))
+        # Span sink: the SHARED <log_dir>/spans.jsonl (O_APPEND lines
+        # interleave safely with the admin process and sibling
+        # services), so Admin.get_trace sees this worker's spans.
+        trace.configure(env[EnvVars.LOG_DIR])
         # The root FileHandler above now owns the file; dropping the
         # env var stops build_service from ALSO binding the thread-
         # routing handler to it (every record would land twice).
         env.pop(EnvVars.LOG_DIR)
+    # Worker runners (train/inference) have no HTTP surface of their
+    # own; RAFIKI_TPU_METRICS_PORT starts a metrics-only JsonHttpServer
+    # so every subprocess/docker service is scrapable. Port 0 picks a
+    # free port (logged); the resident runner doesn't need this — the
+    # admin frontend already exposes the shared process registry.
+    metrics_port = env.get("RAFIKI_TPU_METRICS_PORT")
+    if metrics_port is not None and metrics_port != "":
+        from ..observe import metrics as obs_metrics
+
+        if not obs_metrics.metrics_enabled():
+            # RAFIKI_TPU_METRICS=0 suppresses the /metrics route, so a
+            # server here would answer 404 to the very scrape the port
+            # was configured for — refuse loudly instead.
+            logging.getLogger(__name__).warning(
+                "RAFIKI_TPU_METRICS_PORT=%s ignored: RAFIKI_TPU_METRICS "
+                "disables metrics for this process", metrics_port)
+        else:
+            try:
+                server = obs_metrics.serve_metrics(
+                    port=int(metrics_port),
+                    name=f"metrics-{env.get(EnvVars.SERVICE_ID, '?')[:8]}")
+                logging.getLogger(__name__).info(
+                    "metrics server on port %d", server.port)
+            except (OSError, ValueError):
+                # A node-wide fixed port collides when several services
+                # share one host (or the value is garbage): metrics are
+                # a convenience and must degrade to "none", never kill
+                # the worker before it starts.
+                logging.getLogger(__name__).warning(
+                    "metrics server on port %s unavailable; continuing "
+                    "without", metrics_port, exc_info=True)
     service = build_service(env)
     stop = getattr(service, "stop", None)
     if stop is not None:

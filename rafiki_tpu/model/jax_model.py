@@ -41,6 +41,7 @@ from flax import traverse_util
 from flax.training import train_state
 
 from ..observe import MfuMeter, flops_of_compiled, flops_of_lowered
+from ..observe import metrics as _obs_metrics
 from ..parallel import (batch_sharding, build_mesh, device_get_tree,
                         replicated,
                         shard_variables)
@@ -490,6 +491,19 @@ class JaxModel(BaseModel):
             return exe(state, data, labels, sels, idxs, extra)
 
         meter = MfuMeter(entry.get("flops"), n_devices=mesh.size)
+        # Registry metrics: per-step wall time and a periodically
+        # published MFU gauge, labeled with whatever the caller bound
+        # (the TrialRunner binds trial=<id>, so the admin's /status and
+        # the dashboard can surface chip utilization per trial).
+        _mlabels = _obs_metrics.bound_labels()
+        _reg = _obs_metrics.registry()
+        _step_hist = _reg.histogram(
+            "rafiki_tpu_train_step_seconds",
+            "Optimizer step wall time (chunk time / steps per chunk)")
+        _mfu_gauge = _reg.gauge(
+            "rafiki_tpu_train_mfu_ratio",
+            "Model-FLOPs-utilization of the trial's chip group "
+            "(published per epoch)")
 
         early_stop = int(self.knobs.get("early_stop_epochs", 0))
         best_loss, bad_epochs = float("inf"), 0
@@ -533,6 +547,7 @@ class JaxModel(BaseModel):
             ep_loss, ep_acc, nw = 0.0, 0.0, 0
             s = 0
             while s < steps_per_epoch:
+                t_chunk = time.monotonic()
                 k = min(chunk_steps, steps_per_epoch - s)
                 sel = sel_all[s:s + k]
                 rep = replicated(mesh)
@@ -565,6 +580,10 @@ class JaxModel(BaseModel):
                     compiled_this_call[0] = False
                     meter.reset()
                 loss_acc = np.asarray(metrics)  # single D2H per chunk
+                # The asarray above is the chunk's real sync point, so
+                # the elapsed time is honest per-step wall time.
+                _step_hist.observe(
+                    (time.monotonic() - t_chunk) / k, **_mlabels)
                 ep_loss += float(loss_acc[0]) * k
                 ep_acc += float(loss_acc[1]) * k
                 nw += k
@@ -572,6 +591,8 @@ class JaxModel(BaseModel):
             ep_acc /= max(nw, 1)
             util = {"chip_util": round(meter.mfu, 6)} \
                 if meter.mfu is not None else {}
+            if meter.mfu is not None:
+                _mfu_gauge.set(meter.mfu, **_mlabels)
             logger.log(epoch=epoch, loss=ep_loss, train_acc=ep_acc,
                        steps_per_sec=(step - start_epoch * steps_per_epoch)
                        / (time.time() - t0), **util)

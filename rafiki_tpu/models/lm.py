@@ -357,6 +357,14 @@ class JaxTransformerLM(BaseModel):
                 meter.reset()
             util = ({"chip_util": round(meter.mfu, 6)}
                     if meter.mfu is not None else {})
+            if meter.mfu is not None:
+                from ..observe import metrics as _obs_metrics
+
+                _obs_metrics.registry().gauge(
+                    "rafiki_tpu_train_mfu_ratio",
+                    "Model-FLOPs-utilization of the trial's chip group "
+                    "(published per epoch)").set(
+                        meter.mfu, **_obs_metrics.bound_labels())
             logger.log(step=done, loss=float(loss_acc[0]),
                        token_acc=float(loss_acc[1]), **util)
         # Params stay DEVICE-RESIDENT: pulling 1.9 GB back to the host

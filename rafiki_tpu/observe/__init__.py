@@ -1,16 +1,37 @@
-"""Observability: tracing, profiling, and chip-utilization metering.
+"""Observability: metrics plane, request tracing, profiling, MFU.
 
 Parity+: SURVEY.md §5 "Tracing / profiling" — the reference has no
-first-party tracer (models used TF/Torch profilers ad hoc); the TPU-native
-rebuild makes tracing and utilization first-class: `jax.profiler` trace
-sessions per trial and an MFU (model FLOPs utilization) meter feeding the
-north-star "≥90% chip utilization" metric (BASELINE.md).
+first-party observability; the TPU-native rebuild makes it first-class:
+
+- ``observe.metrics`` — process-wide counter/gauge/histogram registry
+  with Prometheus text exposition; every ``JsonHttpServer`` service
+  exposes it on ``GET /metrics`` for free.
+- ``observe.trace`` — Dapper-style trace ids minted at the HTTP edges,
+  carried in bus envelopes, recorded as JSONL span events and stitched
+  by the admin's ``GET /trace/<id>``.
+- ``observe.profiling`` — per-trial ``jax.profiler`` trace sessions and
+  the MFU (model-FLOPs-utilization) meter feeding the north-star
+  "≥90% chip utilization" metric (BASELINE.md).
+- ``observe.serving`` — the serving frontend's counters, folded into
+  the metrics registry (``/stats`` and ``/metrics`` read one source).
+
+``metrics``/``trace``/``serving`` are stdlib-only; the profiling
+symbols load lazily so a bus broker or metrics scrape never imports
+jax.
 """
 
-from .profiling import (MfuMeter, device_peak_flops, flops_of_compiled,
-                        flops_of_lowered, trace_session, trial_trace_dir)
+from . import metrics, trace
 from .serving import ServingStats
 
-__all__ = ["trace_session", "trial_trace_dir", "device_peak_flops",
-           "flops_of_lowered", "flops_of_compiled", "MfuMeter",
-           "ServingStats"]
+_PROFILING = ("MfuMeter", "device_peak_flops", "flops_of_compiled",
+              "flops_of_lowered", "trace_session", "trial_trace_dir")
+
+__all__ = ["metrics", "trace", "ServingStats", *_PROFILING]
+
+
+def __getattr__(name):
+    if name in _PROFILING:
+        from . import profiling
+
+        return getattr(profiling, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -125,9 +125,9 @@ def _cpu_peak_flops_estimate() -> float:
         _np.asarray(mm(x))  # compile + warm
         best = float("inf")
         for _ in range(3):
-            t0 = time.time()
+            t0 = time.perf_counter()
             _np.asarray(mm(x))  # np.asarray forces a real sync
-            best = min(best, time.time() - t0)
+            best = min(best, time.perf_counter() - t0)
         peak = 2 * n ** 3 / max(best, 1e-9)
         _cpu_peak_cache.append(peak)
         return peak
@@ -182,7 +182,10 @@ class MfuMeter:
         self.peak = (peak_flops_per_device * n_devices
                      if peak_flops_per_device else None)
         self.n_steps = 0
-        self._t0 = time.time()
+        # Monotonic: elapsed-time math must survive a wall-clock step
+        # (NTP slew/jump would otherwise produce negative or inflated
+        # rates mid-trial).
+        self._t0 = time.monotonic()
 
     def tick(self, n_steps: int = 1) -> None:
         self.n_steps += n_steps
@@ -191,11 +194,11 @@ class MfuMeter:
         """Restart the measurement window (e.g. after the first-step
         XLA compile, which is not part of steady-state utilization)."""
         self.n_steps = 0
-        self._t0 = time.time()
+        self._t0 = time.monotonic()
 
     @property
     def elapsed(self) -> float:
-        return time.time() - self._t0
+        return time.monotonic() - self._t0
 
     @property
     def achieved_flops(self) -> Optional[float]:

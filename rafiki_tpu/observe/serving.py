@@ -1,4 +1,4 @@
-"""Serving-path counters: queue depth, coalescing, per-stage latency.
+"""Serving-path counters, folded into the unified metrics registry.
 
 The micro-batcher (``rafiki_tpu.predictor.batcher``) turns many
 concurrent ``/predict`` requests into few scatter-gather super-batches;
@@ -6,85 +6,138 @@ whether that is WORKING is invisible from throughput alone. These
 counters make it measurable: how full the admission queue runs, how many
 requests each super-batch coalesced (the fill ratio), how long each
 stage (fill wait / scatter / gather) takes, and how often backpressure
-fired. The predictor frontend exposes a snapshot on ``GET /stats`` and
-the ``serving-concurrent`` bench records it next to QPS, so a throughput
-win can be attributed to coalescing rather than asserted.
+fired.
 
-Same spirit as the MFU meter in ``observe.profiling``: cheap enough to
-always be on (a lock and a few adds per super-batch, not per query).
+r6 grew this as a bespoke dict; it is now a facade over
+``observe.metrics`` — every number lives in the process registry under
+``rafiki_tpu_serving_*`` (labeled by the frontend's short service id,
+so two predictors in one resident-runner process stay separable) and
+``GET /stats`` and ``GET /metrics`` read the SAME source. ``snapshot``
+keeps its r6 shape (the bench and dashboard consume it) and adds
+bucket-derived p50/p95 per stage.
+
+Still cheap enough to always be on: a lock and a few adds per
+super-batch, not per query.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+import uuid
+from typing import Dict, List, Optional
+
+from . import metrics
+
+_STAGES = ("fill", "scatter", "gather")
 
 
-class _StageClock:
-    """Count / total / max seconds for one pipeline stage."""
-
-    __slots__ = ("count", "total_s", "max_s")
-
-    def __init__(self):
-        self.count = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
-
-    def record(self, seconds: float) -> None:
-        self.count += 1
-        self.total_s += seconds
-        self.max_s = max(self.max_s, seconds)
-
-    def snapshot(self) -> Dict[str, float]:
-        return {
-            "count": self.count,
-            "mean_ms": round(self.total_s / self.count * 1e3, 3)
-            if self.count else 0.0,
-            "max_ms": round(self.max_s * 1e3, 3),
-        }
+def _reg():
+    r = metrics.registry()
+    return {
+        "requests": r.counter(
+            "rafiki_tpu_serving_requests_total",
+            "Requests admitted by the serving frontend"),
+        "queries": r.counter(
+            "rafiki_tpu_serving_queries_total",
+            "Queries admitted by the serving frontend"),
+        "rejected": r.counter(
+            "rafiki_tpu_serving_rejected_total",
+            "Requests bounced with 429 backpressure"),
+        "batches": r.counter(
+            "rafiki_tpu_serving_batches_total",
+            "Super-batches dispatched"),
+        "batched_requests": r.counter(
+            "rafiki_tpu_serving_batched_requests_total",
+            "Requests carried by dispatched super-batches"),
+        "batched_queries": r.counter(
+            "rafiki_tpu_serving_batched_queries_total",
+            "Queries carried by dispatched super-batches"),
+        "queue_depth": r.gauge(
+            "rafiki_tpu_serving_queue_depth_queries",
+            "Queries currently admitted and unsent"),
+        "inflight": r.gauge(
+            "rafiki_tpu_serving_inflight_batches",
+            "Super-batches scattered but not yet gathered"),
+        "stage": r.histogram(
+            "rafiki_tpu_serving_stage_seconds",
+            "Per-super-batch stage latency (stage=fill|scatter|gather)"),
+    }
 
 
 class ServingStats:
-    """Thread-safe counters for one predictor frontend.
+    """Thread-safe counters for one predictor frontend, backed by the
+    process metrics registry under a per-instance ``service`` label.
 
     ``requests``/``queries`` count admissions; ``rejected`` counts
     backpressure 429s; ``batches``/``batched_requests``/``batched_queries``
     describe dispatched super-batches (their ratio is the coalescing
-    factor); ``fill``/``scatter``/``gather`` are per-super-batch stage
-    clocks; ``queue_depth``/``inflight`` are point-in-time gauges set by
-    the batcher.
+    factor); ``fill``/``scatter``/``gather`` land in the
+    ``rafiki_tpu_serving_stage_seconds`` histogram;
+    ``queue_depth``/``inflight`` are point-in-time gauges set by the
+    batcher. Peaks and per-stage maxima are per-instance extras (a
+    Prometheus gauge has no native peak), kept here for ``snapshot``.
     """
 
-    def __init__(self):
+    def __init__(self, service: Optional[str] = None):
+        # The label must be per-instance unique within the process, or
+        # two frontends' series would merge in the registry and each
+        # instance's snapshot would read the other's traffic.
+        self.service = service or f"svc-{uuid.uuid4().hex[:8]}"
+        self._m = _reg()
         self._lock = threading.Lock()
-        self.requests = 0
-        self.queries = 0
-        self.rejected = 0
-        self.batches = 0
-        self.batched_requests = 0
-        self.batched_queries = 0
-        self.queue_depth = 0        # queries currently admitted, unsent
         self.queue_depth_peak = 0
-        self.inflight = 0           # super-batches scattered, ungathered
         self.inflight_peak = 0
-        self.fill = _StageClock()
-        self.scatter = _StageClock()
-        self.gather = _StageClock()
+        self._stage_max: Dict[str, float] = {s: 0.0 for s in _STAGES}
+
+    # --- Registry-backed reads (keep the r6 attribute surface) ---
+
+    def _count(self, key: str) -> int:
+        return int(self._m[key].value(service=self.service))
+
+    @property
+    def requests(self) -> int:
+        return self._count("requests")
+
+    @property
+    def queries(self) -> int:
+        return self._count("queries")
+
+    @property
+    def rejected(self) -> int:
+        return self._count("rejected")
+
+    @property
+    def batches(self) -> int:
+        return self._count("batches")
+
+    @property
+    def batched_requests(self) -> int:
+        return self._count("batched_requests")
+
+    @property
+    def batched_queries(self) -> int:
+        return self._count("batched_queries")
+
+    @property
+    def queue_depth(self) -> int:
+        return self._count("queue_depth")
+
+    @property
+    def inflight(self) -> int:
+        return self._count("inflight")
 
     # --- Admission ---
 
     def admitted(self, n_queries: int) -> None:
-        with self._lock:
-            self.requests += 1
-            self.queries += n_queries
+        self._m["requests"].inc(service=self.service)
+        self._m["queries"].inc(n_queries, service=self.service)
 
     def backpressured(self) -> None:
-        with self._lock:
-            self.rejected += 1
+        self._m["rejected"].inc(service=self.service)
 
     def set_queue_depth(self, n_queries: int) -> None:
+        self._m["queue_depth"].set(n_queries, service=self.service)
         with self._lock:
-            self.queue_depth = n_queries
             self.queue_depth_peak = max(self.queue_depth_peak, n_queries)
 
     # --- Super-batch lifecycle ---
@@ -92,48 +145,84 @@ class ServingStats:
     def dispatched(self, n_requests: int, n_queries: int,
                    fill_s: float, scatter_s: float,
                    inflight: Optional[int] = None) -> None:
-        with self._lock:
-            self.batches += 1
-            self.batched_requests += n_requests
-            self.batched_queries += n_queries
-            self.fill.record(fill_s)
-            self.scatter.record(scatter_s)
-            if inflight is not None:
-                self.inflight = inflight
+        self._m["batches"].inc(service=self.service)
+        self._m["batched_requests"].inc(n_requests, service=self.service)
+        self._m["batched_queries"].inc(n_queries, service=self.service)
+        self._observe_stage("fill", fill_s)
+        self._observe_stage("scatter", scatter_s)
+        if inflight is not None:
+            self._m["inflight"].set(inflight, service=self.service)
+            with self._lock:
                 self.inflight_peak = max(self.inflight_peak, inflight)
 
     def gathered(self, gather_s: float,
                  inflight: Optional[int] = None) -> None:
+        self._observe_stage("gather", gather_s)
+        if inflight is not None:
+            self._m["inflight"].set(inflight, service=self.service)
+
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        self._m["stage"].observe(seconds, service=self.service,
+                                 stage=stage)
         with self._lock:
-            self.gather.record(gather_s)
-            if inflight is not None:
-                self.inflight = inflight
+            self._stage_max[stage] = max(self._stage_max[stage], seconds)
+
+    def close(self) -> None:
+        """Drop this frontend's series from the shared registry. The
+        label is per-instance, so a long-lived resident runner that
+        deploys/stops predictors repeatedly would otherwise grow the
+        registry (and every /metrics payload) one label set per
+        deployment, forever."""
+        for m in self._m.values():
+            m.remove(service=self.service)
 
     # --- Reporting ---
 
+    def _stage_snapshot(self, stage: str) -> Dict[str, float]:
+        hist = self._m["stage"]
+        count = hist.count(service=self.service, stage=stage)
+        total = hist.sum(service=self.service, stage=stage)
+
+        def ms(v: Optional[float]) -> float:
+            return round(v * 1e3, 3) if v is not None else 0.0
+
+        return {
+            "count": count,
+            "mean_ms": ms(total / count) if count else 0.0,
+            "max_ms": ms(self._stage_max[stage]),
+            "p50_ms": ms(hist.percentile(0.5, service=self.service,
+                                         stage=stage)) if count else 0.0,
+            "p95_ms": ms(hist.percentile(0.95, service=self.service,
+                                         stage=stage)) if count else 0.0,
+        }
+
     def snapshot(self) -> Dict[str, object]:
-        with self._lock:
-            return {
-                "requests": self.requests,
-                "queries": self.queries,
-                "rejected": self.rejected,
-                "batches": self.batches,
-                "batched_requests": self.batched_requests,
-                "batched_queries": self.batched_queries,
-                # requests folded into each super-batch on average: 1.0
-                # = no cross-request coalescing happened, N = N requests
-                # rode one scatter-gather.
-                "coalescing_factor": round(
-                    self.batched_requests / self.batches, 3)
-                if self.batches else None,
-                "mean_batch_queries": round(
-                    self.batched_queries / self.batches, 2)
-                if self.batches else None,
-                "queue_depth": self.queue_depth,
-                "queue_depth_peak": self.queue_depth_peak,
-                "inflight": self.inflight,
-                "inflight_peak": self.inflight_peak,
-                "fill": self.fill.snapshot(),
-                "scatter": self.scatter.snapshot(),
-                "gather": self.gather.snapshot(),
-            }
+        batches = self.batches
+        batched_requests = self.batched_requests
+        batched_queries = self.batched_queries
+        return {
+            "service": self.service,
+            "requests": self.requests,
+            "queries": self.queries,
+            "rejected": self.rejected,
+            "batches": batches,
+            "batched_requests": batched_requests,
+            "batched_queries": batched_queries,
+            # requests folded into each super-batch on average: 1.0
+            # = no cross-request coalescing happened, N = N requests
+            # rode one scatter-gather.
+            "coalescing_factor": round(batched_requests / batches, 3)
+            if batches else None,
+            "mean_batch_queries": round(batched_queries / batches, 2)
+            if batches else None,
+            "queue_depth": self.queue_depth,
+            "queue_depth_peak": self.queue_depth_peak,
+            "inflight": self.inflight,
+            "inflight_peak": self.inflight_peak,
+            "fill": self._stage_snapshot("fill"),
+            "scatter": self._stage_snapshot("scatter"),
+            "gather": self._stage_snapshot("gather"),
+        }
+
+
+__all__: List[str] = ["ServingStats"]

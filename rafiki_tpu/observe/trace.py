@@ -1,0 +1,365 @@
+"""End-to-end request tracing: trace ids across HTTP edge, bus, worker.
+
+Dapper-shaped, sized for this system: a trace id is minted (or adopted
+from an ``X-Trace-Id`` request header) at the admin/predictor HTTP
+edges, carried thread-locally through the handler, captured by the
+micro-batcher at admission, injected into the bus message envelope at
+scatter (``"_trace"`` key — old frames simply lack it, old consumers
+ignore it: both directions of the version skew degrade to "no trace"),
+and recovered by the inference worker on the far side of the bus.
+
+Span *events* are flat JSONL lines appended to one shared file per log
+dir (``<log_dir>/spans.jsonl`` — the same directory
+``utils/service_logs`` gives every service), written with O_APPEND
+semantics so resident-runner threads and subprocess services
+interleave whole lines. ``Admin.get_trace`` (``GET /trace/<id>``)
+stitches the file's lines for one trace id into an ordered timeline —
+"why was this /predict slow" is one curl.
+
+Knobs: ``RAFIKI_TPU_TRACE_SAMPLE`` (0..1, default 1.0) samples freshly
+minted traces at the edge; a request that ARRIVES with a trace id is
+always honored (the caller already decided to trace it). Sampling out
+costs nothing downstream — no context means no envelope field and no
+span writes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional
+
+_log = logging.getLogger(__name__)
+
+TRACE_SAMPLE_ENV = "RAFIKI_TPU_TRACE_SAMPLE"
+TRACE_MAX_MB_ENV = "RAFIKI_TPU_TRACE_MAX_MB"
+TRACE_HEADER = "X-Trace-Id"
+
+#: Envelope key inside bus message frames. Absent on old frames (the
+#: backward-compatible fallback: extract() returns no contexts) and
+#: ignored by old consumers (frame readers key on "query"/"queries").
+ENVELOPE_KEY = "_trace"
+
+#: A super-batch coalesces many requests; the envelope carries at most
+#: this many of their contexts (the worker records one span event per
+#: carried trace).
+MAX_ENVELOPE_TRACES = 32
+
+SPAN_FILE = "spans.jsonl"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class TraceContext:
+    """One request's position in its trace: the trace id plus the
+    CURRENT span id (children parent onto it)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: Optional[str] = None,
+                 parent_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id or new_span_id()
+        self.parent_id = parent_id
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, parent_id=self.span_id)
+
+    def header_value(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"TraceContext({self.trace_id[:8]}…/{self.span_id})"
+
+
+# --- Thread-local current context ------------------------------------
+
+_local = threading.local()
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_local, "ctx", None)
+
+
+class use:
+    """``with trace.use(ctx): ...`` — bind/restore the thread's current
+    context. ``ctx=None`` clears for the duration."""
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prior = current()
+        _local.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _local.ctx = self._prior
+        return False
+
+
+def sample_rate() -> float:
+    raw = os.environ.get(TRACE_SAMPLE_ENV, "").strip()
+    if not raw:
+        return 1.0
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return 1.0
+
+
+_HEADER_RE = None
+
+
+def start_trace(header: Optional[str] = None) -> Optional[TraceContext]:
+    """Context for one incoming edge request. An ``X-Trace-Id`` header
+    is always honored: our own ``<32hex>-<16hex>`` format splits into
+    trace + parent span; ANY other non-empty value (a dashed UUID, an
+    opaque upstream id) is taken whole as the trace id — splitting at
+    a dash would silently truncate standard ``str(uuid4())`` ids.
+    Otherwise a fresh trace is minted subject to the sample rate
+    (None = sampled out)."""
+    global _HEADER_RE
+    if header and header.strip():
+        import re
+
+        if _HEADER_RE is None:
+            _HEADER_RE = re.compile(
+                r"^([0-9a-fA-F]{32})-([0-9a-fA-F]{16})$")
+        value = header.strip()
+        match = _HEADER_RE.match(value)
+        if match:
+            return TraceContext(match.group(1),
+                                parent_id=match.group(2))
+        return TraceContext(value)
+    rate = sample_rate()
+    if rate <= 0.0 or (rate < 1.0 and random.random() >= rate):
+        return None
+    return TraceContext(new_trace_id())
+
+
+# --- Envelope carry (bus frames) --------------------------------------
+
+def inject(ctxs: Iterable[Optional[TraceContext]]) -> Optional[Dict]:
+    """Envelope field for a bus frame carrying these requests' traces,
+    or None when nothing is traced (the frame then looks exactly like
+    an old frame)."""
+    ids = [[c.trace_id, c.span_id] for c in ctxs
+           if c is not None][:MAX_ENVELOPE_TRACES]
+    if not ids:
+        return None
+    return {"ids": ids}
+
+
+def extract(frame: Any) -> List[TraceContext]:
+    """Pop the trace envelope off a bus frame dict. Old frames (no
+    ``_trace`` key) and malformed envelopes return ``[]`` — tracing
+    must never fail a query.
+
+    The returned contexts CONTINUE the propagated spans (same span id),
+    so a consumer's ``record_event(child=True)`` parents its span onto
+    the span that sent the frame."""
+    if not isinstance(frame, dict):
+        return []
+    env = frame.pop(ENVELOPE_KEY, None)
+    if not isinstance(env, dict):
+        return []
+    out = []
+    try:
+        for tid, sid in env.get("ids", []):
+            out.append(TraceContext(str(tid), span_id=str(sid)))
+    except (TypeError, ValueError):
+        return []
+    return out
+
+
+def extract_frames(frames: Iterable[Any]) -> List[TraceContext]:
+    """Extract across a popped burst, deduplicated by trace id (a
+    worker burst may drain several frames of one super-batch)."""
+    seen = set()
+    out: List[TraceContext] = []
+    for frame in frames:
+        for ctx in extract(frame):
+            if ctx.trace_id not in seen:
+                seen.add(ctx.trace_id)
+                out.append(ctx)
+    return out
+
+
+# --- Span sink (JSONL through the service log dir) --------------------
+
+_sink_lock = threading.Lock()
+_sink_path: Optional[str] = None
+_sink_file = None
+
+
+def span_log_path(log_dir: str) -> str:
+    return os.path.join(log_dir, SPAN_FILE)
+
+
+def configure(log_dir: Optional[str]) -> None:
+    """Point this process's span sink at ``<log_dir>/spans.jsonl``
+    (append; created on first span). ``None``/"" disables recording.
+    Resident-runner mode configures once per platform; subprocess
+    services configure from their ``RAFIKI_TPU_LOG_DIR`` env."""
+    global _sink_path, _sink_file
+    with _sink_lock:
+        if _sink_file is not None:
+            try:
+                _sink_file.close()
+            except OSError:
+                pass
+            _sink_file = None
+        _sink_path = span_log_path(log_dir) if log_dir else None
+
+
+def configured() -> bool:
+    return _sink_path is not None
+
+
+def _max_span_bytes() -> int:
+    try:
+        return int(float(os.environ.get(TRACE_MAX_MB_ENV, "64"))
+                   * 1024 * 1024)
+    except ValueError:
+        return 64 * 1024 * 1024
+
+
+def _write_lines(lines: List[str]) -> None:
+    global _sink_file
+    with _sink_lock:
+        if _sink_path is None:
+            return
+        try:
+            if _sink_file is None or _sink_file.closed:
+                os.makedirs(os.path.dirname(_sink_path) or ".",
+                            exist_ok=True)
+                _sink_file = open(_sink_path, "a", encoding="utf-8")
+            _sink_file.write("".join(lines))
+            _sink_file.flush()
+            # Size cap (RAFIKI_TPU_TRACE_MAX_MB, default 64): roll to
+            # ONE .1 generation so a busy node (or a client that always
+            # sends X-Trace-Id, bypassing sampling) cannot fill the
+            # disk. Append mode means tell() is the file size; a
+            # concurrent multi-process rotation race is benign — the
+            # atomic replace at worst drops some spans of one
+            # generation.
+            if _sink_file.tell() > _max_span_bytes():
+                _sink_file.close()
+                _sink_file = None
+                os.replace(_sink_path, _sink_path + ".1")
+        except OSError:  # sink dir vanished (test teardown); drop spans
+            _sink_file = None
+
+
+def record_event(name: str, service: str,
+                 ctxs: Iterable[Optional[TraceContext]],
+                 start_wall: float, dur_s: float,
+                 attrs: Optional[Dict[str, Any]] = None,
+                 child: bool = True) -> None:
+    """Append one span event per traced context. ``child=True`` (the
+    common case) records a NEW span parented on each context's span;
+    ``child=False`` records the context's own span (the HTTP edge,
+    which minted it)."""
+    if _sink_path is None:
+        return
+    lines = []
+    for ctx in ctxs:
+        if ctx is None:
+            continue
+        span = {
+            "trace_id": ctx.trace_id,
+            "span_id": new_span_id() if child else ctx.span_id,
+            "parent_id": ctx.span_id if child else ctx.parent_id,
+            "name": name,
+            "service": service,
+            "start_s": round(start_wall, 6),
+            "dur_ms": round(dur_s * 1e3, 3),
+        }
+        if attrs:
+            span["attrs"] = attrs
+        lines.append(json.dumps(span, separators=(",", ":")) + "\n")
+    if lines:
+        _write_lines(lines)
+        from . import metrics
+
+        metrics.registry().counter(
+            "rafiki_tpu_trace_spans_total",
+            "Span events recorded to the span log").inc(len(lines))
+
+
+class span:
+    """``with trace.span("worker.predict", service=sid, ctxs=...)`` —
+    times the block (monotonic) and records the event(s) at exit.
+    No-ops entirely when nothing is traced or no sink is configured."""
+
+    def __init__(self, name: str, service: str = "",
+                 ctxs: Optional[Iterable[Optional[TraceContext]]] = None,
+                 attrs: Optional[Dict[str, Any]] = None,
+                 child: bool = True):
+        self.name = name
+        self.service = service
+        self.attrs = attrs
+        self.child = child
+        self._ctxs = list(ctxs) if ctxs is not None else None
+
+    def __enter__(self):
+        if self._ctxs is None:
+            cur = current()
+            self._ctxs = [cur] if cur is not None else []
+        self._wall = time.time()
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctxs and _sink_path is not None:
+            record_event(self.name, self.service, self._ctxs, self._wall,
+                         time.monotonic() - self._t0, attrs=self.attrs,
+                         child=self.child)
+        return False
+
+
+# --- Stitching (admin's GET /trace/<id>) ------------------------------
+
+def collect_trace(log_dir: str, trace_id: str,
+                  max_spans: int = 1000) -> Dict[str, Any]:
+    """Read ``<log_dir>/spans.jsonl`` (plus its rolled ``.1``
+    generation) and stitch every span of one trace into an ordered
+    timeline. The scan is substring-first (cheap reject) then
+    JSON-parse; a corrupt line is skipped, never fatal."""
+    path = span_log_path(log_dir)
+    spans: List[Dict[str, Any]] = []
+    for p in (path + ".1", path):
+        if len(spans) >= max_spans:
+            break
+        try:
+            with open(p, "r", encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    if trace_id not in line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("trace_id") == trace_id:
+                        spans.append(rec)
+                        if len(spans) >= max_spans:
+                            break
+        except OSError:
+            continue
+    spans.sort(key=lambda s: (s.get("start_s", 0.0), s.get("name", "")))
+    t0 = spans[0].get("start_s", 0.0) if spans else 0.0
+    for s in spans:
+        s["offset_ms"] = round((s.get("start_s", t0) - t0) * 1e3, 3)
+    return {"trace_id": trace_id, "n_spans": len(spans), "spans": spans}

@@ -21,6 +21,7 @@ from .admin import Admin, ServicesManager
 from .admin.app import AdminApp
 from .bus import BusServer, MemoryBus, connect
 from .container import SystemContext, ThreadContainerManager
+from .observe import trace as observe_trace
 from .parallel.chips import ChipAllocator
 from .store import MetaStore, ParamStore
 
@@ -106,6 +107,12 @@ class LocalPlatform:
             meta_uri=meta_uri, params_dir=params_dir, bus_uri=bus_uri,
             node_id=node_id, adopt_unowned=adopt_unowned,
             log_dir=os.path.join(workdir, "logs"))
+        # Span sink for the whole resident-runner process: every
+        # service thread (HTTP edges, batcher, workers) appends to
+        # <logs>/spans.jsonl, which Admin.get_trace stitches. Subprocess
+        # services configure their own sink from RAFIKI_TPU_LOG_DIR
+        # (container/services.py) — same file, O_APPEND interleaving.
+        observe_trace.configure(self.services.log_dir)
         self.admin = Admin(self.meta, self.params, self.services,
                            datasets_dir=os.path.join(workdir, "datasets"))
         self.app: Optional[AdminApp] = None

@@ -10,7 +10,11 @@ result. Routes:
   (``{"__nd__": ..., "dtype": ..., "shape": ...}``) or plain nested lists.
   Overload answers ``429`` with a ``Retry-After`` header.
 - ``GET  /stats``     → micro-batcher counters (coalescing factor,
-  queue depth, per-stage latency; ``observe.ServingStats``).
+  queue depth, per-stage latency; ``observe.ServingStats``, fed from
+  the unified metrics registry).
+- ``GET  /metrics``   → Prometheus text exposition of the process
+  registry (auto-wired by ``JsonHttpServer``); the ``service`` label
+  in ``/stats`` names this frontend's ``rafiki_tpu_serving_*`` series.
 
 Concurrent requests do NOT each pay their own worker scan + bus
 scatter: a continuous micro-batcher (``predictor/batcher.py``)
@@ -49,11 +53,19 @@ class PredictorService:
                  max_batch: Optional[int] = None,
                  max_inflight: Optional[int] = None,
                  queue_cap: Optional[int] = None):
+        import uuid
+
         self.service_id = service_id
         self.inference_job_id = inference_job_id
         self.meta = meta
         self.predictor = Predictor(inference_job_id, bus)
-        self.stats = ServingStats()
+        # The metrics label must be unique per INSTANCE (tests and
+        # restarts reuse service ids within one process; two frontends
+        # sharing a label would read each other's registry series), but
+        # lead with the service id so a human can match /metrics series
+        # to the service table.
+        self.stats = ServingStats(
+            service=f"{service_id[:12]}-{uuid.uuid4().hex[:4]}")
         # Knob precedence matches NodeConfig: explicit constructor arg >
         # RAFIKI_TPU_SERVING_* env (apply_env exports them) > default.
         if microbatch is None:
@@ -79,7 +91,12 @@ class PredictorService:
             ("GET", "/", self._health),
             ("GET", "/stats", self._stats),
             ("POST", "/predict", self._predict),
-        ], host=host, port=port, name=f"predictor-{service_id[:8]}")
+        ], host=host, port=port,
+            # Same per-INSTANCE uniqueness rule as the stats label (and
+            # sharing its suffix): a reused service id would merge two
+            # frontends' http series, and the old instance's stop()
+            # would delete the live one's.
+            name=f"predictor-{self.stats.service}")
         self.port = self._http.port
 
     # --- Service lifecycle (ContainerManager contract) ---
@@ -100,6 +117,18 @@ class PredictorService:
         self._http.stop()
         if self.batcher is not None:
             self.batcher.stop()
+        # Release this frontend's registry series (serving counters AND
+        # the http layer's per-service series): the labels are
+        # per-deployment, so leaking them would grow every scrape with
+        # deploy/stop churn.
+        self.stats.close()
+        from ..observe import metrics as obs_metrics
+
+        for name in ("rafiki_tpu_http_request_seconds",
+                     "rafiki_tpu_http_requests_total"):
+            m = obs_metrics.registry().find(name)
+            if m is not None:
+                m.remove(service=self._http.name)
         self.meta.update_service(self.service_id,
                                  status=ServiceStatus.STOPPED)
 
@@ -125,6 +154,10 @@ class PredictorService:
     def _stats(self, params, body, ctx):
         snap = self.stats.snapshot()
         snap["microbatch"] = self.microbatch
+        # The HTTP layer's own series (rafiki_tpu_http_request_seconds)
+        # label by the server name — expose it so /metrics readers (the
+        # bench) can match this frontend's series without guessing.
+        snap["http_service"] = self._http.name
         if self.batcher is not None:
             snap["knobs"] = {
                 "fill_window": self.batcher.fill_window,

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Any, List, Optional
 
-from ..observe import ServingStats
+from ..observe import ServingStats, trace
 
 _log = logging.getLogger(__name__)
 
@@ -56,13 +56,17 @@ class Backpressure(RuntimeError):
 class _Request:
     """One caller's slice of a super-batch."""
 
-    __slots__ = ("queries", "event", "result", "error")
+    __slots__ = ("queries", "event", "result", "error", "trace")
 
     def __init__(self, queries: List[Any]):
         self.queries = queries
         self.event = threading.Event()
         self.result: Optional[List[Any]] = None
         self.error: Optional[BaseException] = None
+        # The submitting (handler) thread's trace context: the batcher
+        # and gather threads have none of their own, so the request
+        # carries it across the thread hop into the bus envelope.
+        self.trace = trace.current()
 
     def resolve(self, result: List[Any]) -> None:
         self.result = result
@@ -274,18 +278,29 @@ class MicroBatcher:
             self._top_up(batch)
             fill_s = time.monotonic() - t0
             flat: List[Any] = []
+            ctxs: List[Any] = []
             for req in batch:
                 flat.extend(req.queries)
+                if req.trace is not None:
+                    ctxs.append(req.trace)
             t1 = time.monotonic()
+            wall = time.time()
             try:
                 finisher = self.predictor.predict_submit(
-                    flat, pre_encoded=self.pre_encoded)
+                    flat, pre_encoded=self.pre_encoded,
+                    trace_ctxs=ctxs)
             except BaseException as e:  # noqa: BLE001 - forwarded to callers
                 self._inflight_sem.release()
                 for req in batch:
                     req.fail(e)
                 continue
             scatter_s = time.monotonic() - t1
+            if ctxs:
+                trace.record_event(
+                    "predictor.scatter", self.stats.service, ctxs, wall,
+                    scatter_s, attrs={"requests": len(batch),
+                                      "queries": len(flat),
+                                      "fill_ms": round(fill_s * 1e3, 3)})
             with self._inflight_lock:
                 self._inflight += 1
                 inflight = self._inflight
@@ -307,18 +322,25 @@ class MicroBatcher:
                 finisher, batch = self._completions.popleft()
                 self._gathering = batch
             t0 = time.monotonic()
+            wall = time.time()
             results = error = None
             try:
                 results = finisher()
             except BaseException as e:  # noqa: BLE001 - forwarded to callers
                 error = e
             finally:
+                gather_s = time.monotonic() - t0
                 with self._inflight_lock:
                     self._inflight -= 1
                     inflight = self._inflight
                 self._inflight_sem.release()
-                self.stats.gathered(time.monotonic() - t0,
-                                    inflight=inflight)
+                self.stats.gathered(gather_s, inflight=inflight)
+                ctxs = [r.trace for r in batch if r.trace is not None]
+                if ctxs:
+                    trace.record_event("predictor.gather",
+                                       self.stats.service, ctxs, wall,
+                                       gather_s,
+                                       attrs={"error": error is not None})
             offset = 0
             for req in batch:
                 if error is not None:

@@ -135,6 +135,7 @@ class Predictor:
 
     def predict_submit(self, queries: List[Any], *,
                        pre_encoded: bool = False,
+                       trace_ctxs: Optional[List[Any]] = None,
                        ) -> Callable[[], List[Optional[Any]]]:
         """Scatter a batch of queries NOW; returns a finisher that
         gathers + ensembles when called.
@@ -147,7 +148,10 @@ class Predictor:
 
         ``pre_encoded=True`` means the queries are already bus-safe
         frames (e.g. straight off the HTTP body) — no decode/re-encode
-        round-trip on the hot path.
+        round-trip on the hot path. ``trace_ctxs`` carries the coalesced
+        requests' trace contexts into the bus envelope (the
+        micro-batcher's scatter thread has no ambient context; the
+        direct path falls back to the calling thread's).
         """
         n = len(queries)
         if not n:
@@ -163,7 +167,8 @@ class Predictor:
             from ..cache import encode_payload
 
             encoded = [encode_payload(q) for q in queries]  # once total
-        batch_id = self.cache.send_query_batch_fanout(workers, encoded)
+        batch_id = self.cache.send_query_batch_fanout(
+            workers, encoded, trace_ctxs=trace_ctxs)
 
         def finish() -> List[Optional[Any]]:
             replies = self.cache.gather_prediction_batches(

@@ -5,6 +5,19 @@ Flask service boilerplate). Flask isn't in this environment; this module
 gives the Admin and Predictor frontends the same thing on
 ``ThreadingHTTPServer``: route tables with ``<param>`` captures, JSON
 bodies in/out, bearer-token extraction, graceful start/stop.
+
+Observability rides here for free on every service built on this class:
+
+- ``GET /metrics`` (Prometheus text, the process-wide
+  ``observe.metrics`` registry) is auto-appended to the route table
+  unless the service registered its own or ``RAFIKI_TPU_METRICS=0``.
+- Every request is timed into ``rafiki_tpu_http_request_seconds``
+  (labeled service + route PATTERN — bounded cardinality) and counted
+  in ``rafiki_tpu_http_requests_total`` (+ status code).
+- The trace edge: an ``X-Trace-Id`` request header is honored (else a
+  fresh sampled trace is minted), bound thread-locally for the handler
+  (``observe.trace.current()``), recorded as the root ``http`` span,
+  and echoed back in the response's ``X-Trace-Id`` header.
 """
 
 from __future__ import annotations
@@ -13,9 +26,12 @@ import json
 import logging
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
+
+from ..observe import metrics, trace
 
 _log = logging.getLogger(__name__)
 
@@ -72,6 +88,13 @@ def _compile(path: str) -> re.Pattern:
     return re.compile(f"^{pattern}$")
 
 
+def metrics_route(params, body, ctx):
+    """The shared ``GET /metrics`` handler: the whole process registry
+    in Prometheus text exposition format."""
+    return 200, RawResponse("text/plain; version=0.0.4; charset=utf-8",
+                            metrics.registry().expose())
+
+
 class JsonHttpServer:
     """A route-table HTTP server. ``port=0`` picks a free port."""
 
@@ -80,8 +103,26 @@ class JsonHttpServer:
                  name: str = "http", max_body: Optional[int] = None):
         import os
 
-        self._routes = [(method.upper(), _compile(path), handler)
+        routes = list(routes)
+        self.name = name
+        # Every JsonHttpServer-based service exposes the process metrics
+        # registry for free; a service-registered /metrics route wins.
+        self._observe = metrics.metrics_enabled()
+        if self._observe and not any(p == "/metrics"
+                                     for _, p, _ in routes):
+            routes.append(("GET", "/metrics", metrics_route))
+        # Route PATTERN strings ride along for bounded-cardinality
+        # metric labels (the raw path would carry ids/uuids).
+        self._routes = [(method.upper(), path, _compile(path), handler)
                         for method, path, handler in routes]
+        if self._observe:
+            reg = metrics.registry()
+            self._http_hist = reg.histogram(
+                "rafiki_tpu_http_request_seconds",
+                "Request handling latency per service + route pattern")
+            self._http_count = reg.counter(
+                "rafiki_tpu_http_requests_total",
+                "Requests served per service + route pattern + status")
         # Request bodies are buffered in memory before dispatch (dataset
         # uploads included), and the admin process also supervises every
         # service — one unbounded upload (or a forged huge
@@ -145,15 +186,24 @@ class JsonHttpServer:
                         raw_body = raw
                 ctx = RequestContext(self.headers, parse_qs(parsed.query),
                                      raw_body=raw_body)
-                for m, pattern, handler in outer._routes:
+                for m, route, pattern, handler in outer._routes:
                     if m != method:
                         continue
                     match = pattern.match(parsed.path)
                     if match is None:
                         continue
+                    # Trace edge: honor an incoming X-Trace-Id, else
+                    # mint a fresh (sampled) trace; bind it for the
+                    # handler so downstream code (batcher admission,
+                    # bus scatter) can carry it onward.
+                    tctx = trace.start_trace(
+                        self.headers.get(trace.TRACE_HEADER))
+                    wall = time.time()
+                    t0 = time.monotonic()
                     headers = None
                     try:
-                        result = handler(match.groupdict(), body, ctx)
+                        with trace.use(tctx):
+                            result = handler(match.groupdict(), body, ctx)
                         if len(result) == 3:
                             status, obj, headers = result
                         else:
@@ -170,8 +220,25 @@ class JsonHttpServer:
                         _log.exception("%s %s failed", method, parsed.path)
                         status, obj = 500, {
                             "error": f"{type(e).__name__}: {e}"}
+                    dur = time.monotonic() - t0
+                    if outer._observe:
+                        outer._http_hist.observe(dur, service=name,
+                                                 route=route)
+                        outer._http_count.inc(service=name, route=route,
+                                              code=str(status))
+                    if tctx is not None:
+                        trace.record_event(
+                            f"http {method} {route}", name, [tctx],
+                            wall, dur, attrs={"status": status},
+                            child=False)
+                        headers = dict(headers or {})
+                        headers.setdefault(trace.TRACE_HEADER,
+                                           tctx.header_value())
                     self._reply(status, obj, headers)
                     return
+                if outer._observe:
+                    outer._http_count.inc(service=name, route="(none)",
+                                          code="404")
                 self._reply(404, {"error": f"no route {method} {parsed.path}"})
 
             def _reply(self, status: int, obj: Any,

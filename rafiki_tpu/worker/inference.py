@@ -17,6 +17,7 @@ from typing import Any, Optional
 from ..bus import BaseBus, BusOpError
 from ..cache import Cache
 from ..constants import ServiceStatus
+from ..observe import trace
 from ..parallel.chips import ChipGroup
 from ..store import MetaStore, ParamStore
 from ..utils.model_loader import load_model_class
@@ -355,8 +356,14 @@ class InferenceWorker:
 
     def _dispatch_batch(self, items: list):
         """Flatten a burst into ONE chip-side predict dispatch; returns
-        (finisher, spans, n) for ``_complete_batch``. A burst may mix
-        batch frames and single-query frames."""
+        (finisher, spans, n, trace_ctxs, t0) for ``_complete_batch``. A
+        burst may mix batch frames and single-query frames; their trace
+        envelopes (absent on old frames) are popped here so the span
+        covering this burst's device time lands in the span log under
+        every trace id the burst carried."""
+        import time as _time
+
+        trace_ctxs = trace.extract_frames(items)
         flat: list = []
         spans: list = []  # (item, start, count, is_batch)
         for it in items:
@@ -373,14 +380,26 @@ class InferenceWorker:
                            len(flat))
             err = {"error": f"{type(e).__name__}: {e}"}
             finisher = lambda n=len(flat): [err] * n  # noqa: E731
-        return finisher, spans, len(flat)
+        return (finisher, spans, len(flat), trace_ctxs,
+                (_time.time(), _time.monotonic()))
 
-    def _complete_batch(self, finisher, spans: list, n: int) -> None:
+    def _complete_batch(self, finisher, spans: list, n: int,
+                        trace_ctxs: list = (), t0=None) -> None:
+        import time as _time
+
         try:
             predictions = finisher()
         except Exception as e:
             _log.exception("predict failed on batch of %d", n)
             predictions = [{"error": f"{type(e).__name__}: {e}"}] * n
+        if trace_ctxs:
+            # The span covers dispatch -> readback complete (with
+            # pipelining on, that includes the deliberate overlap wait).
+            wall, mono = t0 if t0 else (_time.time(), _time.monotonic())
+            trace.record_event(
+                "worker.predict", self.service_id, trace_ctxs, wall,
+                _time.monotonic() - mono,
+                attrs={"n_queries": n, "trial_id": str(self.trial_id)})
         weight = int(getattr(self._model, "last_weight", 1))
         for it, start, count, is_batch in spans:
             if is_batch:

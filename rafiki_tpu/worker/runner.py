@@ -23,7 +23,7 @@ from ..advisor.base import Proposal
 from ..constants import BudgetOption, TrialStatus
 from ..model.base import BaseModel
 from ..model.logger import logger
-from ..observe import trace_session, trial_trace_dir
+from ..observe import metrics, trace_session, trial_trace_dir
 from ..store import MetaStore, ParamStore
 
 _log = logging.getLogger(__name__)
@@ -204,8 +204,12 @@ class TrialRunner:
             try:
                 # Opt-in per-trial profiler trace (RAFIKI_TPU_TRACE_DIR);
                 # each trial's trace lands in its own TensorBoard-readable
-                # subdirectory (SURVEY.md §5 tracing plan).
-                with trace_session(trial_trace_dir(trial_id)):
+                # subdirectory (SURVEY.md §5 tracing plan). The metrics
+                # label context attributes the train loop's MFU gauge /
+                # step-time histogram to THIS trial — the loop itself
+                # has no idea which trial it runs for.
+                with metrics.label_context(trial=trial_id[:12]), \
+                        trace_session(trial_trace_dir(trial_id)):
                     model.train(self.train_dataset_path,
                                 shared_params=shared, **train_kwargs)
                 score = float(model.evaluate(self.val_dataset_path))
@@ -242,6 +246,15 @@ class TrialRunner:
                          proposal.trial_no, err)
         finally:
             logger.set_sink(prior_sink)
+            # The train metrics are "current trial" series: a finished
+            # (or errored) trial must stop reporting its last values as
+            # live, and the per-trial labels must not accumulate in the
+            # registry forever. Trial logs keep the history.
+            for name in ("rafiki_tpu_train_mfu_ratio",
+                         "rafiki_tpu_train_step_seconds"):
+                m = metrics.registry().find(name)
+                if m is not None:
+                    m.remove(trial=trial_id[:12])
         return self.meta.get_trial(trial_id)
 
 

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Static check: every metric registered in the tree follows the
+``rafiki_tpu_<subsystem>_<name>_<unit>`` naming convention.
+
+Run as a tier-1 test (tests/test_metrics.py invokes it) and standalone:
+
+    python scripts/check_metrics_names.py [repo_root]
+
+The check is intentionally dumb and fast: it greps every ``.py`` file
+under ``rafiki_tpu/`` for string literals starting with ``rafiki_tpu_``
+that appear as the first argument of a ``counter(`` / ``gauge(`` /
+``histogram(`` call (however the registry is aliased), and validates:
+
+- full name matches ``rafiki_tpu_[a-z0-9]+(_[a-z0-9]+)+``
+- the SUBSYSTEM (token after the prefix) is in the known set
+- the UNIT (last token) is in the known set, and counters end in
+  ``_total``
+
+Exit code 0 = clean; 1 = violations (printed one per line).
+Extending the subsystem/unit vocabulary is a deliberate edit HERE, so
+a typo'd metric name can't silently fork the namespace.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+PREFIX = "rafiki_tpu_"
+
+SUBSYSTEMS = {"bus", "serving", "http", "train", "trace", "node"}
+
+# _total marks counters (Prometheus convention); everything else is the
+# physical unit of a gauge/histogram.
+UNITS = {"total", "seconds", "ratio", "bytes", "queries", "batches",
+         "info"}
+
+NAME_RE = re.compile(r"^rafiki_tpu_[a-z0-9]+(?:_[a-z0-9]+)+$")
+
+# First string argument of a registry call, e.g.:
+#   reg.counter(\n    "rafiki_tpu_x_y_total", ...)
+CALL_RE = re.compile(
+    r"\b(counter|gauge|histogram)\(\s*\n?\s*"
+    r"[\"'](" + PREFIX + r"[a-zA-Z0-9_]*)[\"']")
+
+
+def check_file(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    problems = []
+    for match in CALL_RE.finditer(text):
+        kind, name = match.group(1), match.group(2)
+        line = text[:match.start()].count("\n") + 1
+        where = f"{path}:{line}"
+        if not NAME_RE.match(name):
+            problems.append(f"{where}: {name!r} is not "
+                            f"rafiki_tpu_<subsystem>_<name>_<unit>")
+            continue
+        tokens = name[len(PREFIX):].split("_")
+        if tokens[0] not in SUBSYSTEMS:
+            problems.append(
+                f"{where}: {name!r} subsystem {tokens[0]!r} not in "
+                f"{sorted(SUBSYSTEMS)} (extend the set in "
+                f"scripts/check_metrics_names.py if intentional)")
+        unit = tokens[-1]
+        if unit not in UNITS:
+            problems.append(
+                f"{where}: {name!r} unit {unit!r} not in "
+                f"{sorted(UNITS)}")
+        if kind == "counter" and unit != "total":
+            problems.append(
+                f"{where}: counter {name!r} must end in _total")
+        if kind != "counter" and unit == "total":
+            problems.append(
+                f"{where}: {kind} {name!r} must not end in _total")
+    return problems
+
+
+def main(root: str) -> int:
+    pkg = os.path.join(root, "rafiki_tpu")
+    problems = []
+    n_files = 0
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                n_files += 1
+                problems.extend(check_file(os.path.join(dirpath, fn)))
+    for p in problems:
+        print(p)
+    if not problems:
+        print(f"ok: {n_files} files, all metric names conform")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__)))))

@@ -286,8 +286,11 @@ def test_dashboard_upload_and_log_elements(http_platform):
     text = requests.get(url, timeout=10).text
     for el in ("nd-upload", "nd-file", "nd-name", "nd-task",  # datasets
                "nm-src-file",                 # model .py file upload
-               "services", "svclog"):         # per-service log view
+               "services", "svclog",          # per-service log view
+               "infstats", "infstats-summary"):  # serving stats panel
         assert f'id="{el}"' in text, f"missing dashboard element #{el}"
+    # the panel is fed by the admin's server-side /stats proxy
+    assert "/stats" in text and "refreshInfStats" in text
 
 
 def test_oversized_upload_rejected_413(http_platform):
