@@ -522,27 +522,41 @@ def main_serving_openloop() -> dict:
 
 def main_serving_concurrent() -> dict:
     """Closed-loop concurrent serving: N clients against the predictor
-    HTTP frontend, micro-batcher ON vs OFF (ISSUE r6).
+    HTTP frontend — micro-batcher ON vs OFF (ISSUE r6) and replica
+    sharding ON vs OFF (ISSUE r8).
 
     The closed-loop config[3] (``serving``) hammers with 16 clients of
     64-query batches — big enough that per-request scatter overhead
     amortizes. Real app traffic is many SMALL requests, where the r5
     frontend paid one worker scan + bus scatter + blocking gather per
     request; this config measures exactly that regime (8 clients x
-    4-query requests) and the fix: ONE platform serves TWO inference
-    jobs of the same trained trial — one with the continuous
-    micro-batcher (the production default), one with
-    ``RAFIKI_TPU_SERVING_MICROBATCH=0`` (the r5 path) — windows
-    interleaved A/B/A/B so the ratio measures the batcher, not the
-    box's mood. The batcher job's ``/stats`` coalescing factor rides
-    the record, so the throughput win is attributable, not asserted.
+    4-query requests) and the fixes. ONE platform serves FOUR inference
+    jobs of the same trained trial:
 
-    Latency reporting (r7): percentiles come from the predictors' OWN
-    ``/metrics`` histograms (``rafiki_tpu_http_request_seconds`` for
-    end-to-end, ``rafiki_tpu_serving_stage_seconds`` per stage) instead
-    of client-side per-request timing — the bench reads the same
-    numbers a production scrape would, at bucket resolution, cumulative
-    over warm + timed windows.
+    - A: micro-batcher + replica sharding (production default), with a
+      second same-bin replica attached (``attach_inference_workers``)
+      so each super-batch is sliced across both;
+    - C: micro-batcher, sharding OFF, the SAME two replicas — one
+      rotating replica eats each whole super-batch (the r6 path), so
+      the A/C ratio isolates data-parallel sharding;
+    - B: micro-batcher off (the r5 one-scatter-per-request baseline),
+      also holding two replicas so the A/B ratio compares frontends at
+      equal worker capacity;
+    - D: micro-batcher with the fill window PINNED to the old fixed
+      5 ms; a low-offered-load trickle against A (adaptive) vs D
+      (fixed) compares added p99 — the adaptive window's reason to
+      exist.
+
+    The micro-batch ratio (A/B) runs the small-request regime the
+    batcher exists for. The SHARDING ratio (A/C) runs its own windows
+    of BIG requests (``shard_request`` queries each): slicing a
+    super-batch only pays when the slice carries real compute, and
+    small-batch windows would measure per-shard overhead against
+    scheduler noise. Heavy windows are interleaved A/B/A-big/C-big per
+    round so each ratio measures its mechanism, not the box's mood.
+    The trickle percentiles are BUCKET DELTAS of the predictors' own
+    ``rafiki_tpu_http_request_seconds`` histograms (snapshot before and
+    after the trickle), so the heavy phase's tail cannot pollute them.
     """
     import tempfile
     import threading
@@ -553,26 +567,86 @@ def main_serving_concurrent() -> dict:
     from rafiki_tpu.config import NodeConfig
     from rafiki_tpu.constants import BudgetOption, TaskType, UserType
     from rafiki_tpu.model import load_image_dataset
-    from rafiki_tpu.observe.metrics import (histogram_percentiles_ms,
+    from rafiki_tpu.observe.metrics import (bucket_percentile,
+                                            histogram_percentiles_ms,
                                             parse_exposition)
     from rafiki_tpu.platform import LocalPlatform
 
     n_clients, per_request = 8, 4
+    shard_request = 32  # queries/request in the sharding A/B windows
     window_s = 12.0
+    trickle_n, trickle_gap_s = 150, 0.02
     mb_env = NodeConfig.env_name("serving_microbatch")
+    shard_env = NodeConfig.env_name("serving_shard_replicas")
+    fwmin_env = NodeConfig.env_name("serving_fill_window_min")
 
-    def start_job(admin, cache, user_id, job_id, warm_batch):
+    def start_job(admin, cache, user_id, job_id, warm_batch,
+                  replicas=0):
         inf = admin.create_inference_job(user_id, job_id, max_models=1)
         deadline = time.time() + 600
         while not cache.running_workers(inf["id"]) \
                 and time.time() < deadline:
             time.sleep(0.5)
         assert cache.running_workers(inf["id"]), "no workers registered"
+        for _ in range(replicas):
+            attached = admin.attach_inference_workers(inf["id"])
+            assert attached, "replica attach failed (chips exhausted?)"
+        want = 1 + replicas
+        while len(cache.running_workers(inf["id"])) < want \
+                and time.time() < deadline:
+            time.sleep(0.5)
+        n_workers = len(cache.running_workers(inf["id"]))
+        assert n_workers >= want, \
+            f"{n_workers}/{want} replicas registered"
         host = admin.get_inference_job(inf["id"])["predictor_host"]
         url = f"http://{host}/predict"
         r = requests.post(url, json={"queries": warm_batch}, timeout=300)
         r.raise_for_status()
         return inf["id"], host
+
+    def http_buckets(host, stats):
+        """Cumulative /predict latency buckets {le: count} from the
+        predictor's own exposition — snapshot-diffable."""
+        metrics = parse_exposition(
+            requests.get(f"http://{host}/metrics", timeout=30).text)
+        out = {}
+        for labels, v in metrics.get(
+                "rafiki_tpu_http_request_seconds_bucket", []):
+            if labels.get("service") != stats.get("http_service") or \
+                    labels.get("route") != "/predict":
+                continue
+            le = labels.get("le")
+            bound = float("inf") if le == "+Inf" else float(le)
+            out[bound] = out.get(bound, 0) + int(v)
+        return out
+
+    def delta_percentiles_ms(before, after, qs=(0.5, 0.95, 0.99)):
+        """Percentiles of only the observations BETWEEN two bucket
+        snapshots (cumulative-bucket deltas stay cumulative)."""
+        deltas = sorted((le, after.get(le, 0) - before.get(le, 0))
+                        for le in after)
+        if not deltas or deltas[-1][1] <= 0:
+            return None
+        out = []
+        for q in qs:
+            v = bucket_percentile(deltas, q)
+            out.append(round(v * 1e3, 3) if v is not None else None)
+        return out
+
+    def trickle_round(url, queries, k):
+        """Low offered load: sequential single-REAL-query requests
+        (same encoded image frames as the heavy phase — a scalar would
+        measure the worker's error path, not serving), gaps far beyond
+        the adaptive ceiling — the regime where a fixed fill window is
+        pure added latency. Rounds are interleaved across the compared
+        jobs by the caller so a slow phase of the box lands on both."""
+        for i in range(k):
+            r = requests.post(url,
+                              json={"query": queries[i % len(queries)]},
+                              timeout=60)
+            r.raise_for_status()
+            assert "error" not in str(r.json().get("prediction"))[:40]
+            time.sleep(trickle_gap_s)
 
     def one_window(url, batch, duration=None):
         counts = [0] * n_clients
@@ -629,7 +703,18 @@ def main_serving_concurrent() -> dict:
     with tempfile.TemporaryDirectory() as tmp:
         train_path, val_path = make_synthetic_image_dataset_compat(
             tmp, n_train=2048, n_val=256)
-        os.environ.pop(mb_env, None)
+        for env in (mb_env, shard_env, fwmin_env):
+            os.environ.pop(env, None)
+        import jax
+
+        n_devices = len(jax.devices())
+        # Four A/B jobs (+ replicas) of one tiny model may co-own one
+        # chip on small boxes; lift the time-sliced tenancy cap so the
+        # comparison matrix fits. Restored afterwards — a sweep's later
+        # configs (multitenant) must measure the production default.
+        share_env = "RAFIKI_TPU_MAX_CHIP_SHARE"
+        prior_share = os.environ.get(share_env)
+        os.environ.setdefault(share_env, "8")
         platform = LocalPlatform(workdir=f"{tmp}/plat")
         try:
             admin = platform.admin
@@ -648,24 +733,57 @@ def main_serving_concurrent() -> dict:
             val = load_image_dataset(val_path)
             batch = [encode_payload(val.images[i % val.size])
                      for i in range(per_request)]
+            batch_big = [encode_payload(val.images[i % val.size])
+                         for i in range(shard_request)]
 
-            # Job A: micro-batcher on (production default).
+            # Job A: micro-batcher + sharding (production default),
+            # 2 same-bin replicas.
             inf_a, host_a = start_job(admin, cache, user["id"],
-                                      job["id"], batch)
-            # Job B: the r5 one-scatter-per-request path.
+                                      job["id"], batch, replicas=1)
+            # Job C: same 2 replicas, sharding OFF — one rotating
+            # replica eats each whole super-batch.
+            os.environ[shard_env] = "0"
+            try:
+                inf_c, host_c = start_job(admin, cache, user["id"],
+                                          job["id"], batch, replicas=1)
+            finally:
+                os.environ.pop(shard_env, None)
+            # Job B: the r5 one-scatter-per-request path — with the
+            # SAME 2 replicas as A/C (its direct path round-robins
+            # across them), so microbatch_speedup compares frontends at
+            # equal worker capacity instead of crediting A's second
+            # replica to the batcher.
             os.environ[mb_env] = "0"
             try:
                 inf_b, host_b = start_job(admin, cache, user["id"],
-                                          job["id"], batch)
+                                          job["id"], batch, replicas=1)
             finally:
                 os.environ.pop(mb_env, None)
-            # The forcing must have taken, or the A/B ratio is fiction.
+            # Job D: fill window PINNED at the old fixed 5 ms (the
+            # adaptive window's trickle comparator; single worker).
+            os.environ[fwmin_env] = "0.005"
+            try:
+                inf_d, host_d = start_job(admin, cache, user["id"],
+                                          job["id"], batch)
+            finally:
+                os.environ.pop(fwmin_env, None)
+            # The forcings must have taken, or the ratios are fiction.
             stats_b = requests.get(f"http://{host_b}/stats",
                                    timeout=30).json()
             assert stats_b.get("microbatch") is False, stats_b
+            stats_c = requests.get(f"http://{host_c}/stats",
+                                   timeout=30).json()
+            assert stats_c.get("shard_replicas") is False, stats_c
+            stats_a = requests.get(f"http://{host_a}/stats",
+                                   timeout=30).json()
+            assert stats_a.get("shard_replicas") is True, stats_a
+            stats_d = requests.get(f"http://{host_d}/stats",
+                                   timeout=30).json()
+            assert stats_d["knobs"]["fill_window_min"] == 0.005, stats_d
 
-            url_a, url_b = (f"http://{host_a}/predict",
-                            f"http://{host_b}/predict")
+            url_a, url_b, url_c, url_d = (
+                f"http://{host_a}/predict", f"http://{host_b}/predict",
+                f"http://{host_c}/predict", f"http://{host_d}/predict")
             # Warm windows (untimed): the workers AOT-compile per
             # power-of-two batch bucket, and only the coalesced load
             # decides which buckets the timed windows will hit — run
@@ -673,14 +791,40 @@ def main_serving_concurrent() -> dict:
             # compile lands inside a measurement.
             one_window(url_a, batch, duration=5.0)
             one_window(url_b, batch, duration=5.0)
+            one_window(url_a, batch_big, duration=5.0)
+            one_window(url_c, batch_big, duration=5.0)
             vals_a: list = []
             vals_b: list = []
+            vals_a_big: list = []
+            vals_c_big: list = []
             for _ in range(4):
                 vals_a.append(one_window(url_a, batch))
                 vals_b.append(one_window(url_b, batch))
-                if _settled(vals_a) and _settled(vals_b):
+                vals_a_big.append(one_window(url_a, batch_big))
+                vals_c_big.append(one_window(url_c, batch_big))
+                if _settled(vals_a) and _settled(vals_b) \
+                        and _settled(vals_a_big) \
+                        and _settled(vals_c_big):
                     break
+            # Low-offered-load trickle: adaptive (A) vs pinned 5 ms
+            # (D), p99 from bucket DELTAS so the heavy phase can't
+            # pollute the tail; rounds interleaved A/D/A/D... so box
+            # noise (GC, scheduler) lands on both jobs alike.
             stats_a = requests.get(f"http://{host_a}/stats",
+                                   timeout=30).json()
+            before_a = http_buckets(host_a, stats_a)
+            before_d = http_buckets(host_d, stats_d)
+            rounds = 3
+            for _ in range(rounds):
+                trickle_round(url_a, batch, trickle_n // rounds)
+                trickle_round(url_d, batch, trickle_n // rounds)
+            trickle_a = delta_percentiles_ms(
+                before_a, http_buckets(host_a, stats_a))
+            trickle_d = delta_percentiles_ms(
+                before_d, http_buckets(host_d, stats_d))
+            stats_a = requests.get(f"http://{host_a}/stats",
+                                   timeout=30).json()
+            stats_c = requests.get(f"http://{host_c}/stats",
                                    timeout=30).json()
             stats_b = requests.get(f"http://{host_b}/stats",
                                    timeout=30).json()
@@ -690,26 +834,51 @@ def main_serving_concurrent() -> dict:
             lat_a = server_latency(host_a, stats_a)
             lat_b = server_latency(host_b, stats_b)
             stages_a = stage_latency(host_a, stats_a)
-            admin.stop_inference_job(inf_a)
-            admin.stop_inference_job(inf_b)
+            for inf in (inf_a, inf_b, inf_c, inf_d):
+                admin.stop_inference_job(inf)
         finally:
             platform.shutdown()
+            if prior_share is None:
+                os.environ.pop(share_env, None)
+            else:
+                os.environ[share_env] = prior_share
 
     best_a, best_b = max(vals_a), max(vals_b)
+    best_a_big, best_c_big = max(vals_a_big), max(vals_c_big)
     return _emit(
         "serving_concurrent_qps", best_a, "queries/s",
         n_windows=len(vals_a),
         spread=round((best_a - min(vals_a)) / best_a, 3),
         windows_microbatch=[round(v, 2) for v in vals_a],
         windows_direct=[round(v, 2) for v in vals_b],
+        windows_shard_on=[round(v, 2) for v in vals_a_big],
+        windows_shard_off=[round(v, 2) for v in vals_c_big],
         n_clients=n_clients,
         queries_per_request=per_request,
         qps_microbatch_on=round(best_a, 2),
         qps_microbatch_off=round(best_b, 2),
         microbatch_speedup=round(best_a / best_b, 3),
+        # Replica sharding A/B: both jobs hold 2 same-bin replicas;
+        # only A slices super-batches across them. Measured in its own
+        # big-request windows — slicing pays in compute-per-shard, so
+        # tiny-batch windows would measure per-shard overhead against
+        # scheduler noise. n_devices tells the reader whether the
+        # replicas actually held separate devices (data parallelism) or
+        # co-owned one chip (where sharding can only add overhead).
+        n_devices=n_devices,
+        n_replicas_per_bin=2,
+        shard_queries_per_request=shard_request,
+        qps_shard_on=round(best_a_big, 2),
+        qps_shard_off=round(best_c_big, 2),
+        shard_speedup=round(best_a_big / best_c_big, 3),
         coalescing_factor=stats_a.get("coalescing_factor"),
         mean_batch_queries=stats_a.get("mean_batch_queries"),
         rejected_429=stats_a.get("rejected"),
+        # Adaptive fill window at low offered load (trickle), p50/p95/
+        # p99 ms: "added p99" vs the pinned-5ms job is the window cost.
+        fill_window_s=stats_a.get("fill_window_s"),
+        trickle_ms_p50_p95_p99_adaptive=trickle_a,
+        trickle_ms_p50_p95_p99_fixed=trickle_d,
         # From the predictors' /metrics histograms (bucket-resolution,
         # cumulative over warm + timed windows) — the same series a
         # production scrape reads.
@@ -1047,7 +1216,13 @@ def _main_cli() -> None:
         # ensure_platform runs for its probe/config side effect; the
         # records name the backend jax actually reports ("tpu", not the
         # plugin name "axon") so error records match success records.
-        ensure_platform()
+        # serving-concurrent's replica-sharding A/B needs each replica
+        # on its OWN device (co-owners of one chip serialize on its
+        # queue — sharding there measures pure overhead), so a CPU
+        # fallback for that config gets 2 virtual devices (no-op when
+        # the accelerator serves, or when XLA_FLAGS already pins one).
+        ensure_platform(n_virtual_devices=(
+            2 if args.config == "serving-concurrent" else None))
         import jax
 
         platform = jax.default_backend()

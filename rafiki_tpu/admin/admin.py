@@ -373,8 +373,9 @@ class Admin:
         """Elastic serving scale-out: attach one REPLICA worker per
         served trial bin of a RUNNING inference job on THIS node's
         chips (the ``join --inference-job`` path). The Predictor
-        round-robins across replicas, so QPS scales with unchanged
-        ensemble semantics."""
+        shards each super-batch across same-bin replicas
+        (latency-weighted data parallelism), so QPS scales with
+        unchanged ensemble semantics."""
         job = self.meta.get_inference_job(inference_job_id)
         if job is None:
             raise ValueError(f"unknown inference job {inference_job_id}")

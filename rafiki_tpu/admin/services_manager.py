@@ -434,14 +434,18 @@ class ServicesManager:
                              ) -> Optional[Dict[str, Any]]:
         """Attach one REPLICA worker for an already-served trial bin on
         THIS node's chips (elastic serving capacity: the Predictor
-        round-robins requests across same-bin replicas, so QPS scales
-        without changing the ensemble semantics). Returns None when
-        this node's chips are exhausted."""
+        shards each super-batch across same-bin replicas, so QPS scales
+        without changing the ensemble semantics). Exclusive placement
+        first; when the slice is full, a resident-runner node falls
+        back to a time-sliced group (same tier the first serving group
+        may use) so scale-out is still possible on a saturated box.
+        Returns None when this node's chips are exhausted."""
         svc_row = self.meta.create_service(ServiceType.INFERENCE,
                                            ServiceStatus.DEPLOYING,
                                            node_id=self.node_id)
         group = self.allocator.allocate(
-            chips_per_worker, name=self._alloc_name(svc_row["id"]))
+            chips_per_worker, name=self._alloc_name(svc_row["id"]),
+            shared_ok=self._sharing_ok())
         if group is None:
             self.meta.update_service(svc_row["id"],
                                      status=ServiceStatus.STOPPED)

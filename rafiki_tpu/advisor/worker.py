@@ -10,16 +10,26 @@ so ``TrialRunner`` cannot tell the difference.
 Queues: requests on ``adv:{sub_id}:req``; replies on a per-request queue
 ``adv:{sub_id}:rep:{req_id}`` (the scatter-gather convention used across
 the platform).
+
+Request frames carry the caller's trace context under the same
+``"_trace"`` envelope key the serving query path uses
+(``observe.trace``): the AdvisorWorker records one ``advisor.<op>``
+span per carried trace, so "why was this trial slow to start" shows
+the advisor hop in ``GET /trace/<id>``. Old frames lack the key and
+old workers ignore it — version skew in either direction degrades to
+"no trace", never a failed RPC.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Any, Dict, Optional, Tuple
 
 from ..bus import BaseBus
 from ..model.knobs import Knobs
+from ..observe import trace
 from .base import BaseAdvisor, Proposal
 
 
@@ -77,7 +87,24 @@ class AdvisorWorker:
                                   {"error": f"{type(e).__name__}: {e}"})
 
     def _handle(self, req: Dict[str, Any]) -> None:
+        # Pop the trace envelope BEFORE dispatching (extract also
+        # tolerates old frames without it) and time the advisor work so
+        # the span shows where a propose/feedback actually went.
+        ctxs = trace.extract(req)
         op = req.get("op")
+        if not ctxs:
+            self._dispatch(req, op)
+            return
+        wall = time.time()
+        t0 = time.monotonic()
+        try:
+            self._dispatch(req, op)
+        finally:
+            trace.record_event(
+                f"advisor.{op}", f"advisor-{self.sub_id[:8]}", ctxs,
+                wall, time.monotonic() - t0)
+
+    def _dispatch(self, req: Dict[str, Any], op: Optional[str]) -> None:
         req_id = req.get("req_id")
         if op == "propose":
             proposal = self.advisor.propose()
@@ -106,10 +133,19 @@ class RemoteAdvisor:
         self.sub_id = sub_train_job_id
         self.timeout = timeout
 
+    @staticmethod
+    def _inject_trace(req: Dict[str, Any]) -> Dict[str, Any]:
+        """Carry the calling thread's trace context (if any) in the
+        request frame — same envelope the serving scatter uses."""
+        env = trace.inject([trace.current()])
+        if env is not None:
+            req[trace.ENVELOPE_KEY] = env
+        return req
+
     def _rpc(self, req: Dict[str, Any]) -> Dict[str, Any]:
         req_id = uuid.uuid4().hex
         req["req_id"] = req_id
-        self.bus.push(_req_queue(self.sub_id), req)
+        self.bus.push(_req_queue(self.sub_id), self._inject_trace(req))
         rep = self.bus.pop(_rep_queue(self.sub_id, req_id),
                            timeout=self.timeout)
         if rep is None:
@@ -126,13 +162,13 @@ class RemoteAdvisor:
         return None if d is None else Proposal.from_json(d)
 
     def feedback(self, proposal: Proposal, score: float) -> None:
-        self.bus.push(_req_queue(self.sub_id), {
+        self.bus.push(_req_queue(self.sub_id), self._inject_trace({
             "op": "feedback", "proposal": proposal.to_json(),
-            "score": float(score)})
+            "score": float(score)}))
 
     def forget(self, proposal: Proposal) -> None:
-        self.bus.push(_req_queue(self.sub_id), {
-            "op": "forget", "proposal": proposal.to_json()})
+        self.bus.push(_req_queue(self.sub_id), self._inject_trace({
+            "op": "forget", "proposal": proposal.to_json()}))
 
     def best(self) -> Optional[Tuple[Knobs, float]]:
         d = self._rpc({"op": "best"})["best"]

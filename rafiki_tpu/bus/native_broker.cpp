@@ -417,6 +417,69 @@ static void handle_request(Conn& c, const char* data, size_t n) {
         return;
     }
 
+    if (op == "push_many") {
+        // One round-trip for a multi-queue scatter (the serving
+        // fan-out: one shard frame per replica worker). The whole
+        // items array is validated BEFORE anything is enqueued —
+        // all-or-nothing, so a reported error never leaves a pushed
+        // prefix behind (the Python client only retries per-item on
+        // "unknown op", i.e. against brokers predating this op).
+        auto iit = env.find("items");
+        if (iit == env.end() || !iit->second.ok()) {
+            respond_error(c, "push_many needs items");
+            return;
+        }
+        std::vector<std::pair<std::string, std::string>> pushes;
+        Scanner sc{iit->second.p, iit->second.p + iit->second.n};
+        sc.ws();
+        bool bad = (sc.p >= sc.end || *sc.p != '[');
+        if (!bad) {
+            ++sc.p;
+            sc.ws();
+            if (sc.p < sc.end && *sc.p == ']') {
+                ++sc.p;  // empty array
+            } else {
+                while (true) {
+                    Span elem = sc.skip_value();
+                    std::map<std::string, Span> ienv;
+                    std::string qname;
+                    if (!elem.ok() ||
+                        !parse_envelope(elem.p, elem.n, ienv) ||
+                        !str_field(ienv, "queue", qname)) {
+                        bad = true;
+                        break;
+                    }
+                    auto vit = ienv.find("value");
+                    if (vit == ienv.end() || !vit->second.ok()) {
+                        bad = true;
+                        break;
+                    }
+                    pushes.emplace_back(qname, vit->second.str());
+                    sc.ws();
+                    if (sc.p < sc.end && *sc.p == ',') {
+                        ++sc.p;
+                        continue;
+                    }
+                    if (sc.p < sc.end && *sc.p == ']') {
+                        ++sc.p;
+                        break;
+                    }
+                    bad = true;
+                    break;
+                }
+            }
+        }
+        if (bad) {
+            respond_error(c, "push_many items malformed");
+            return;
+        }
+        for (auto& pr : pushes)
+            if (!fulfil_waiter(pr.first, pr.second))
+                queues[pr.first].push_back(pr.second);
+        respond_value(c, "null");
+        return;
+    }
+
     if (op == "pop" || op == "pop_all") {
         std::string qname;
         if (!str_field(env, "queue", qname)) {

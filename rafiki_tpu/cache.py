@@ -95,9 +95,17 @@ class Cache:
             self.bus.delete_queue(key)
 
     def _gather(self, queue_key: str, n_workers: int, timeout: float,
-                decode: Any) -> List[Dict[str, Any]]:
+                decode: Any, reap: bool = True,
+                timestamps: bool = False) -> List[Dict[str, Any]]:
         """Pop up to ``n_workers`` replies off a one-shot reply queue,
-        then reap it; stragglers are swept by deferred reaping."""
+        then reap it; stragglers are swept by deferred reaping.
+
+        ``reap=False`` leaves the queue alive — the sharded gather
+        calls again after resubmitting missing shards to sibling
+        replicas, and a delete between rounds could race away a reply
+        already in flight. ``timestamps=True`` stamps each reply with
+        ``"_recv_mono"`` (monotonic pop time) so the caller can feed
+        per-replica latency tracking without re-timing the pops."""
         import time
 
         now = time.monotonic()
@@ -111,7 +119,12 @@ class Cache:
             item = self.bus.pop(queue_key, timeout=remaining)
             if item is None:
                 break
-            out.append(decode(item))
+            item = decode(item)
+            if timestamps:
+                item["_recv_mono"] = time.monotonic()
+            out.append(item)
+        if not reap:
+            return out
         self.bus.delete_queue(queue_key)
         if len(out) < n_workers:
             with self._reap_lock:
@@ -210,8 +223,41 @@ class Cache:
         self.bus.push_many(frames)
         return batch_id
 
+    def send_query_shards(self, shards: List[tuple],
+                          encoded_queries: List[Any],
+                          batch_id: Optional[str] = None,
+                          trace_ctxs: Optional[List] = None) -> str:
+        """Scatter per-SHARD slices of one pre-encoded batch — the
+        data-parallel fanout behind ``Predictor``'s replica sharding.
+
+        ``shards`` is ``[(worker_id, start, count, shard_id), ...]``;
+        each frame carries its slice of the shared encoded list (a
+        shallow slice — payload objects are shared, never copied) plus
+        a ``"shard"`` id the worker echoes back in its reply so the
+        gatherer can match replies to plan entries even when a
+        resubmitted shard lands on a worker that already served its own
+        (old workers simply don't echo; the gatherer falls back to
+        matching by worker id). A full-batch shard reuses the shared
+        list itself. One ``push_many`` round-trip for the whole plan,
+        exactly like the unsharded fanout."""
+        batch_id = batch_id or uuid.uuid4().hex
+        env = _trace_envelope(trace_ctxs)
+        n = len(encoded_queries)
+        frames = []
+        for worker_id, start, count, shard_id in shards:
+            qs = (encoded_queries if start == 0 and count == n
+                  else encoded_queries[start:start + count])
+            frame: Dict[str, Any] = {"batch_id": batch_id, "queries": qs,
+                                     "shard": shard_id}
+            if env is not None:
+                frame[_trace.ENVELOPE_KEY] = env
+            frames.append((f"q:{worker_id}", frame))
+        self.bus.push_many(frames)
+        return batch_id
+
     def gather_prediction_batches(self, batch_id: str, n_workers: int,
-                                  timeout: float = 5.0,
+                                  timeout: float = 5.0, reap: bool = True,
+                                  timestamps: bool = False,
                                   ) -> List[Dict[str, Any]]:
         """Collect up to ``n_workers`` per-worker batch replies."""
         def decode(item):
@@ -219,7 +265,21 @@ class Cache:
                                    for p in item["predictions"]]
             return item
 
-        return self._gather(f"r:{batch_id}", n_workers, timeout, decode)
+        return self._gather(f"r:{batch_id}", n_workers, timeout, decode,
+                            reap=reap, timestamps=timestamps)
+
+    def reap_reply_queue(self, batch_id: str, defer: bool = True) -> None:
+        """Finish a ``reap=False`` gather: delete the reply queue.
+        ``defer=True`` additionally schedules the deferred sweep — for
+        gathers that ended with stragglers or duplicate (resubmitted)
+        shards still able to reply and recreate the queue."""
+        import time
+
+        self.bus.delete_queue(f"r:{batch_id}")
+        if defer:
+            with self._reap_lock:
+                self._reap_later.append((time.monotonic(),
+                                         f"r:{batch_id}"))
 
     # --- Queries (InferenceWorker side) ---
 
@@ -247,8 +307,14 @@ class Cache:
             "prediction": encode_payload(prediction)})
 
     def send_prediction_batch(self, batch_id: str, worker_id: str,
-                              predictions: List[Any],
-                              weight: int = 1) -> None:
-        self.bus.push(f"r:{batch_id}", {
-            "worker_id": worker_id, "weight": int(weight),
-            "predictions": [encode_payload(p) for p in predictions]})
+                              predictions: List[Any], weight: int = 1,
+                              shard: Optional[Any] = None) -> None:
+        """``shard`` echoes the query frame's shard id (when the frame
+        carried one) so a sharded gather can match this reply to its
+        plan entry; un-sharded frames reply without the key, which is
+        also what pre-shard workers produce."""
+        frame = {"worker_id": worker_id, "weight": int(weight),
+                 "predictions": [encode_payload(p) for p in predictions]}
+        if shard is not None:
+            frame["shard"] = shard
+        self.bus.push(f"r:{batch_id}", frame)

@@ -73,11 +73,24 @@ class NodeConfig:
     # The predictor coalesces every /predict arriving within one fill
     # window into ONE scatter-gather super-batch (predictor/batcher.py).
     serving_microbatch: bool = True        # off = one scatter per request
-    serving_fill_window: float = 0.005     # seconds a window stays open
+    serving_fill_window: float = 0.005     # adaptive-window ceiling
+    #                                        default (legacy fixed knob)
+    serving_fill_window_min: float = 0.0   # adaptive floor; == max pins
+    serving_fill_window_max: Optional[float] = None  # None = use
+    #                                        serving_fill_window
     serving_max_batch: int = 1024          # queries per super-batch
     serving_max_inflight: int = 2          # scattered-ungathered batches
     serving_queue_cap: int = 4096          # admission bound (queries);
     #                                        beyond it: 429 + Retry-After
+    # Data-parallel replica sharding: slice each trial bin's
+    # super-batch across ALL live same-bin replicas (latency-weighted)
+    # instead of sending it whole to one rotating pick.
+    serving_shard_replicas: bool = True
+    # Per-client fairness: cap one client key's share of the admission
+    # queue. The key comes from the request header named by
+    # serving_client_header ("" = fairness off).
+    serving_client_header: str = ""
+    serving_client_share: float = 0.25     # fraction of queue_cap
 
     # --- Observability (docs/observability.md) ---
     metrics: bool = True                   # /metrics route + bus/http
@@ -190,6 +203,15 @@ class NodeConfig:
                 or self.serving_queue_cap < 1:
             raise ValueError("serving_max_batch, serving_max_inflight "
                              "and serving_queue_cap must be >= 1")
+        fw_max = (self.serving_fill_window
+                  if self.serving_fill_window_max is None
+                  else self.serving_fill_window_max)
+        if not (0 <= self.serving_fill_window_min <= fw_max):
+            raise ValueError("need 0 <= serving_fill_window_min <= "
+                             "serving_fill_window_max")
+        if not (0.0 <= self.serving_client_share <= 1.0):
+            raise ValueError("serving_client_share must be within "
+                             "[0, 1]")
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError("trace_sample must be within [0, 1]")
         if self.log_level.upper() not in (
@@ -223,9 +245,26 @@ class NodeConfig:
         # in-process thread — env is the one transport both inherit).
         os.environ[self.env_name("serving_microbatch")] = \
             "1" if self.serving_microbatch else "0"
-        for f in ("serving_fill_window", "serving_max_batch",
-                  "serving_max_inflight", "serving_queue_cap"):
+        os.environ[self.env_name("serving_shard_replicas")] = \
+            "1" if self.serving_shard_replicas else "0"
+        for f in ("serving_fill_window", "serving_fill_window_min",
+                  "serving_max_batch", "serving_max_inflight",
+                  "serving_queue_cap", "serving_client_share"):
             os.environ[self.env_name(f)] = str(getattr(self, f))
+        # The adaptive ceiling defaults to the legacy fixed knob; only
+        # an explicit override is exported (consumers fall back to
+        # SERVING_FILL_WINDOW themselves).
+        if self.serving_fill_window_max is not None:
+            os.environ[self.env_name("serving_fill_window_max")] = \
+                str(self.serving_fill_window_max)
+        else:
+            os.environ.pop(self.env_name("serving_fill_window_max"),
+                           None)
+        if self.serving_client_header:
+            os.environ[self.env_name("serving_client_header")] = \
+                self.serving_client_header
+        else:
+            os.environ.pop(self.env_name("serving_client_header"), None)
         # Observability: the /metrics route and bus/http instrumentation
         # check RAFIKI_TPU_METRICS at construction; the trace edges read
         # RAFIKI_TPU_TRACE_SAMPLE per request.

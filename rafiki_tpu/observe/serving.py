@@ -43,6 +43,10 @@ def _reg():
         "rejected": r.counter(
             "rafiki_tpu_serving_rejected_total",
             "Requests bounced with 429 backpressure"),
+        "backpressure": r.counter(
+            "rafiki_tpu_serving_backpressure_total",
+            "429 rejections split by reason "
+            "(reason=queue_full|client_share)"),
         "batches": r.counter(
             "rafiki_tpu_serving_batches_total",
             "Super-batches dispatched"),
@@ -61,6 +65,10 @@ def _reg():
         "stage": r.histogram(
             "rafiki_tpu_serving_stage_seconds",
             "Per-super-batch stage latency (stage=fill|scatter|gather)"),
+        "fill_window": r.gauge(
+            "rafiki_tpu_serving_fill_window_seconds",
+            "Load-adaptive fill window the last super-batch filled "
+            "under"),
     }
 
 
@@ -132,8 +140,9 @@ class ServingStats:
         self._m["requests"].inc(service=self.service)
         self._m["queries"].inc(n_queries, service=self.service)
 
-    def backpressured(self) -> None:
+    def backpressured(self, reason: str = "queue_full") -> None:
         self._m["rejected"].inc(service=self.service)
+        self._m["backpressure"].inc(service=self.service, reason=reason)
 
     def set_queue_depth(self, n_queries: int) -> None:
         self._m["queue_depth"].set(n_queries, service=self.service)
@@ -144,12 +153,15 @@ class ServingStats:
 
     def dispatched(self, n_requests: int, n_queries: int,
                    fill_s: float, scatter_s: float,
-                   inflight: Optional[int] = None) -> None:
+                   inflight: Optional[int] = None,
+                   fill_window: Optional[float] = None) -> None:
         self._m["batches"].inc(service=self.service)
         self._m["batched_requests"].inc(n_requests, service=self.service)
         self._m["batched_queries"].inc(n_queries, service=self.service)
         self._observe_stage("fill", fill_s)
         self._observe_stage("scatter", scatter_s)
+        if fill_window is not None:
+            self._m["fill_window"].set(fill_window, service=self.service)
         if inflight is not None:
             self._m["inflight"].set(inflight, service=self.service)
             with self._lock:
@@ -215,10 +227,19 @@ class ServingStats:
             if batches else None,
             "mean_batch_queries": round(batched_queries / batches, 2)
             if batches else None,
+            "rejected_by_reason": {
+                labels["reason"]: int(v)
+                for labels, v in self._m["backpressure"].samples()
+                if labels.get("service") == self.service},
             "queue_depth": self.queue_depth,
             "queue_depth_peak": self.queue_depth_peak,
             "inflight": self.inflight,
             "inflight_peak": self.inflight_peak,
+            # The last dispatched super-batch's adaptive fill window
+            # (seconds) — converges toward the max under load, the min
+            # under trickle.
+            "fill_window_s": self._m["fill_window"].value(
+                service=self.service),
             "fill": self._stage_snapshot("fill"),
             "scatter": self._stage_snapshot("scatter"),
             "gather": self._stage_snapshot("gather"),

@@ -203,15 +203,25 @@ class ChipAllocator:
         self._groups: Dict[str, ChipGroup] = {}
 
     def allocate(self, n: int, name: str, *, shared_ok: bool = False,
-                 max_share: int = 4) -> Optional[ChipGroup]:
+                 max_share: Optional[int] = None) -> Optional[ChipGroup]:
         """Allocate ``n`` chips as an ICI-compact group; None if full.
 
         ``shared_ok`` adds the time-sliced fallback tier (docstring
         above): exclusive placement first, then least-subscribed shared
-        placement up to ``max_share`` owners per chip.
+        placement up to ``max_share`` owners per chip (default 4;
+        ``RAFIKI_TPU_MAX_CHIP_SHARE`` overrides — a dense box serving
+        many replica workers per chip may deliberately oversubscribe).
         """
         if n <= 0:
             raise ValueError("n must be positive")
+        if max_share is None:
+            import os
+
+            try:
+                max_share = int(os.environ.get(
+                    "RAFIKI_TPU_MAX_CHIP_SHARE", "4"))
+            except ValueError:
+                max_share = 4
         with self._lock:
             if name in self._groups:
                 raise ValueError(

@@ -50,15 +50,19 @@ class PredictorService:
                  meta: MetaStore, bus: BaseBus, host: str = "0.0.0.0",
                  port: int = 0, microbatch: Optional[bool] = None,
                  fill_window: Optional[float] = None,
+                 fill_window_min: Optional[float] = None,
+                 fill_window_max: Optional[float] = None,
                  max_batch: Optional[int] = None,
                  max_inflight: Optional[int] = None,
-                 queue_cap: Optional[int] = None):
+                 queue_cap: Optional[int] = None,
+                 shard_replicas: Optional[bool] = None,
+                 client_header: Optional[str] = None,
+                 client_share: Optional[float] = None):
         import uuid
 
         self.service_id = service_id
         self.inference_job_id = inference_job_id
         self.meta = meta
-        self.predictor = Predictor(inference_job_id, bus)
         # The metrics label must be unique per INSTANCE (tests and
         # restarts reuse service ids within one process; two frontends
         # sharing a label would read each other's registry series), but
@@ -68,17 +72,34 @@ class PredictorService:
             service=f"{service_id[:12]}-{uuid.uuid4().hex[:4]}")
         # Knob precedence matches NodeConfig: explicit constructor arg >
         # RAFIKI_TPU_SERVING_* env (apply_env exports them) > default.
+        if shard_replicas is None:
+            shard_replicas = _parse_bool(
+                _env_knob("serving_shard_replicas", "1"))
+        self.predictor = Predictor(inference_job_id, bus,
+                                   shard_replicas=shard_replicas,
+                                   service=self.stats.service)
         if microbatch is None:
             microbatch = _parse_bool(_env_knob("serving_microbatch", "1"))
         self.microbatch = microbatch
+        # Per-client fairness: the header that derives the client key
+        # ("" = off) and the per-key share of the admission queue.
+        self.client_header = (client_header
+                              if client_header is not None else
+                              _env_knob("serving_client_header", ""))
         self.batcher: Optional[MicroBatcher] = None
         if microbatch:
+            fw = float(fill_window if fill_window is not None else
+                       _env_knob("serving_fill_window", "0.005"))
+            fw_max_env = _env_knob("serving_fill_window_max", "")
             self.batcher = MicroBatcher(
                 self.predictor,
-                fill_window=float(fill_window
-                                  if fill_window is not None else
-                                  _env_knob("serving_fill_window",
-                                            "0.005")),
+                fill_window=fw,
+                fill_window_min=float(
+                    fill_window_min if fill_window_min is not None else
+                    _env_knob("serving_fill_window_min", "0.0")),
+                fill_window_max=(
+                    fill_window_max if fill_window_max is not None else
+                    float(fw_max_env) if fw_max_env else None),
                 max_batch=int(max_batch if max_batch is not None else
                               _env_knob("serving_max_batch", "1024")),
                 max_inflight=int(max_inflight
@@ -86,6 +107,10 @@ class PredictorService:
                                  _env_knob("serving_max_inflight", "2")),
                 queue_cap=int(queue_cap if queue_cap is not None else
                               _env_knob("serving_queue_cap", "4096")),
+                client_share=(
+                    float(client_share if client_share is not None else
+                          _env_knob("serving_client_share", "0.25"))
+                    if self.client_header else 0.0),
                 stats=self.stats)
         self._http = JsonHttpServer([
             ("GET", "/", self._health),
@@ -117,11 +142,12 @@ class PredictorService:
         self._http.stop()
         if self.batcher is not None:
             self.batcher.stop()
-        # Release this frontend's registry series (serving counters AND
-        # the http layer's per-service series): the labels are
-        # per-deployment, so leaking them would grow every scrape with
-        # deploy/stop churn.
+        # Release this frontend's registry series (serving counters,
+        # the predictor's shard/replica series AND the http layer's
+        # per-service series): the labels are per-deployment, so
+        # leaking them would grow every scrape with deploy/stop churn.
         self.stats.close()
+        self.predictor.close()
         from ..observe import metrics as obs_metrics
 
         for name in ("rafiki_tpu_http_request_seconds",
@@ -158,16 +184,22 @@ class PredictorService:
         # label by the server name — expose it so /metrics readers (the
         # bench) can match this frontend's series without guessing.
         snap["http_service"] = self._http.name
+        snap["shard_replicas"] = self.predictor.shard_replicas
         if self.batcher is not None:
             snap["knobs"] = {
                 "fill_window": self.batcher.fill_window,
+                "fill_window_min": self.batcher.fill_window_min,
+                "fill_window_max": self.batcher.fill_window_max,
                 "max_batch": self.batcher.max_batch,
                 "max_inflight": self.batcher.max_inflight,
                 "queue_cap": self.batcher.queue_cap,
+                "client_share": self.batcher.client_share,
+                "client_header": self.client_header,
             }
         return 200, snap
 
-    def _run_queries(self, encoded_queries) -> list:
+    def _run_queries(self, encoded_queries,
+                     client: Optional[str] = None) -> list:
         """One request's queries → ensembled predictions, through the
         shared micro-batcher when enabled (frames stay wire-encoded all
         the way to the bus — no decode/re-encode on the hot path)."""
@@ -177,7 +209,8 @@ class PredictorService:
             # batcher then surfaces as a 500, not a hung socket.
             timeout = (self.predictor.worker_wait_timeout
                        + self.predictor.gather_timeout + 60.0)
-            return self.batcher.submit(encoded_queries, timeout=timeout)
+            return self.batcher.submit(encoded_queries, timeout=timeout,
+                                       client=client)
         self.stats.admitted(len(encoded_queries))
         return self.predictor.predict(
             [decode_payload(q) for q in encoded_queries])
@@ -185,17 +218,21 @@ class PredictorService:
     def _predict(self, params, body, ctx):
         if not body:
             return 400, {"error": "missing JSON body"}
+        client = (ctx.headers.get(self.client_header)
+                  if self.client_header else None)
         try:
             if "queries" in body:
-                preds = self._run_queries(body["queries"])
+                preds = self._run_queries(body["queries"],
+                                          client=client)
                 return 200, {"predictions": preds}
             if "query" in body:
-                preds = self._run_queries([body["query"]])
+                preds = self._run_queries([body["query"]],
+                                          client=client)
                 return 200, {"prediction": preds[0]}
         except Backpressure as e:
             return (429,
                     {"error": str(e), "queue_depth": e.depth,
-                     "queue_cap": e.cap,
+                     "queue_cap": e.cap, "reason": e.reason,
                      "retry_after": e.retry_after},
                     {"Retry-After": str(int(e.retry_after))})
         return 400, {"error": "body needs 'query' or 'queries'"}

@@ -19,12 +19,27 @@ shared admission queue between the HTTP handlers and the Predictor:
   it is full, ``submit`` raises :class:`Backpressure` immediately and
   the HTTP route turns that into ``429 Retry-After`` — overload shows
   up as fast rejections, not unbounded handler-thread pileup.
+- **Adaptive fill window.** The window is sized from the OBSERVED
+  arrival rate (an inter-arrival EWMA; the resulting fill times land
+  in the ``rafiki_tpu_serving_stage_seconds`` fill histogram, which is
+  how an operator verifies convergence): near zero under trickle load,
+  where waiting would only add latency nobody shares, growing toward
+  ``fill_window_max`` as arrivals tighten and coalescing pays. Pin
+  ``fill_window_min == fill_window_max`` to restore a fixed window.
+- **Per-client fairness.** With a ``client_share`` cap and a client
+  key passed by the caller (header-derived in the HTTP frontend;
+  default off), no single client's queries can hold more than that
+  share of the admission queue — one burst can't starve everyone else
+  up to the 429 bound.
 
 Knobs (``NodeConfig`` fields, ``RAFIKI_TPU_SERVING_*`` env parity):
-``serving_microbatch`` (on/off), ``serving_fill_window`` (seconds),
-``serving_max_batch`` (queries per super-batch), ``serving_max_inflight``
+``serving_microbatch`` (on/off), ``serving_fill_window`` (seconds;
+the adaptive ceiling's default), ``serving_fill_window_min`` /
+``serving_fill_window_max`` (adaptive bounds), ``serving_max_batch``
+(queries per super-batch), ``serving_max_inflight``
 (scattered-ungathered super-batches), ``serving_queue_cap`` (admission
-bound, queries). Observability rides :class:`observe.ServingStats`.
+bound, queries), ``serving_client_header`` / ``serving_client_share``
+(fairness). Observability rides :class:`observe.ServingStats`.
 """
 
 from __future__ import annotations
@@ -34,31 +49,42 @@ import logging
 import math
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ..observe import ServingStats, trace
 
 _log = logging.getLogger(__name__)
 
+#: Inter-arrival EWMA smoothing: ~the last dozen arrivals dominate —
+#: fast enough to open the window within one burst, calm enough that a
+#: single stray request doesn't slam it shut.
+_ARRIVAL_ALPHA = 0.15
+
 
 class Backpressure(RuntimeError):
-    """Admission queue full; retry after ``retry_after`` seconds."""
+    """Admission bound hit; retry after ``retry_after`` seconds.
+    ``reason`` says WHICH bound: ``"queue_full"`` (the global queue
+    cap) or ``"client_share"`` (one client key over its fair share)."""
 
-    def __init__(self, retry_after: float, depth: int, cap: int):
+    def __init__(self, retry_after: float, depth: int, cap: int,
+                 reason: str = "queue_full"):
         super().__init__(
-            f"serving queue full ({depth}/{cap} queries); "
+            f"serving queue full ({depth}/{cap} queries, {reason}); "
             f"retry after {retry_after:.1f}s")
         self.retry_after = retry_after
         self.depth = depth
         self.cap = cap
+        self.reason = reason
 
 
 class _Request:
     """One caller's slice of a super-batch."""
 
-    __slots__ = ("queries", "event", "result", "error", "trace")
+    __slots__ = ("queries", "event", "result", "error", "trace",
+                 "client")
 
-    def __init__(self, queries: List[Any]):
+    def __init__(self, queries: List[Any],
+                 client: Optional[str] = None):
         self.queries = queries
         self.event = threading.Event()
         self.result: Optional[List[Any]] = None
@@ -67,6 +93,7 @@ class _Request:
         # and gather threads have none of their own, so the request
         # carries it across the thread hop into the bus envelope.
         self.trace = trace.current()
+        self.client = client
 
     def resolve(self, result: List[Any]) -> None:
         self.result = result
@@ -88,8 +115,11 @@ class MicroBatcher:
     """
 
     def __init__(self, predictor: Any, *, fill_window: float = 0.005,
+                 fill_window_min: float = 0.0,
+                 fill_window_max: Optional[float] = None,
                  max_batch: int = 1024, max_inflight: int = 2,
                  queue_cap: int = 4096, pre_encoded: bool = True,
+                 client_share: float = 0.0,
                  stats: Optional[ServingStats] = None):
         if fill_window < 0:
             raise ValueError("fill_window must be >= 0")
@@ -98,15 +128,38 @@ class MicroBatcher:
                              "must be >= 1")
         self.predictor = predictor
         self.fill_window = fill_window
+        # Adaptive window bounds: max defaults to the legacy fixed
+        # knob, min to zero — so out of the box a trickle pays ~no
+        # coalescing idle time while load still earns the full window.
+        self.fill_window_min = fill_window_min
+        self.fill_window_max = (fill_window if fill_window_max is None
+                                else fill_window_max)
+        if not (0 <= self.fill_window_min <= self.fill_window_max):
+            raise ValueError("need 0 <= fill_window_min <= "
+                             "fill_window_max")
+        if not (0.0 <= client_share <= 1.0):
+            raise ValueError("client_share must be within [0, 1]")
         self.max_batch = max_batch
         self.max_inflight = max_inflight
         self.queue_cap = queue_cap
         self.pre_encoded = pre_encoded
+        # Fairness: one client key may hold at most this fraction of
+        # the admission queue (0 = off). Only requests that CARRY a
+        # client key are capped; anonymous traffic sees the global
+        # bound alone.
+        self.client_share = client_share
+        self._client_cap = max(1, int(queue_cap * client_share)) \
+            if client_share > 0 else 0
+        self._client_pending: Dict[str, int] = {}
         self.stats = stats or ServingStats()
 
         self._cond = threading.Condition()
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._pending_queries = 0
+        # Inter-arrival EWMA (seconds between submits) — the adaptive
+        # window's load signal. None until two arrivals happened.
+        self._dt_ewma: Optional[float] = None
+        self._last_arrival: Optional[float] = None
         self._inflight_sem = threading.Semaphore(max_inflight)
         self._inflight = 0  # gauge only; _inflight_sem is the limiter
         self._inflight_lock = threading.Lock()
@@ -154,6 +207,7 @@ class MicroBatcher:
             stranded = list(self._queue)
             self._queue.clear()
             self._pending_queries = 0
+            self._client_pending.clear()
         with self._completions_cond:
             stranded.extend(req for _, batch in self._completions
                             for req in batch)
@@ -170,22 +224,40 @@ class MicroBatcher:
     # --- Caller side ---
 
     def submit(self, queries: List[Any],
-               timeout: Optional[float] = None) -> List[Any]:
+               timeout: Optional[float] = None,
+               client: Optional[str] = None) -> List[Any]:
         """Enqueue one request's queries; block until its slice of the
         super-batch results is ready. Raises :class:`Backpressure` when
-        the admission queue is full (the caller maps it to HTTP 429)."""
+        the admission queue is full — or, with fairness on, when
+        ``client``'s share of it is (the caller maps it to HTTP 429)."""
         if not self._started:
             self.start()
         n = len(queries)
         if n == 0:
             return []
-        req = _Request(queries)
+        if self._client_cap == 0:
+            client = None
+        req = _Request(queries, client=client)
         with self._cond:
             # Checked under the lock: a request admitted after stop()'s
             # queue drain would sit in a queue no thread reads, blocking
             # its handler for the full timeout.
             if self._stop.is_set():
                 raise RuntimeError("micro-batcher stopped")
+            now = time.monotonic()
+            if self._last_arrival is not None:
+                # Clamp the gap: any dt beyond the ceiling already
+                # means "window = floor", and an unclamped idle gap
+                # (minutes) would poison the EWMA so badly that the
+                # first ~dozens of a post-idle burst get no window.
+                # At 2x the ceiling, a burst re-opens the window
+                # within ~5 arrivals.
+                dt = min(now - self._last_arrival,
+                         2.0 * self.fill_window_max)
+                self._dt_ewma = (dt if self._dt_ewma is None else
+                                 _ARRIVAL_ALPHA * dt +
+                                 (1.0 - _ARRIVAL_ALPHA) * self._dt_ewma)
+            self._last_arrival = now
             # A request larger than the whole cap is only admitted when
             # the queue is empty (otherwise it could never be served);
             # everything else bounces as soon as the bound is crossed.
@@ -194,6 +266,17 @@ class MicroBatcher:
                 self.stats.backpressured()
                 raise Backpressure(self._retry_after(),
                                    self._pending_queries, self.queue_cap)
+            if client is not None:
+                held = self._client_pending.get(client, 0)
+                # A single client's first over-cap request is admitted
+                # when it holds nothing (mirror of the global oversized
+                # rule: it could never be served otherwise).
+                if held > 0 and held + n > self._client_cap:
+                    self.stats.backpressured(reason="client_share")
+                    raise Backpressure(self._retry_after(), held,
+                                       self._client_cap,
+                                       reason="client_share")
+                self._client_pending[client] = held + n
             self._queue.append(req)
             self._pending_queries += n
             self.stats.admitted(n)
@@ -216,6 +299,21 @@ class MicroBatcher:
 
     # --- Batcher thread: fill + scatter ---
 
+    def current_fill_window(self) -> float:
+        """The load-adaptive fill window: with arrivals slower than the
+        ceiling, waiting can't coalesce anything — the window collapses
+        to the floor; as the inter-arrival EWMA tightens, the window
+        opens toward the ceiling (``max - ewma``, clamped), where one
+        window holds many requests. Reading ``_dt_ewma`` races benignly
+        with submit (a float read; a stale value sizes ONE window)."""
+        lo, hi = self.fill_window_min, self.fill_window_max
+        if lo >= hi:
+            return lo  # pinned: fixed-window mode
+        dt = self._dt_ewma
+        if dt is None:
+            return lo
+        return min(hi, max(lo, hi - dt))
+
     def _drain_into(self, batch: List[_Request], total: int) -> int:
         """Pop whole queued requests into ``batch`` while they fit under
         the super-batch query cap (an oversized request is admitted
@@ -227,6 +325,12 @@ class MicroBatcher:
                 break
             req = self._queue.popleft()
             self._pending_queries -= nxt
+            if req.client is not None:
+                held = self._client_pending.get(req.client, 0) - nxt
+                if held > 0:
+                    self._client_pending[req.client] = held
+                else:
+                    self._client_pending.pop(req.client, None)
             batch.append(req)
             total += nxt
         self.stats.set_queue_depth(self._pending_queries)
@@ -234,19 +338,21 @@ class MicroBatcher:
 
     def _take_batch(self):
         """Block for the first request, then keep filling until the
-        fill window closes or the query cap is hit. Returns
-        ``(batch, t_first)`` where ``t_first`` is when filling began —
-        idle time spent waiting for the first request is not fill
-        time."""
+        (adaptive) fill window closes or the query cap is hit. Returns
+        ``(batch, t_first, window)`` where ``t_first`` is when filling
+        began — idle time spent waiting for the first request is not
+        fill time — and ``window`` is the adaptive window this batch
+        filled under (recorded for observability)."""
         batch: List[_Request] = []
         total = 0
         with self._cond:
             while not self._queue:
                 if self._stop.is_set():
-                    return batch, time.monotonic()
+                    return batch, time.monotonic(), 0.0
                 self._cond.wait(0.1)
             t_first = time.monotonic()
-            deadline = t_first + self.fill_window
+            window = self.current_fill_window()
+            deadline = t_first + window
             while True:
                 total = self._drain_into(batch, total)
                 remaining = deadline - time.monotonic()
@@ -254,7 +360,7 @@ class MicroBatcher:
                         or self._stop.is_set():
                     break
                 self._cond.wait(remaining)
-        return batch, t_first
+        return batch, t_first, window
 
     def _top_up(self, batch: List[_Request]) -> None:
         """After waiting for an in-flight slot, absorb whatever queued
@@ -265,7 +371,7 @@ class MicroBatcher:
 
     def _batch_loop(self) -> None:
         while not self._stop.is_set():
-            batch, t0 = self._take_batch()
+            batch, t0, window = self._take_batch()
             if not batch:
                 continue
             # Wait for an in-flight slot (keep-N-in-flight), topping the
@@ -305,7 +411,8 @@ class MicroBatcher:
                 self._inflight += 1
                 inflight = self._inflight
             self.stats.dispatched(len(batch), len(flat), fill_s,
-                                  scatter_s, inflight=inflight)
+                                  scatter_s, inflight=inflight,
+                                  fill_window=window)
             with self._completions_cond:
                 self._completions.append((finisher, batch))
                 self._completions_cond.notify_all()

@@ -10,15 +10,52 @@ HTTP frontend lives in ``rafiki_tpu.predictor.app``.
 from __future__ import annotations
 
 import logging
+import os
 import threading
-from typing import Any, Callable, Dict, List, Optional
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..bus import BaseBus
 from ..cache import Cache
+from ..observe import metrics as _metrics
 
 _log = logging.getLogger(__name__)
+
+#: EWMA smoothing for per-replica gather latency: ~5 replies dominate.
+_LAT_ALPHA = 0.3
+
+#: Fraction of the gather timeout spent waiting for primary shards
+#: before missing ones are resubmitted to sibling replicas (only when a
+#: missing shard actually HAS a sibling; otherwise the full timeout is
+#: spent waiting — there is nobody else to ask).
+_RESUBMIT_AT = 0.5
+
+
+class _Shard:
+    """One slice of a super-batch bound for one replica worker."""
+
+    __slots__ = ("worker", "bin", "start", "count", "shard_id",
+                 "reply", "resubmitted", "t_sent", "pair", "superseded")
+
+    def __init__(self, worker: str, bin_id: str, start: int, count: int):
+        self.worker = worker
+        self.bin = bin_id
+        self.start = start
+        self.count = count
+        self.shard_id = uuid.uuid4().hex[:12]
+        self.reply: Optional[Dict[str, Any]] = None
+        self.resubmitted = False
+        self.t_sent = 0.0  # monotonic scatter time (latency EWMA)
+        # A resubmitted shard and its original cover the SAME slice;
+        # whichever replies first supersedes the other so the gather
+        # stops waiting as soon as the slice is covered.
+        self.pair: Optional["_Shard"] = None
+        self.superseded = False
+
+    def wire(self) -> Tuple[str, int, int, str]:
+        return (self.worker, self.start, self.count, self.shard_id)
 
 
 def ensemble_predictions(worker_predictions: List[Any],
@@ -66,21 +103,73 @@ def ensemble_predictions(worker_predictions: List[Any],
 class Predictor:
     def __init__(self, inference_job_id: str, bus: BaseBus,
                  gather_timeout: float = 30.0,
-                 worker_wait_timeout: float = 120.0):
+                 worker_wait_timeout: float = 120.0,
+                 shard_replicas: Optional[bool] = None,
+                 service: Optional[str] = None):
         self.inference_job_id = inference_job_id
         self.cache = Cache(bus)
         self.gather_timeout = gather_timeout
         self.worker_wait_timeout = worker_wait_timeout
+        # Data-parallel replica sharding: each trial bin's slice of a
+        # super-batch is spread across ALL live same-bin replicas
+        # (latency-weighted) instead of all landing on one rotating
+        # pick. Same ensemble semantics — each bin still contributes
+        # exactly one vote per query — but replicas become serving
+        # capacity instead of failover spares.
+        if shard_replicas is None:
+            from ..config import _parse_bool
+
+            shard_replicas = _parse_bool(os.environ.get(
+                "RAFIKI_TPU_SERVING_SHARD_REPLICAS", "1"))
+        self.shard_replicas = shard_replicas
         self._rr = 0  # replica round-robin cursor
         # worker_id -> trial bin, memoized: registration info is
         # immutable per worker id, and per-request bus.get fan-out
         # would put O(workers) round-trips on the serving hot path.
         self._bins: Dict[str, str] = {}
+        # worker_id -> EWMA of scatter->reply latency (seconds). Drives
+        # the latency-weighted shard split; a timed-out shard penalizes
+        # its replica so the next plan leans on its siblings.
+        self._lat: Dict[str, float] = {}
+        # worker_id -> monotonic time of its last penalty. A penalized
+        # replica gets a zero slice (its EWMA only refreshes on
+        # replies, which it no longer gets), so the penalty is dropped
+        # after one probe interval — a recovered replica rejoins the
+        # plan; a still-dead one costs one partial deadline per probe.
+        self._penalized: Dict[str, float] = {}
         # ThreadingHTTPServer handler threads (batcher-off mode) and
         # the micro-batcher's scatter thread all route through
-        # _choose_workers; the rr cursor and bin memo are guarded so
-        # concurrent requests can't lose rotations or corrupt the memo.
+        # _choose_workers/_plan_shards; the rr cursor, bin memo, and
+        # latency map are guarded so concurrent requests can't lose
+        # rotations or corrupt them.
         self._state_lock = threading.Lock()
+        # Per-instance metrics label (two predictors for one job in one
+        # process — test restarts — must not merge series); callers
+        # that own a ServingStats pass its label so /metrics readers
+        # can join the serving and shard families.
+        self.service = service or f"pred-{uuid.uuid4().hex[:8]}"
+        self._m_shards = self._m_resubmits = self._m_replica = None
+        if _metrics.metrics_enabled():
+            reg = _metrics.registry()
+            self._m_shards = reg.counter(
+                "rafiki_tpu_serving_shards_total",
+                "Shards scattered to replica workers")
+            self._m_resubmits = reg.counter(
+                "rafiki_tpu_serving_shard_resubmits_total",
+                "Shards resubmitted to a sibling replica after their "
+                "primary replica missed the partial-gather deadline")
+            self._m_replica = reg.histogram(
+                "rafiki_tpu_serving_replica_gather_seconds",
+                "Per-replica scatter->reply latency (worker= short "
+                "replica id)")
+
+    def close(self) -> None:
+        """Drop this predictor's metric series (per-instance ``service``
+        label; a resident runner deploying/stopping frontends would
+        otherwise grow the registry forever)."""
+        for m in (self._m_shards, self._m_resubmits, self._m_replica):
+            if m is not None:
+                m.remove(service=self.service)
 
     def workers(self) -> List[str]:
         return self.cache.running_workers(self.inference_job_id)
@@ -110,28 +199,161 @@ class Predictor:
             self._bins[worker_id] = bin_id
         return bin_id
 
-    def _choose_workers(self) -> List[str]:
-        """One worker per TRIAL BIN. Same-bin workers are replicas
-        (elastic serving capacity — extra copies of the same trials);
-        querying all of them would double-weight their trials in the
-        ensemble, so each request picks one per bin, rotating across
-        requests for load balance. The hot path costs one registry
+    def _group_replicas(self) -> Tuple[Dict[str, List[str]], int,
+                                       Dict[str, float]]:
+        """The shared front half of every scatter plan: wait for
+        workers, prune memo/latency rows of departed ones (a long-lived
+        predictor under churn would otherwise leak a row per worker
+        restart, forever), expire stale penalties, group live workers
+        by trial bin, and advance the rotation cursor. Returns
+        ``(groups, rr, lat_snapshot)``. The hot path costs one registry
         keys() scan; per-worker info reads are memoized."""
+        import time
+
         workers = sorted(self._wait_workers())  # may block; lock-free
+        if not workers:
+            return {}, 0, {}
         with self._state_lock:
-            # Prune memo entries for departed workers once the map
-            # clearly outgrows the live set — long-lived predictors
-            # otherwise accumulate a row per worker restart, forever.
             if len(self._bins) > 2 * len(workers) + 8:
                 live = set(workers)
                 self._bins = {w: b for w, b in self._bins.items()
                               if w in live}
+                self._lat = {w: v for w, v in self._lat.items()
+                             if w in live}
+                self._penalized = {w: t for w, t
+                                   in self._penalized.items()
+                                   if w in live}
+            # Expire penalties one probe interval old: a penalized
+            # replica's slice is ~zero, so only dropping the penalty
+            # lets its EWMA refresh — a recovered replica rejoins the
+            # plan; a still-dead one costs one partial deadline per
+            # probe (and correctness is covered by the resubmit).
+            now = time.monotonic()
+            for w in [w for w, t in self._penalized.items()
+                      if now - t >= self.gather_timeout]:
+                del self._penalized[w]
+                self._lat.pop(w, None)
             groups: Dict[str, List[str]] = {}
             for w in workers:
                 groups.setdefault(self._bin_of(w), []).append(w)
             self._rr += 1
-            return [members[self._rr % len(members)]
-                    for _, members in sorted(groups.items())]
+            return groups, self._rr, dict(self._lat)
+
+    @staticmethod
+    def _rotate_pick(members: List[str], rr: int) -> str:
+        """THE rotating per-bin replica pick — shared by the unsharded
+        plan branch and _choose_workers so the rotation rule cannot
+        diverge between the product path and its test surface."""
+        return members[rr % len(members)]
+
+    def _choose_workers(self) -> List[str]:
+        """One worker per TRIAL BIN (the unsharded pick; what
+        ``predict_submit`` does per bin when sharding is off or a bin
+        has one replica). Same-bin workers are replicas; querying all
+        of them would double-weight their trials in the ensemble, so
+        each request picks one per bin, rotating across requests for
+        load balance."""
+        groups, rr, _ = self._group_replicas()
+        return [self._rotate_pick(members, rr)
+                for _, members in sorted(groups.items())]
+
+    # --- Shard planning (data-parallel replica serving) ---
+
+    def _note_latency(self, worker_id: str, seconds: float) -> None:
+        if seconds < 0:
+            return
+        with self._state_lock:
+            prev = self._lat.get(worker_id)
+            self._lat[worker_id] = (seconds if prev is None else
+                                    _LAT_ALPHA * seconds +
+                                    (1.0 - _LAT_ALPHA) * prev)
+            # A penalized worker stays quarantined until the probe
+            # expiry in _group_replicas even if a straggler reply lands
+            # here: clearing the penalty early would leave the poisoned
+            # EWMA in place with no refresh path (a ~zero slice means
+            # no replies), starving the replica forever — expiry drops
+            # the EWMA too, so recovery is bounded by one probe
+            # interval instead.
+        if self._m_replica is not None:
+            self._m_replica.observe(seconds, service=self.service,
+                                    worker=worker_id[:8])
+
+    def _penalize(self, worker_id: str) -> None:
+        """A shard timed out on this replica: inflate its EWMA so the
+        next plans lean on siblings. The penalty expires after one
+        probe interval (see ``_plan_shards``): a penalized replica's
+        slice is ~zero, so its EWMA would otherwise never refresh and
+        one transient timeout would starve it forever."""
+        import time
+
+        with self._state_lock:
+            prev = self._lat.get(worker_id, self.gather_timeout)
+            self._lat[worker_id] = max(prev * 2.0, self.gather_timeout)
+            self._penalized[worker_id] = time.monotonic()
+
+    def _plan_shards(self, n: int) -> Tuple[List[_Shard],
+                                            Dict[str, List[str]]]:
+        """Split ``n`` queries into per-replica shards, one group of
+        shards per trial bin. With sharding OFF (or a single replica in
+        a bin) the bin's whole batch goes to one rotating pick — the
+        pre-shard behavior. With sharding ON, the bin's batch is sliced
+        across ALL its live replicas, sized inversely to each replica's
+        gather-latency EWMA (even slices until latencies are known); a
+        replica whose weighted slice rounds to zero is skipped. Returns
+        ``(plan, groups)`` — groups (bin -> members) feed the
+        resubmit-to-siblings path."""
+        groups, rr, lat = self._group_replicas()
+        plan: List[_Shard] = []
+        for bin_id, members in sorted(groups.items()):
+            if not self.shard_replicas or len(members) == 1 or n == 1:
+                plan.append(_Shard(self._rotate_pick(members, rr),
+                                   bin_id, 0, n))
+                continue
+            # Rotate so equal-weight ties spread the larger remainder
+            # slices across replicas over successive batches.
+            k = rr % len(members)
+            order = members[k:] + members[:k]
+            known = [v for w in order
+                     if (v := lat.get(w)) is not None and v > 0]
+            default = sum(known) / len(known) if known else 1.0
+            weights = [1.0 / max(lat.get(w, default), 1e-6)
+                       for w in order]
+            total_w = sum(weights)
+            raw = [n * w / total_w for w in weights]
+            sizes = [int(r) for r in raw]
+            for i in sorted(range(len(order)),
+                            key=lambda i: raw[i] - sizes[i],
+                            reverse=True)[:n - sum(sizes)]:
+                sizes[i] += 1
+            start = 0
+            for w, size in zip(order, sizes):
+                if size > 0:
+                    plan.append(_Shard(w, bin_id, start, size))
+                    start += size
+        return plan, groups
+
+    def _match_reply(self, reply: Dict[str, Any],
+                     plan: List[_Shard]) -> None:
+        """Attach one gathered reply to its plan entry. New workers
+        echo the frame's shard id; old workers don't, so the fallback
+        is the first reply-less shard sent to that worker (unambiguous
+        unless a resubmit doubled up on it — and resubmits only target
+        shard-echoing siblings of the same deployment)."""
+        sid = reply.get("shard")
+        shard = None
+        if sid is not None:
+            shard = next((s for s in plan if s.shard_id == sid), None)
+        if shard is None and sid is None:
+            wid = reply.get("worker_id")
+            shard = next((s for s in plan
+                          if s.worker == wid and s.reply is None), None)
+        recv = reply.pop("_recv_mono", None)
+        if recv is not None and shard is not None:
+            self._note_latency(shard.worker, recv - shard.t_sent)
+        if shard is not None and shard.reply is None:
+            shard.reply = reply
+            if shard.pair is not None:
+                shard.pair.superseded = True
 
     def predict_submit(self, queries: List[Any], *,
                        pre_encoded: bool = False,
@@ -140,11 +362,20 @@ class Predictor:
         """Scatter a batch of queries NOW; returns a finisher that
         gathers + ensembles when called.
 
-        Batch-granular frames: ONE bus message per worker carries the
-        whole request, and each worker replies once — the scatter/gather
-        cost is O(workers), not O(queries x workers). The split lets the
-        micro-batcher overlap super-batch K's gather with K+1's scatter
-        (the frontend mirror of the worker's one-burst-in-flight trick).
+        Batch-granular frames: ONE bus message per shard carries that
+        replica's slice of the request, and each replica replies once —
+        the scatter/gather cost is O(shards), not O(queries x workers),
+        and the whole plan rides one ``push_many`` broker round-trip.
+        The split lets the micro-batcher overlap super-batch K's gather
+        with K+1's scatter (the frontend mirror of the worker's
+        one-burst-in-flight trick).
+
+        With replica sharding ON (the default), each trial bin's batch
+        is spread across all live same-bin replicas — data-parallel
+        serving with unchanged ensemble semantics. A replica that dies
+        mid-gather gets its shard resubmitted to a sibling; a bin with
+        no live sibling degrades to a partial-bin result (the other
+        bins still vote) instead of stalling the batch.
 
         ``pre_encoded=True`` means the queries are already bus-safe
         frames (e.g. straight off the HTTP body) — no decode/re-encode
@@ -153,11 +384,13 @@ class Predictor:
         micro-batcher's scatter thread has no ambient context; the
         direct path falls back to the calling thread's).
         """
+        import time
+
         n = len(queries)
         if not n:
             return lambda: []
-        workers = self._choose_workers()
-        if not workers:
+        plan, groups = self._plan_shards(n)
+        if not plan:
             raise RuntimeError(
                 f"no running inference workers for job "
                 f"{self.inference_job_id}")
@@ -167,25 +400,145 @@ class Predictor:
             from ..cache import encode_payload
 
             encoded = [encode_payload(q) for q in queries]  # once total
-        batch_id = self.cache.send_query_batch_fanout(
-            workers, encoded, trace_ctxs=trace_ctxs)
+        now = time.monotonic()
+        for s in plan:
+            s.t_sent = now
+        batch_id = self.cache.send_query_shards(
+            [s.wire() for s in plan], encoded, trace_ctxs=trace_ctxs)
+        if self._m_shards is not None:
+            self._m_shards.inc(len(plan), service=self.service)
 
         def finish() -> List[Optional[Any]]:
-            replies = self.cache.gather_prediction_batches(
-                batch_id, n_workers=len(workers),
-                timeout=self.gather_timeout)
-            if len(replies) < len(workers):
-                _log.warning("batch %s: %d/%d workers replied", batch_id,
-                             len(replies), len(workers))
-            results: List[Optional[Any]] = []
-            for i in range(n):
-                live = [r for r in replies if i < len(r["predictions"])]
-                results.append(ensemble_predictions(
-                    [r["predictions"][i] for r in live],
-                    weights=[int(r.get("weight", 1)) for r in live]))
-            return results
+            self._gather_shards(batch_id, plan, groups, encoded,
+                                trace_ctxs)
+            return self._reassemble(n, plan)
 
         return finish
+
+    def _gather_shards(self, batch_id: str, plan: List[_Shard],
+                       groups: Dict[str, List[str]], encoded: List[Any],
+                       trace_ctxs: Optional[List[Any]]) -> None:
+        """Collect replies until every shard is matched or the gather
+        timeout lapses. When shards are still missing at the partial
+        deadline AND have live siblings, they are resubmitted once —
+        the batch degrades to waiting on the fastest sibling instead of
+        stalling on a dead replica."""
+        import time
+
+        t0 = time.monotonic()
+        deadline = t0 + self.gather_timeout
+        can_resubmit = any(len(groups.get(s.bin, ())) > 1 for s in plan)
+        partial = (t0 + self.gather_timeout * _RESUBMIT_AT
+                   if can_resubmit else deadline)
+        resubmitted = False
+
+        def drain(until: float) -> None:
+            # One reply per pop: a bulk pop of "all pending" would
+            # block the full timeout on a superseded shard's reply that
+            # will never come, even after its pair already covered the
+            # slice.
+            while True:
+                pending = sum(1 for s in plan
+                              if s.reply is None and not s.superseded)
+                remaining = until - time.monotonic()
+                if not pending or remaining <= 0:
+                    return
+                replies = self.cache.gather_prediction_batches(
+                    batch_id, n_workers=1, timeout=remaining,
+                    reap=False, timestamps=True)
+                if not replies:
+                    return
+                for r in replies:
+                    self._match_reply(r, plan)
+
+        drain(partial)
+        missing = [s for s in plan if s.reply is None]
+        if missing and can_resubmit:
+            retries: List[_Shard] = []
+            now = time.monotonic()
+            for s in missing:
+                self._penalize(s.worker)
+            # Latency snapshot AFTER the penalties, and co-missing
+            # workers excluded outright: a shard must never be
+            # resubmitted to a sibling that just missed the same
+            # deadline. Unknown (never-measured) siblings default to
+            # ~1s — preferred over a penalized replica, not over a
+            # measured-healthy one.
+            with self._state_lock:
+                lat = dict(self._lat)
+            missing_workers = {s.worker for s in missing}
+            for s in missing:
+                siblings = [w for w in groups.get(s.bin, ())
+                            if w != s.worker
+                            and w not in missing_workers]
+                if not siblings:
+                    continue
+                pick = min(siblings,
+                           key=lambda w: lat.get(w, 1.0))
+                retry = _Shard(pick, s.bin, s.start, s.count)
+                retry.resubmitted = True
+                retry.t_sent = now
+                retry.pair = s
+                s.pair = retry
+                retries.append(retry)
+            if retries:
+                resubmitted = True
+                self.cache.send_query_shards(
+                    [s.wire() for s in retries], encoded,
+                    batch_id=batch_id, trace_ctxs=trace_ctxs)
+                plan.extend(retries)
+                if self._m_resubmits is not None:
+                    self._m_resubmits.inc(len(retries),
+                                          service=self.service)
+                _log.warning(
+                    "batch %s: %d shard(s) missing at partial deadline;"
+                    " resubmitted to sibling replicas", batch_id,
+                    len(retries))
+        drain(deadline)
+        unmatched = [s for s in plan
+                     if s.reply is None and not s.superseded]
+        if unmatched:
+            for s in unmatched:
+                if not s.resubmitted:
+                    self._penalize(s.worker)
+            _log.warning("batch %s: %d/%d shards replied", batch_id,
+                         len(plan) - len(unmatched), len(plan))
+        # Stragglers (or the slower of an original/resubmit pair) may
+        # still reply; the deferred sweep reaps their recreated queue
+        # instead of leaking it. A fully-clean gather needs no sweep.
+        self.cache.reap_reply_queue(
+            batch_id, defer=bool(unmatched or resubmitted))
+
+    def _reassemble(self, n: int, plan: List[_Shard],
+                    ) -> List[Optional[Any]]:
+        """Stitch matched shard replies back into per-bin prediction
+        rows (request order), then ensemble across bins per query. A
+        query whose bin shard never replied simply loses that bin's
+        vote — the surviving bins still ensemble; a query with no votes
+        at all comes back None (the pre-shard no-reply behavior)."""
+        _HOLE = object()
+        rows: Dict[str, List[Any]] = {}
+        bin_weight: Dict[str, int] = {}
+        for s in plan:
+            if s.reply is None:
+                continue
+            row = rows.get(s.bin)
+            if row is None:
+                row = rows[s.bin] = [_HOLE] * n
+            preds = s.reply.get("predictions") or []
+            for j in range(min(s.count, len(preds))):
+                if row[s.start + j] is _HOLE:
+                    row[s.start + j] = preds[j]
+            bin_weight[s.bin] = max(bin_weight.get(s.bin, 1),
+                                    int(s.reply.get("weight", 1)))
+        results: List[Optional[Any]] = []
+        ordered = sorted(rows.items())
+        for i in range(n):
+            votes = [(row[i], bin_weight[b]) for b, row in ordered
+                     if row[i] is not _HOLE]
+            results.append(ensemble_predictions(
+                [v for v, _ in votes], weights=[w for _, w in votes]))
+        return results
 
     def predict(self, queries: List[Any], *,
                 pre_encoded: bool = False) -> List[Optional[Any]]:

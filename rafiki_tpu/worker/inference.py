@@ -403,9 +403,16 @@ class InferenceWorker:
         weight = int(getattr(self._model, "last_weight", 1))
         for it, start, count, is_batch in spans:
             if is_batch:
+                # Echo the shard id of a sharded super-batch slice so
+                # the Predictor's gather can match this reply to its
+                # shard plan entry (a resubmitted shard may land on a
+                # worker that already served its own slice of the same
+                # batch, making worker_id alone ambiguous). Un-sharded
+                # frames have no "shard" key and reply without one.
                 self.cache.send_prediction_batch(
                     it["batch_id"], self.service_id,
-                    predictions[start:start + count], weight=weight)
+                    predictions[start:start + count], weight=weight,
+                    shard=it.get("shard"))
             else:
                 self.cache.send_prediction(it["query_id"], self.service_id,
                                            predictions[start],

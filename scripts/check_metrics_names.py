@@ -16,6 +16,13 @@ that appear as the first argument of a ``counter(`` / ``gauge(`` /
 - the UNIT (last token) is in the known set, and counters end in
   ``_total``
 
+It ALSO cross-checks the Grafana dashboard JSONs under
+``docs/grafana/``: every ``rafiki_tpu_*`` metric a panel expression
+references (histogram ``_bucket``/``_sum``/``_count`` suffixes
+stripped) must be a name actually registered somewhere in the tree —
+so a renamed metric breaks this check instead of silently blanking a
+dashboard panel.
+
 Exit code 0 = clean; 1 = violations (printed one per line).
 Extending the subsystem/unit vocabulary is a deliberate edit HERE, so
 a typo'd metric name can't silently fork the namespace.
@@ -45,12 +52,23 @@ CALL_RE = re.compile(
     r"[\"'](" + PREFIX + r"[a-zA-Z0-9_]*)[\"']")
 
 
-def check_file(path: str) -> list:
+#: Any rafiki_tpu_* token inside a dashboard JSON (panel exprs,
+#: label_values templating queries, ...).
+DASH_TOKEN_RE = re.compile(r"\brafiki_tpu_[a-z0-9_]+\b")
+
+#: Exposition-level suffixes a histogram's series carry beyond its
+#: registered name.
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def check_file(path: str, registered=None) -> list:
     with open(path, encoding="utf-8") as f:
         text = f.read()
     problems = []
     for match in CALL_RE.finditer(text):
         kind, name = match.group(1), match.group(2)
+        if registered is not None:
+            registered.add(name)
         line = text[:match.start()].count("\n") + 1
         where = f"{path}:{line}"
         if not NAME_RE.match(name):
@@ -77,20 +95,56 @@ def check_file(path: str) -> list:
     return problems
 
 
+def check_dashboard(path: str, registered: set) -> list:
+    """Every metric a dashboard references must be a registered name
+    (after stripping the histogram exposition suffixes)."""
+    import json
+
+    with open(path, encoding="utf-8") as f:
+        try:
+            text = f.read()
+            json.loads(text)  # a broken dashboard import is a failure
+        except json.JSONDecodeError as e:
+            return [f"{path}: invalid JSON ({e})"]
+    problems = []
+    for name in sorted(set(DASH_TOKEN_RE.findall(text))):
+        base = name
+        for suffix in HIST_SUFFIXES:
+            if base.endswith(suffix) and base[:-len(suffix)] in registered:
+                base = base[:-len(suffix)]
+                break
+        if base not in registered:
+            problems.append(
+                f"{path}: references {name!r}, which no code path "
+                f"registers (renamed metric? update the dashboard)")
+    return problems
+
+
 def main(root: str) -> int:
     pkg = os.path.join(root, "rafiki_tpu")
     problems = []
+    registered: set = set()
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in filenames:
             if fn.endswith(".py"):
                 n_files += 1
-                problems.extend(check_file(os.path.join(dirpath, fn)))
+                problems.extend(check_file(os.path.join(dirpath, fn),
+                                           registered))
+    grafana = os.path.join(root, "docs", "grafana")
+    n_dash = 0
+    if os.path.isdir(grafana):
+        for fn in sorted(os.listdir(grafana)):
+            if fn.endswith(".json"):
+                n_dash += 1
+                problems.extend(check_dashboard(
+                    os.path.join(grafana, fn), registered))
     for p in problems:
         print(p)
     if not problems:
-        print(f"ok: {n_files} files, all metric names conform")
+        print(f"ok: {n_files} files + {n_dash} dashboard(s), all "
+              f"metric names conform")
     return 1 if problems else 0
 
 

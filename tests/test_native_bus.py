@@ -124,6 +124,130 @@ def test_parked_pop_not_misdelivered_after_fd_reuse():
         server.stop()
 
 
+def test_native_push_many_single_round_trip():
+    """A multi-queue scatter over the NATIVE broker must be one
+    ``push_many`` op — not W ``push`` round-trips — and must fulfil
+    parked waiters exactly like per-item pushes. Verified through the
+    ``rafiki_tpu_bus_op_seconds`` op label: the scatter adds one
+    push_many observation and zero push observations."""
+    from rafiki_tpu.observe import metrics
+
+    server = NativeBusServer().start()
+    try:
+        c = BusClient(server.host, server.port)
+        hist = metrics.registry().histogram("rafiki_tpu_bus_op_seconds")
+        before_many = hist.count(backend="tcp", op="push_many",
+                                 kind="query")
+        before_push = hist.count(backend="tcp", op="push", kind="query")
+        items = [(f"q:w{i}", {"batch_id": "b1", "queries": [i],
+                              "shard": f"s{i}"}) for i in range(5)]
+        c.push_many(items)
+        assert not getattr(c, "_no_push_many", False), \
+            "native broker negotiated the per-item fallback"
+        assert hist.count(backend="tcp", op="push_many",
+                          kind="query") == before_many + 1
+        assert hist.count(backend="tcp", op="push",
+                          kind="query") == before_push
+        for i in range(5):
+            got = c.pop(f"q:w{i}", timeout=2.0)
+            assert got == {"batch_id": "b1", "queries": [i],
+                           "shard": f"s{i}"}
+        # A parked blocking pop is fulfilled by push_many directly.
+        got2 = []
+        t = threading.Thread(
+            target=lambda: got2.append(c.pop("q:park", timeout=10.0)))
+        t.start()
+        c2 = BusClient(server.host, server.port)
+        c2.push_many([("q:park", {"v": 7})])
+        t.join(timeout=10)
+        assert got2 == [{"v": 7}]
+        c.close()
+        c2.close()
+    finally:
+        server.stop()
+
+
+def test_sharded_scatter_is_one_push_many_on_native_path():
+    """End to end: a replica-SHARDED Predictor scatter over the native
+    broker is exactly one query-kind push_many round-trip (not one
+    push per shard), per the ``rafiki_tpu_bus_op_seconds`` op label."""
+    import time
+
+    from rafiki_tpu.cache import Cache
+    from rafiki_tpu.observe import metrics
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    server = NativeBusServer().start()
+    try:
+        worker_bus = BusClient(server.host, server.port)
+        cache = Cache(worker_bus)
+        cache.register_worker("job", "wA1", info={"trial_id": "tA"})
+        cache.register_worker("job", "wA2", info={"trial_id": "tA"})
+        stop = threading.Event()
+
+        def worker_loop(wid):
+            c = Cache(BusClient(server.host, server.port))
+            while not stop.is_set():
+                for it in c.pop_queries(wid, timeout=0.1):
+                    c.send_prediction_batch(
+                        it["batch_id"], wid,
+                        [q * 2 for q in it["queries"]],
+                        shard=it.get("shard"))
+
+        threads = [threading.Thread(target=worker_loop, args=(w,),
+                                    daemon=True)
+                   for w in ("wA1", "wA2")]
+        [t.start() for t in threads]
+        hist = metrics.registry().histogram("rafiki_tpu_bus_op_seconds")
+        before_many = hist.count(backend="tcp", op="push_many",
+                                 kind="query")
+        before_push = hist.count(backend="tcp", op="push", kind="query")
+        p = Predictor("job", BusClient(server.host, server.port),
+                      gather_timeout=10.0, worker_wait_timeout=10.0)
+        assert p.predict(list(range(8))) == [float(q * 2)
+                                             for q in range(8)]
+        assert hist.count(backend="tcp", op="push_many",
+                          kind="query") == before_many + 1
+        assert hist.count(backend="tcp", op="push",
+                          kind="query") == before_push
+        stop.set()
+        [t.join(timeout=5) for t in threads]
+        time.sleep(0)  # let client sockets settle before teardown
+    finally:
+        server.stop()
+
+
+def test_push_many_unknown_op_fallback(monkeypatch):
+    """Against an OLD broker (predating the push_many op) the client
+    negotiates a permanent per-item fallback instead of failing the
+    scatter: same delivered frames, W push round-trips."""
+    from rafiki_tpu.bus import BusServer
+    from rafiki_tpu.bus.tcp import _Handler
+
+    real_dispatch = _Handler._dispatch
+
+    def old_dispatch(bus, req):
+        if req.get("op") == "push_many":
+            raise ValueError(f"unknown op: {req.get('op')!r}")
+        return real_dispatch(bus, req)
+
+    monkeypatch.setattr(_Handler, "_dispatch",
+                        staticmethod(old_dispatch))
+    server = BusServer().start()
+    try:
+        c = BusClient(server.host, server.port)
+        c.push_many([("q:a", 1), ("q:b", 2)])
+        assert getattr(c, "_no_push_many", False) is True
+        assert c.pop("q:a", timeout=1.0) == 1
+        assert c.pop("q:b", timeout=1.0) == 2
+        # The fallback is sticky: later scatters go straight per-item.
+        c.push_many([("q:a", 3)])
+        assert c.pop("q:a", timeout=1.0) == 3
+        c.close()
+    finally:
+        server.stop()
+
+
 def test_serve_broker_fallback_selects():
     server = serve_broker()
     try:

@@ -23,16 +23,23 @@ from rafiki_tpu.predictor.app import PredictorService
 class EchoWorker:
     """Minimal InferenceWorker stand-in: pops query batches off the bus
     and replies ``[value, value + 0.5]`` per query (so a reply is
-    attributable to its query). ``delay`` simulates model latency."""
+    attributable to its query). ``delay`` simulates model latency;
+    ``trial_id`` sets the replica bin; ``dead=True`` swallows frames (a
+    replica that crashed mid-gather); ``echo_shard=False`` mimics a
+    pre-shard worker that doesn't echo the shard id."""
 
-    def __init__(self, bus, worker_id="w1", job_id="job", delay=0.0):
+    def __init__(self, bus, worker_id="w1", job_id="job", delay=0.0,
+                 trial_id="t1", dead=False, echo_shard=True):
         self.cache = Cache(bus)
         self.worker_id = worker_id
         self.delay = delay
+        self.dead = dead
+        self.echo_shard = echo_shard
         self.stop_flag = threading.Event()
         self.served_batches = 0
+        self.served_sizes = []
         self.cache.register_worker(job_id, worker_id,
-                                   info={"trial_id": "t1"})
+                                   info={"trial_id": trial_id})
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -40,12 +47,16 @@ class EchoWorker:
         while not self.stop_flag.is_set():
             items = self.cache.pop_queries(self.worker_id, timeout=0.1)
             for it in items:
+                if self.dead:
+                    continue
                 if self.delay:
                     time.sleep(self.delay)
                 self.served_batches += 1
+                self.served_sizes.append(len(it["queries"]))
                 self.cache.send_prediction_batch(
                     it["batch_id"], self.worker_id,
-                    [[float(q), float(q) + 0.5] for q in it["queries"]])
+                    [[float(q), float(q) + 0.5] for q in it["queries"]],
+                    shard=it.get("shard") if self.echo_shard else None)
 
     def stop(self):
         self.stop_flag.set()
@@ -305,6 +316,383 @@ def test_stop_fails_waiters_fast_and_rejects_late_submits(bus):
         assert elapsed < 15, "waiter hung past stop()"
     with pytest.raises(RuntimeError, match="stopped"):
         mb.submit([1], timeout=5)
+
+
+# --- Replica-sharded scatter (data-parallel serving) ---
+
+
+def _expected(qs):
+    return [[float(q), float(q) + 0.5] for q in qs]
+
+
+def test_shard_split_across_same_bin_replicas(bus):
+    """With 2 same-bin replicas, one batch is sliced across BOTH (each
+    sees a strict subset) and reassembles in request order."""
+    wa = EchoWorker(bus, "wA1", trial_id="tA")
+    wb = EchoWorker(bus, "wA2", trial_id="tA")
+    p = _predictor(bus)
+    try:
+        qs = list(range(10))
+        assert p.predict(qs) == _expected(qs)
+        assert wa.served_sizes and wb.served_sizes, \
+            "a replica idled through a sharded batch"
+        assert max(wa.served_sizes) < 10 and max(wb.served_sizes) < 10
+        assert sum(wa.served_sizes) + sum(wb.served_sizes) == 10
+    finally:
+        wa.stop()
+        wb.stop()
+
+
+def test_shard_uneven_replica_counts_and_order(bus):
+    """Bins with 3 and 1 replicas: every query still gets exactly one
+    vote per bin, results in request order, ensemble across bins."""
+    workers = [EchoWorker(bus, f"wA{i}", trial_id="tA")
+               for i in range(3)]
+    workers.append(EchoWorker(bus, "wB", trial_id="tB"))
+    p = _predictor(bus)
+    try:
+        for n in (1, 2, 7):  # fewer queries than replicas, uneven splits
+            qs = list(range(100, 100 + n))
+            assert p.predict(qs) == _expected(qs), f"n={n}"
+        # the single-replica bin always served full batches
+        assert all(s in (1, 2, 7)
+                   for s in workers[-1].served_sizes)
+    finally:
+        [w.stop() for w in workers]
+
+
+def test_shard_replicas_off_restores_one_pick_per_bin(bus):
+    """shard_replicas=False: the pre-shard behavior — one rotating
+    replica serves the WHOLE batch."""
+    wa = EchoWorker(bus, "wA1", trial_id="tA")
+    wb = EchoWorker(bus, "wA2", trial_id="tA")
+    p = _predictor(bus, shard_replicas=False)
+    try:
+        qs = list(range(8))
+        assert p.predict(qs) == _expected(qs)
+        sizes = wa.served_sizes + wb.served_sizes
+        assert sizes == [8], sizes
+    finally:
+        wa.stop()
+        wb.stop()
+
+
+def test_shard_env_knob(bus, monkeypatch):
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_SHARD_REPLICAS", "0")
+    assert _predictor(bus).shard_replicas is False
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_SHARD_REPLICAS", "1")
+    assert _predictor(bus).shard_replicas is True
+    # constructor beats env
+    assert _predictor(bus, shard_replicas=False).shard_replicas is False
+
+
+def test_replica_death_mid_gather_resubmits_to_sibling(bus):
+    """A dead replica's shard is resubmitted to its sibling at the
+    partial-gather deadline: the batch completes with FULL results,
+    well before the full gather timeout, and the dead replica is
+    latency-penalized out of the next plan."""
+    dead = EchoWorker(bus, "wA1", trial_id="tA", dead=True)
+    live = EchoWorker(bus, "wA2", trial_id="tA")
+    p = _predictor(bus, gather_timeout=4.0)
+    try:
+        qs = list(range(8))
+        t0 = time.monotonic()
+        assert p.predict(qs) == _expected(qs)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 3.5, \
+            f"resubmit did not beat the full gather timeout ({elapsed})"
+        # the penalized replica gets no slice on the next batch
+        live.served_sizes.clear()
+        dead_sizes_before = list(dead.served_sizes)
+        assert p.predict(qs) == _expected(qs)
+        assert live.served_sizes == [8]
+        assert dead.served_sizes == dead_sizes_before
+    finally:
+        dead.stop()
+        live.stop()
+
+
+def test_resubmit_skips_co_missing_siblings(bus):
+    """Two replicas dying in the SAME batch must both resubmit to the
+    remaining live sibling — never to each other (a co-missing worker
+    is no rescue, whatever its historical EWMA says)."""
+    dead1 = EchoWorker(bus, "wA1", trial_id="tA", dead=True)
+    dead2 = EchoWorker(bus, "wA2", trial_id="tA", dead=True)
+    live = EchoWorker(bus, "wA3", trial_id="tA")
+    p = _predictor(bus, gather_timeout=4.0)
+    qs = list(range(9))
+    try:
+        t0 = time.monotonic()
+        assert p.predict(qs) == _expected(qs)
+        assert time.monotonic() - t0 < 3.5
+        assert sum(live.served_sizes) == 9, live.served_sizes
+    finally:
+        dead1.stop()
+        dead2.stop()
+        live.stop()
+
+
+def test_penalized_replica_recovers_after_probe_interval(bus):
+    """One transient timeout must not starve a replica forever: the
+    penalty (whose ~zero slice means its latency EWMA can never
+    refresh on its own) expires after one probe interval and the
+    recovered replica rejoins the plan."""
+    flaky = EchoWorker(bus, "wA1", trial_id="tA", dead=True)
+    steady = EchoWorker(bus, "wA2", trial_id="tA")
+    p = _predictor(bus, gather_timeout=1.0)
+    qs = list(range(8))
+    try:
+        assert p.predict(qs) == _expected(qs)  # resubmit covered it
+        assert "wA1" in p._penalized
+        flaky.dead = False  # the replica comes back
+        assert p.predict(qs) == _expected(qs)
+        assert not flaky.served_sizes, "penalty ignored"
+        time.sleep(1.1)  # one probe interval (== gather_timeout)
+        assert p.predict(qs) == _expected(qs)
+        assert flaky.served_sizes, "recovered replica never rejoined"
+        assert "wA1" not in p._penalized
+    finally:
+        flaky.stop()
+        steady.stop()
+
+
+def test_partial_bin_degrades_not_stalls(bus):
+    """A dead single-replica bin (no sibling to resubmit to) costs only
+    its own vote: the other bin's predictions still come back."""
+    dead = EchoWorker(bus, "wA", trial_id="tA", dead=True)
+    live = EchoWorker(bus, "wB", trial_id="tB")
+    p = _predictor(bus, gather_timeout=2.0)
+    try:
+        qs = [1, 2, 3]
+        out = p.predict(qs)
+        assert out == _expected(qs), out  # tB's votes survived
+    finally:
+        dead.stop()
+        live.stop()
+
+
+def test_old_worker_without_shard_echo_still_matches(bus):
+    """Pre-shard workers reply without the shard id; the gatherer falls
+    back to matching by worker id (one shard per worker per batch)."""
+    wa = EchoWorker(bus, "wA1", trial_id="tA", echo_shard=False)
+    wb = EchoWorker(bus, "wA2", trial_id="tA", echo_shard=False)
+    p = _predictor(bus)
+    try:
+        qs = list(range(6))
+        assert p.predict(qs) == _expected(qs)
+    finally:
+        wa.stop()
+        wb.stop()
+
+
+def test_latency_weighted_split_prefers_fast_replica(bus):
+    """A slow replica's EWMA shrinks its slice: after a few batches the
+    fast replica serves most of the queries."""
+    slow = EchoWorker(bus, "wA1", trial_id="tA", delay=0.20)
+    fast = EchoWorker(bus, "wA2", trial_id="tA")
+    p = _predictor(bus)
+    try:
+        qs = list(range(12))
+        for _ in range(4):
+            assert p.predict(qs) == _expected(qs)
+        # steady state: the fast replica served most of the queries
+        # (the slow one may even drop out of the plan entirely)
+        assert sum(fast.served_sizes) > sum(slow.served_sizes), \
+            (fast.served_sizes, slow.served_sizes)
+    finally:
+        slow.stop()
+        fast.stop()
+
+
+def test_sharded_scatter_through_microbatcher(bus):
+    """End to end: concurrent ragged requests through the micro-batcher
+    over 2 same-bin replicas — per-request slices intact (the
+    order-preserving reassembly under mixed request sizes)."""
+    wa = EchoWorker(bus, "wA1", trial_id="tA")
+    wb = EchoWorker(bus, "wA2", trial_id="tA")
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window=0.05, max_batch=256,
+                      max_inflight=2, queue_cap=1024).start()
+    try:
+        out = {}
+        errors = []
+        barrier = threading.Barrier(10)
+
+        def client(i):
+            try:
+                barrier.wait()
+                qs = [i * 100 + j for j in range(1 + i % 5)]
+                out[i] = (qs, mb.submit(qs, timeout=15))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(10)]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert not errors, errors
+        assert len(out) == 10
+        for i, (qs, preds) in out.items():
+            assert preds == _expected(qs), \
+                f"client {i} got another request's slice"
+        assert wa.served_sizes and wb.served_sizes
+    finally:
+        mb.stop()
+        wa.stop()
+        wb.stop()
+
+
+# --- Adaptive fill window ---
+
+
+def test_adaptive_window_converges_trickle_vs_burst(bus):
+    """Trickle arrivals (inter-arrival >> ceiling) collapse the window
+    to the floor; a tight burst opens it toward the ceiling."""
+    worker = EchoWorker(bus)
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window_min=0.0, fill_window_max=0.05,
+                      max_batch=256, max_inflight=2,
+                      queue_cap=1024).start()
+    try:
+        # Trickle: arrivals 0.1s apart, far beyond the 50ms ceiling.
+        for i in range(6):
+            mb.submit([i], timeout=10)
+            time.sleep(0.1)
+        assert mb.current_fill_window() <= 0.005, \
+            mb.current_fill_window()
+        trickle_stats = mb.stats.snapshot()
+        assert trickle_stats["fill_window_s"] <= 0.005
+        # Burst: concurrent clients hammering — the EWMA tightens and
+        # the window opens.
+        barrier = threading.Barrier(8)
+
+        def client(i):
+            barrier.wait()
+            for j in range(6):
+                mb.submit([i * 10 + j], timeout=10)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert mb.current_fill_window() > 0.02, \
+            mb.current_fill_window()
+    finally:
+        mb.stop()
+        worker.stop()
+
+
+def test_pinned_window_stays_fixed(bus):
+    """fill_window_min == fill_window_max restores the fixed window
+    regardless of load."""
+    worker = EchoWorker(bus)
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window_min=0.02, fill_window_max=0.02,
+                      max_batch=64, max_inflight=2,
+                      queue_cap=256).start()
+    try:
+        for i in range(3):
+            mb.submit([i], timeout=10)
+            time.sleep(0.05)
+        assert mb.current_fill_window() == 0.02
+    finally:
+        mb.stop()
+        worker.stop()
+
+
+def test_adaptive_window_env_knobs(bus, monkeypatch):
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_FILL_WINDOW_MIN", "0.001")
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_FILL_WINDOW_MAX", "0.03")
+    b = PredictorService("s", "j", None, bus).batcher
+    assert b.fill_window_min == 0.001 and b.fill_window_max == 0.03
+    # ceiling defaults to the legacy fixed knob when MAX is unset
+    monkeypatch.delenv("RAFIKI_TPU_SERVING_FILL_WINDOW_MAX")
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_FILL_WINDOW", "0.02")
+    b = PredictorService("s", "j", None, bus).batcher
+    assert b.fill_window_max == 0.02
+
+
+# --- Per-client fairness under backpressure ---
+
+
+def test_client_share_caps_one_client_not_others(bus):
+    """With fairness on, one client key may hold at most its share of
+    the admission queue: its overflow bounces with
+    reason=client_share while other clients keep being admitted."""
+    worker = EchoWorker(bus, delay=0.3)  # slow: the queue backs up
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window_min=0.0, fill_window_max=0.01,
+                      max_batch=4, max_inflight=1, queue_cap=40,
+                      client_share=0.25).start()  # 10 queries per key
+    results = {"hog_429": 0, "hog_ok": 0, "other_ok": 0,
+               "other_429": 0}
+    lock = threading.Lock()
+
+    def hog(i):
+        try:
+            mb.submit([i] * 5, timeout=30, client="hog")
+            with lock:
+                results["hog_ok"] += 1
+        except Backpressure as e:
+            assert e.reason == "client_share", e.reason
+            with lock:
+                results["hog_429"] += 1
+
+    def other(i):
+        try:
+            mb.submit([i], timeout=30, client=f"c{i}")
+            with lock:
+                results["other_ok"] += 1
+        except Backpressure:
+            with lock:
+                results["other_429"] += 1
+
+    try:
+        hogs = [threading.Thread(target=hog, args=(i,))
+                for i in range(8)]
+        [t.start() for t in hogs]
+        time.sleep(0.15)  # hog floods first
+        others = [threading.Thread(target=other, args=(i,))
+                  for i in range(6)]
+        [t.start() for t in others]
+        [t.join(timeout=60) for t in hogs + others]
+        assert results["hog_429"] > 0, results
+        assert results["other_ok"] == 6, results
+        snap = mb.stats.snapshot()
+        assert snap["rejected_by_reason"].get("client_share", 0) == \
+            results["hog_429"]
+    finally:
+        mb.stop()
+        worker.stop()
+
+
+def test_client_share_off_by_default(bus):
+    """Without a client_share knob the client key is ignored — no
+    per-key bound, only the global cap."""
+    worker = EchoWorker(bus)
+    p = _predictor(bus)
+    mb = MicroBatcher(p, fill_window=0.01, max_batch=64,
+                      queue_cap=64).start()
+    try:
+        assert mb.submit([1, 2, 3], timeout=10,
+                         client="x") == _expected([1, 2, 3])
+        assert mb._client_pending == {}
+    finally:
+        mb.stop()
+        worker.stop()
+
+
+def test_client_header_knob_reaches_service(bus, monkeypatch):
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_CLIENT_HEADER",
+                       "X-Client-Id")
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_CLIENT_SHARE", "0.5")
+    svc = PredictorService("s", "j", None, bus)
+    assert svc.client_header == "X-Client-Id"
+    assert svc.batcher.client_share == 0.5
+    monkeypatch.delenv("RAFIKI_TPU_SERVING_CLIENT_HEADER")
+    svc = PredictorService("s", "j", None, bus)
+    assert svc.client_header == ""
+    assert svc.batcher.client_share == 0.0  # fairness off sans header
 
 
 def test_empty_and_oversized_requests(bus):

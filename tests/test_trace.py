@@ -381,3 +381,40 @@ def test_admin_trace_route_and_metrics(tmp_path):
     finally:
         platform.shutdown()
         trace.configure(None)
+
+
+# --- Advisor RPC trace propagation (ISSUE-3 satellite) ---
+
+def test_advisor_rpc_carries_trace_context(span_sink):
+    """RemoteAdvisor injects the caller's context into proposal and
+    feedback frames; the AdvisorWorker records advisor.<op> spans under
+    the same trace id. Old frames (no envelope) stay span-free."""
+    from rafiki_tpu.advisor import RandomAdvisor
+    from rafiki_tpu.advisor.worker import AdvisorWorker, RemoteAdvisor
+    from rafiki_tpu.model.knobs import IntegerKnob
+
+    bus = MemoryBus()
+    advisor = RandomAdvisor({"x": IntegerKnob(1, 9)})
+    worker = AdvisorWorker(advisor, bus, "sub1").start()
+    remote = RemoteAdvisor(bus, "sub1", timeout=10.0)
+    try:
+        tid = "ad" * 16
+        with trace.use(trace.TraceContext(tid)):
+            prop = remote.propose()
+            assert prop is not None
+            remote.feedback(prop, 0.5)
+        # feedback is fire-and-forget; give the worker a beat
+        deadline = time.time() + 5
+        names = set()
+        while time.time() < deadline and len(names) < 2:
+            out = trace.collect_trace(span_sink, tid)
+            names = {s["name"] for s in out["spans"]}
+            time.sleep(0.05)
+        assert names == {"advisor.propose", "advisor.feedback"}, names
+        for s in trace.collect_trace(span_sink, tid)["spans"]:
+            assert s["service"].startswith("advisor-")
+        # Untraced caller -> old-shape frames -> no spans, RPC still fine
+        assert remote.propose() is not None
+        assert trace.collect_trace(span_sink, "ee" * 16)["n_spans"] == 0
+    finally:
+        worker.stop()
