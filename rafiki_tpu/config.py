@@ -92,6 +92,17 @@ class NodeConfig:
     serving_client_header: str = ""
     serving_client_share: float = 0.25     # fraction of queue_cap
 
+    # --- Trial lifecycle / dataset residency (docs/training.md) ---
+    # Host dataset cache: parsed datasets stay resident across trials,
+    # keyed by (path, mtime, size), byte-budget LRU. 0 disables.
+    dataset_cache_bytes: int = 1 << 30
+    # Device staging cache: the replicated uint8 dataset arrays stay
+    # resident on the mesh across trials (never donated). 0 disables.
+    stage_cache_bytes: int = 2 << 30
+    # TrainWorkers compute the NEXT proposal on a background thread
+    # while the current trial trains (advisor/prefetch.py). Opt-out.
+    advisor_prefetch: bool = True
+
     # --- Observability (docs/observability.md) ---
     metrics: bool = True                   # /metrics route + bus/http
     #                                        instrumentation wiring
@@ -212,6 +223,9 @@ class NodeConfig:
         if not (0.0 <= self.serving_client_share <= 1.0):
             raise ValueError("serving_client_share must be within "
                              "[0, 1]")
+        if self.dataset_cache_bytes < 0 or self.stage_cache_bytes < 0:
+            raise ValueError("dataset_cache_bytes and stage_cache_bytes "
+                             "must be >= 0 (0 disables the cache)")
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError("trace_sample must be within [0, 1]")
         if self.log_level.upper() not in (
@@ -265,6 +279,15 @@ class NodeConfig:
                 self.serving_client_header
         else:
             os.environ.pop(self.env_name("serving_client_header"), None)
+        # Trial-lifecycle knobs: the dataset/staging caches read their
+        # budgets per call (model/dataset.py, model/jax_model.py); the
+        # TrainWorker reads the prefetch toggle when its loop starts.
+        os.environ[self.env_name("dataset_cache_bytes")] = \
+            str(self.dataset_cache_bytes)
+        os.environ[self.env_name("stage_cache_bytes")] = \
+            str(self.stage_cache_bytes)
+        os.environ[self.env_name("advisor_prefetch")] = \
+            "1" if self.advisor_prefetch else "0"
         # Observability: the /metrics route and bus/http instrumentation
         # check RAFIKI_TPU_METRICS at construction; the trace edges read
         # RAFIKI_TPU_TRACE_SAMPLE per request.

@@ -18,6 +18,15 @@ Corpus datasets (POS tagging): a zip containing ``corpus.tsv`` with one
 
 All loaders return plain numpy; device placement/sharding is the training
 loop's job (``rafiki_tpu.model.jax_model``).
+
+Cross-trial residency: the image/token loaders front a process-level
+**host dataset cache** (byte-budget LRU keyed by the file's
+``(path, mtime_ns, size)`` fingerprint, budget
+``RAFIKI_TPU_DATASET_CACHE_BYTES``), so trial 2..N of a sub-train-job
+never re-parse the dataset from disk — the r5 profile showed the trial
+hot loop spending its wall time exactly here and in the matching
+device staging (``jax_model``'s stage cache). Cached datasets are
+SHARED across callers: treat every loaded dataset as read-only.
 """
 
 from __future__ import annotations
@@ -25,11 +34,16 @@ from __future__ import annotations
 import csv
 import io
 import os
+import threading
 import zipfile
+from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
+
+from ..observe import phases as _phases
 
 
 @dataclass
@@ -133,17 +147,165 @@ def hash_token_ids(tokens: List[str], vocab_size: int,
     return ids
 
 
+# --- Host dataset cache (cross-trial residency) ---
+#
+# One bounded process-level cache for the hot-loop dataset formats
+# (image + token): repeat trials of one sub-train-job call
+# ``train()/evaluate()`` with the SAME dataset paths, and before r9
+# every call re-read and re-parsed the file (PIL-decoding every PNG for
+# the zip encoding). Keyed by the file fingerprint — a rewritten file
+# (new mtime_ns or size) is a different dataset, never a stale hit.
+
+DATASET_CACHE_ENV = "RAFIKI_TPU_DATASET_CACHE_BYTES"
+DATASET_CACHE_DEFAULT = 1 << 30  # keep NodeConfig.dataset_cache_bytes equal
+
+
+class ByteBudgetLRU:
+    """Byte-budget LRU shared by BOTH residency caches (this module's
+    host dataset cache and ``jax_model``'s device staging cache), so
+    the lock/eviction/occupancy-metric logic cannot drift between
+    them. ``metrics_name`` is the ``observe.phases`` cache family the
+    evict counter and bytes gauge report under."""
+
+    def __init__(self, metrics_name: str):
+        self._name = metrics_name
+        self._lock = threading.Lock()
+        #: key -> (value, nbytes)
+        self._entries: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+
+    def get(self, key: Any) -> Optional[Any]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            self._entries.move_to_end(key)
+            return entry[0]
+
+    def put(self, key: Any, value: Any, nbytes: int,
+            budget: int) -> None:
+        if nbytes > budget:
+            return  # would evict everything and still not fit
+        n_evicted = 0
+        with self._lock:
+            prev = self._entries.pop(key, None)
+            if prev is not None:
+                self._bytes -= prev[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > budget and len(self._entries) > 1:
+                _, (_, ev_bytes) = self._entries.popitem(last=False)
+                self._bytes -= ev_bytes
+                n_evicted += 1
+            held = self._bytes
+        if n_evicted:
+            _phases.cache_event(self._name, "evict", n_evicted)
+        _phases.set_cache_bytes(self._name, held)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+        _phases.set_cache_bytes(self._name, 0)
+
+    def values(self) -> List[Any]:
+        with self._lock:
+            return [v for v, _ in self._entries.values()]
+
+    def info(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+
+_DATASET_CACHE = ByteBudgetLRU("dataset")
+
+
+def dataset_cache_budget() -> int:
+    """Byte budget of the host dataset cache (0 disables it). Read per
+    call so tests and ``apply_env`` changes take effect immediately."""
+    try:
+        return int(os.environ.get(DATASET_CACHE_ENV,
+                                  DATASET_CACHE_DEFAULT))
+    except ValueError:
+        return DATASET_CACHE_DEFAULT
+
+
+def dataset_fingerprint(dataset_path: str) -> Tuple[str, int, int]:
+    """The identity of a dataset FILE: ``(abspath, mtime_ns, size)``.
+    Also the host half of the device staging-cache key
+    (``jax_model``): both caches agree on what "the same dataset"
+    means, so a rewritten file invalidates staged device arrays too.
+
+    Loaders stamp the fingerprint they loaded UNDER onto the dataset
+    object (``ds.fingerprint``): downstream caches must key by what
+    was actually read, not by a fresh stat — a file rewritten between
+    load and staging would otherwise cache the old data under the new
+    file's identity."""
+    st = os.stat(dataset_path)
+    return (os.path.abspath(dataset_path), st.st_mtime_ns, st.st_size)
+
+
+def clear_dataset_cache() -> None:
+    _DATASET_CACHE.clear()
+
+
+def _freeze(ds: Any) -> None:
+    """Mark a to-be-cached dataset's arrays read-only: the object is
+    shared process-wide, and a model mutating it in place (legal under
+    the old load-per-call semantics) would silently poison every later
+    trial — the fingerprint doesn't change, so the entry would never
+    invalidate. Frozen, the mutation raises at ITS call site instead."""
+    for name in ("images", "labels", "features", "targets", "ids"):
+        arr = getattr(ds, name, None)
+        if isinstance(arr, np.ndarray):
+            arr.setflags(write=False)
+
+
+def _cached_load(kind: str, dataset_path: str, parse) -> Any:
+    if not os.path.exists(dataset_path):
+        raise FileNotFoundError(dataset_path)
+    fp = dataset_fingerprint(dataset_path)
+    if dataset_cache_budget() <= 0:
+        ds = parse()
+        ds.fingerprint = fp
+        return ds
+    key = (kind, *fp)
+    ds = _DATASET_CACHE.get(key)
+    if ds is not None:
+        _phases.cache_event("dataset", "hit")
+        return ds
+    _phases.cache_event("dataset", "miss")
+    ds = parse()
+    ds.fingerprint = fp
+    _freeze(ds)
+    _DATASET_CACHE.put(key, ds, _dataset_nbytes(ds),
+                       dataset_cache_budget())
+    return ds
+
+
+def _dataset_nbytes(ds: Any) -> int:
+    if isinstance(ds, ImageDataset):
+        return int(ds.images.nbytes + ds.labels.nbytes)
+    if isinstance(ds, TokenDataset):
+        return int(ds.ids.nbytes)
+    return 0
+
+
 # --- Loaders ---
 
 def load_image_dataset(dataset_path: str) -> ImageDataset:
-    """Load an image-classification dataset (.npz packed or .zip of files)."""
-    if not os.path.exists(dataset_path):
-        raise FileNotFoundError(dataset_path)
-    if dataset_path.endswith(".npz"):
-        return _load_image_npz(dataset_path)
-    if zipfile.is_zipfile(dataset_path):
-        return _load_image_zip(dataset_path)
-    raise ValueError(f"Unrecognised dataset format: {dataset_path}")
+    """Load an image-classification dataset (.npz packed or .zip of
+    files). Cached across calls (module docstring): repeat loads of an
+    unchanged file return the SAME read-only dataset object."""
+
+    def parse() -> ImageDataset:
+        if dataset_path.endswith(".npz"):
+            return _load_image_npz(dataset_path)
+        if zipfile.is_zipfile(dataset_path):
+            return _load_image_zip(dataset_path)
+        raise ValueError(f"Unrecognised dataset format: {dataset_path}")
+
+    return _cached_load("image", dataset_path, parse)
 
 
 # Reference-compatible alias (upstream: dataset_utils.load_dataset_of_image_files)
@@ -322,18 +484,20 @@ def write_corpus_dataset(sentences: List[List[str]], tags: List[List[str]],
 
 def load_token_dataset(dataset_path: str) -> TokenDataset:
     """Load a packed token-id dataset (.npz with ``ids`` +
-    ``vocab_size``)."""
-    if not os.path.exists(dataset_path):
-        raise FileNotFoundError(dataset_path)
-    with np.load(dataset_path) as z:
-        ids = np.asarray(z["ids"], dtype=np.int32)
-        vocab_size = int(z["vocab_size"])
-    if ids.ndim != 1:
-        raise ValueError(f"token dataset must be 1-D, got {ids.shape}")
-    if ids.size and (ids.min() < 0 or ids.max() >= vocab_size):
-        raise ValueError("token ids out of range for vocab_size "
-                         f"{vocab_size}")
-    return TokenDataset(ids=ids, vocab_size=vocab_size)
+    ``vocab_size``). Cached like ``load_image_dataset``."""
+
+    def parse() -> TokenDataset:
+        with np.load(dataset_path) as z:
+            ids = np.asarray(z["ids"], dtype=np.int32)
+            vocab_size = int(z["vocab_size"])
+        if ids.ndim != 1:
+            raise ValueError(f"token dataset must be 1-D, got {ids.shape}")
+        if ids.size and (ids.min() < 0 or ids.max() >= vocab_size):
+            raise ValueError("token ids out of range for vocab_size "
+                             f"{vocab_size}")
+        return TokenDataset(ids=ids, vocab_size=vocab_size)
+
+    return _cached_load("token", dataset_path, parse)
 
 
 def write_token_dataset(ids: np.ndarray, vocab_size: int,

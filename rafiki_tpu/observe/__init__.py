@@ -14,19 +14,21 @@ first-party observability; the TPU-native rebuild makes it first-class:
   "≥90% chip utilization" metric (BASELINE.md).
 - ``observe.serving`` — the serving frontend's counters, folded into
   the metrics registry (``/stats`` and ``/metrics`` read one source).
+- ``observe.phases`` — trial-lifecycle phase timings and the
+  dataset/staging residency-cache counters (``docs/training.md``).
 
-``metrics``/``trace``/``serving`` are stdlib-only; the profiling
-symbols load lazily so a bus broker or metrics scrape never imports
-jax.
+``metrics``/``trace``/``serving``/``phases`` are stdlib-only; the
+profiling symbols load lazily so a bus broker or metrics scrape never
+imports jax.
 """
 
-from . import metrics, trace
+from . import metrics, phases, trace
 from .serving import ServingStats
 
 _PROFILING = ("MfuMeter", "device_peak_flops", "flops_of_compiled",
               "flops_of_lowered", "trace_session", "trial_trace_dir")
 
-__all__ = ["metrics", "trace", "ServingStats", *_PROFILING]
+__all__ = ["metrics", "phases", "trace", "ServingStats", *_PROFILING]
 
 
 def __getattr__(name):

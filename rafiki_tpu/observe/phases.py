@@ -1,0 +1,109 @@
+"""Trial-lifecycle phase metrics, folded into the unified registry.
+
+The trial hot loop (propose -> load -> stage -> train -> eval ->
+persist) is where the training plane's trials/hour lives, and BENCH_r05
+showed it almost entirely host-bound (``chip_util ~ 0`` for the trials
+config). These series make the breakdown measurable the same way the
+serving stage histogram did for the frontend:
+
+- ``rafiki_tpu_trial_phase_seconds{phase=}`` — wall time per phase.
+  ``propose``/``train``/``eval``/``persist`` are recorded by the
+  TrialRunner around the whole lifecycle step; ``load`` (dataset parse
+  from disk) and ``stage`` (full-dataset host->device transfer) are
+  SUB-SPANS recorded inside ``model.train()``/``model.evaluate()`` —
+  they are contained in the train/eval phases, not additive with them.
+  With the residency caches warm, load+stage collapse to ~0 for trial
+  2..N of a sub-train-job.
+- ``rafiki_tpu_trial_dataset_cache_total{event=hit|miss|evict}`` and
+  ``rafiki_tpu_trial_stage_cache_total{event=hit|miss|evict}`` — the
+  host dataset cache (``model/dataset.py``) and device staging cache
+  (``model/jax_model.py``) hit/miss/eviction counters. Trial 2..N of a
+  job performing ZERO disk loads and ZERO full-dataset H2D shows up as
+  misses staying flat while hits grow (the bench's regression check).
+- ``rafiki_tpu_trial_dataset_cache_bytes`` /
+  ``rafiki_tpu_trial_stage_cache_bytes`` — current cache occupancy
+  against the ``RAFIKI_TPU_DATASET_CACHE_BYTES`` /
+  ``RAFIKI_TPU_STAGE_CACHE_BYTES`` budgets.
+
+Stdlib-only (this module is imported by ``model/dataset.py``, which
+must stay importable without jax). Labels are bounded: phase names and
+cache event kinds only — deliberately NOT per-trial, so the families
+never need per-trial series cleanup and the bench can read cumulative
+sums across a whole window.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import metrics
+
+PHASES = ("propose", "load", "stage", "train", "eval", "persist")
+
+#: Trial phases span four orders of magnitude more than a bus push:
+#: a warm load/stage is sub-millisecond, a real train phase minutes.
+PHASE_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                 10.0, 30.0, 60.0, 300.0, 1800.0)
+
+_m: Optional[Dict[str, object]] = None
+
+
+def _reg() -> Dict[str, object]:
+    global _m
+    if _m is None:
+        r = metrics.registry()
+        _m = {
+            "phase": r.histogram(
+                "rafiki_tpu_trial_phase_seconds",
+                "Wall time of one trial-lifecycle phase (phase="
+                "propose|load|stage|train|eval|persist; load/stage are "
+                "sub-spans of train/eval)", buckets=PHASE_BUCKETS),
+            "dataset_cache": r.counter(
+                "rafiki_tpu_trial_dataset_cache_total",
+                "Host dataset cache events (event=hit|miss|evict)"),
+            "stage_cache": r.counter(
+                "rafiki_tpu_trial_stage_cache_total",
+                "Device staging cache events (event=hit|miss|evict)"),
+            "dataset_cache_bytes": r.gauge(
+                "rafiki_tpu_trial_dataset_cache_bytes",
+                "Bytes held by the host dataset cache"),
+            "stage_cache_bytes": r.gauge(
+                "rafiki_tpu_trial_stage_cache_bytes",
+                "Bytes held by the device staging cache"),
+        }
+    return _m
+
+
+def observe_phase(phase: str, seconds: float) -> None:
+    """Record one phase duration. Always-on cheap (one histogram
+    observe); ``RAFIKI_TPU_METRICS=0`` disables it wholesale."""
+    if metrics.metrics_enabled():
+        _reg()["phase"].observe(seconds, phase=phase)
+
+
+def cache_event(cache: str, event: str, n: int = 1) -> None:
+    """``cache`` is ``"dataset"`` or ``"stage"``; ``event`` one of
+    hit/miss/evict."""
+    if metrics.metrics_enabled():
+        _reg()[f"{cache}_cache"].inc(n, event=event)
+
+
+def set_cache_bytes(cache: str, n_bytes: int) -> None:
+    if metrics.metrics_enabled():
+        _reg()[f"{cache}_cache_bytes"].set(n_bytes)
+
+
+def cache_counts(cache: str) -> Dict[str, int]:
+    """Current {event: count} for one cache family — what the bench's
+    zero-disk-load / zero-H2D regression check reads."""
+    m = _reg()[f"{cache}_cache"]
+    return {labels.get("event", ""): int(v) for labels, v in m.samples()}
+
+
+def phase_totals() -> Dict[str, Dict[str, float]]:
+    """{phase: {"sum": seconds, "count": n}} — snapshot-diffable, which
+    is how ``bench.py --config trials`` derives its per-trial phase
+    breakdown."""
+    h = _reg()["phase"]
+    return {p: {"sum": h.sum(phase=p), "count": h.count(phase=p)}
+            for p in PHASES}

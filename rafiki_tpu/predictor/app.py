@@ -86,6 +86,25 @@ class PredictorService:
         self.client_header = (client_header
                               if client_header is not None else
                               _env_knob("serving_client_header", ""))
+        # Batcher-OFF fairness (the direct one-scatter-per-request
+        # path has no admission queue): the same client_share caps one
+        # client key's IN-FLIGHT queries instead, against the same
+        # serving_queue_cap basis — so flipping
+        # RAFIKI_TPU_SERVING_MICROBATCH does not silently drop the
+        # fairness guarantee. Reuses the header-derived key and the
+        # backpressure{reason="client_share"} accounting.
+        # Resolved ONCE and shared with the MicroBatcher below, so the
+        # batcher-on and batcher-off fairness caps can never
+        # desynchronize.
+        _share = (float(client_share if client_share is not None else
+                        _env_knob("serving_client_share", "0.25"))
+                  if self.client_header else 0.0)
+        _qcap = int(queue_cap if queue_cap is not None else
+                    _env_knob("serving_queue_cap", "4096"))
+        self._direct_cap = max(1, int(_qcap * _share)) if _share > 0 \
+            else 0
+        self._direct_pending: Dict[str, int] = {}
+        self._direct_lock = threading.Lock()
         self.batcher: Optional[MicroBatcher] = None
         if microbatch:
             fw = float(fill_window if fill_window is not None else
@@ -105,12 +124,8 @@ class PredictorService:
                 max_inflight=int(max_inflight
                                  if max_inflight is not None else
                                  _env_knob("serving_max_inflight", "2")),
-                queue_cap=int(queue_cap if queue_cap is not None else
-                              _env_knob("serving_queue_cap", "4096")),
-                client_share=(
-                    float(client_share if client_share is not None else
-                          _env_knob("serving_client_share", "0.25"))
-                    if self.client_header else 0.0),
+                queue_cap=_qcap,
+                client_share=_share,
                 stats=self.stats)
         self._http = JsonHttpServer([
             ("GET", "/", self._health),
@@ -211,9 +226,30 @@ class PredictorService:
                        + self.predictor.gather_timeout + 60.0)
             return self.batcher.submit(encoded_queries, timeout=timeout,
                                        client=client)
-        self.stats.admitted(len(encoded_queries))
-        return self.predictor.predict(
-            [decode_payload(q) for q in encoded_queries])
+        n = len(encoded_queries)
+        if client is not None and self._direct_cap:
+            with self._direct_lock:
+                held = self._direct_pending.get(client, 0)
+                # Mirror of the batcher's oversized-request rule: a
+                # single over-cap request is admitted when the client
+                # holds nothing (it could never be served otherwise).
+                if held > 0 and held + n > self._direct_cap:
+                    self.stats.backpressured(reason="client_share")
+                    raise Backpressure(1.0, held, self._direct_cap,
+                                       reason="client_share")
+                self._direct_pending[client] = held + n
+        try:
+            self.stats.admitted(n)
+            return self.predictor.predict(
+                [decode_payload(q) for q in encoded_queries])
+        finally:
+            if client is not None and self._direct_cap:
+                with self._direct_lock:
+                    left = self._direct_pending.get(client, 0) - n
+                    if left > 0:
+                        self._direct_pending[client] = left
+                    else:
+                        self._direct_pending.pop(client, None)
 
     def _predict(self, params, body, ctx):
         if not body:

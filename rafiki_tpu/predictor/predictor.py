@@ -29,8 +29,23 @@ _LAT_ALPHA = 0.3
 #: Fraction of the gather timeout spent waiting for primary shards
 #: before missing ones are resubmitted to sibling replicas (only when a
 #: missing shard actually HAS a sibling; otherwise the full timeout is
-#: spent waiting — there is nobody else to ask).
+#: spent waiting — there is nobody else to ask). This is the FALLBACK
+#: (and ceiling) straggler deadline: when every planned replica has a
+#: latency EWMA, the partial deadline is latency-RELATIVE instead
+#: (``_STRAGGLER_K`` x the slowest planned replica's EWMA), so a fast
+#: fleet resubmits a missing shard in milliseconds rather than waiting
+#: out half of a 30s timeout.
 _RESUBMIT_AT = 0.5
+
+#: Multiplier over the slowest planned replica's gather-latency EWMA
+#: for the latency-relative partial deadline: a healthy reply lands
+#: within ~1x its EWMA, so 4x is a straggler with margin for jitter.
+_STRAGGLER_K = 4.0
+
+#: Floor (seconds) of the latency-relative deadline: sub-millisecond
+#: EWMAs (in-process bus) would otherwise flap resubmits on scheduler
+#: noise.
+_STRAGGLER_MIN = 0.025
 
 
 class _Shard:
@@ -332,6 +347,24 @@ class Predictor:
                     start += size
         return plan, groups
 
+    def _partial_wait(self, plan: List[_Shard]) -> float:
+        """Seconds to wait for primary shards before resubmitting
+        missing ones (the straggler deadline). Latency-relative when
+        every planned replica has a gather-latency EWMA —
+        ``min(_RESUBMIT_AT x gather_timeout,
+        _STRAGGLER_K x slowest planned EWMA)`` — so fast fleets react
+        in milliseconds; the fixed fraction is both the fallback (a
+        never-measured replica in the plan means there is no honest
+        latency basis yet) and the ceiling (the relative deadline may
+        only ever move the resubmit EARLIER)."""
+        fixed = self.gather_timeout * _RESUBMIT_AT
+        with self._state_lock:
+            ewmas = [self._lat.get(s.worker) for s in plan]
+        if any(v is None or v <= 0 for v in ewmas):
+            return fixed
+        return min(fixed, max(_STRAGGLER_K * max(ewmas),
+                              _STRAGGLER_MIN))
+
     def _match_reply(self, reply: Dict[str, Any],
                      plan: List[_Shard]) -> None:
         """Attach one gathered reply to its plan entry. New workers
@@ -428,7 +461,7 @@ class Predictor:
         t0 = time.monotonic()
         deadline = t0 + self.gather_timeout
         can_resubmit = any(len(groups.get(s.bin, ())) > 1 for s in plan)
-        partial = (t0 + self.gather_timeout * _RESUBMIT_AT
+        partial = (t0 + self._partial_wait(plan)
                    if can_resubmit else deadline)
         resubmitted = False
 

@@ -15,18 +15,110 @@ import json
 import logging
 import os
 import shutil
+import threading
 import time
 import traceback
-from typing import Any, Dict, List, Optional, Type
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Type
 
 from ..advisor.base import Proposal
 from ..constants import BudgetOption, TrialStatus
 from ..model.base import BaseModel
 from ..model.logger import logger
 from ..observe import metrics, trace_session, trial_trace_dir
+from ..observe import phases as _phases
 from ..store import MetaStore, ParamStore
 
 _log = logging.getLogger(__name__)
+
+
+class _PersistStage:
+    """Single-slot background stage for the completed-trial persist
+    tail (trial-log flush, ``ParamStore.save`` hand-off,
+    ``mark_trial_completed``, spent-checkpoint sweep).
+
+    Exactly ONE trial's tail may be in flight: ``submit`` first waits
+    for the previous tail to finish — strict per-trial ordering (trial
+    N's meta writes land before trial N+1's) with exactly one trial of
+    overlap, which is all the pipeline needs: trial N+1's propose/
+    validate/init runs while trial N persists.
+
+    Budget accounting: a submitted-but-uncommitted tail is a completion
+    the meta store can't see yet. ``completed_count`` folds the pending
+    count into the caller's COMPLETED query under the same lock the
+    tail's commit point holds, so the runner's budget check neither
+    double-counts a completion racing its own commit nor proposes an
+    extra trial past ``MODEL_TRIAL_COUNT``.
+
+    Tails never raise: the closure built in ``run_one`` catches its own
+    failures and retroactively marks the trial errored (the score was
+    real and the advisor already got its feedback — only persistence
+    failed)."""
+
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="trial-persist")
+        self._last: Optional[Future] = None
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._failures = 0
+
+    def note_failure(self) -> None:
+        """Called by a tail that errored its trial retroactively. The
+        runner's loop folds this into the consecutive-error circuit
+        breaker — otherwise a persistently failing tail (disk full)
+        would never trip it (run_one's row snapshot still says RUNNING)
+        and a trial-count budget would never be satisfied: an infinite
+        loop."""
+        with self._lock:
+            self._failures += 1
+
+    def failure_count(self) -> int:
+        with self._lock:
+            return self._failures
+
+    def submit(self, fn: Callable[[Callable], None]) -> None:
+        """``fn(commit)`` runs on the persist thread; it must call
+        ``commit(meta_write)`` at most once — the meta write and the
+        pending-count decrement happen atomically."""
+        if self._last is not None:
+            self._last.result()  # single slot; tails don't raise
+        with self._lock:
+            self._pending += 1
+
+        def run() -> None:
+            committed = [False]
+
+            def commit(meta_write: Callable[[], None]) -> None:
+                with self._lock:
+                    meta_write()
+                    self._pending -= 1
+                committed[0] = True
+
+            try:
+                fn(commit)
+            finally:
+                if not committed[0]:
+                    with self._lock:
+                        self._pending -= 1
+
+        self._last = self._pool.submit(run)
+
+    def completed_count(self, count_fn: Callable[[], int]) -> int:
+        """``count_fn()`` (the meta COMPLETED query) plus the pending
+        tails, read atomically against commits."""
+        with self._lock:
+            return int(count_fn()) + self._pending
+
+    def drain(self) -> None:
+        """Block until the in-flight tail (if any) has finished — after
+        this, no trial row of a submitted tail is left RUNNING."""
+        if self._last is not None:
+            self._last.result()
+
+    def close(self) -> None:
+        self.drain()
+        self._pool.shutdown(wait=True)
 
 
 class BudgetTracker:
@@ -62,7 +154,8 @@ class TrialRunner:
                  worker_id: str = "local",
                  budget: Optional[Dict[str, Any]] = None,
                  stop_flag: Optional[Any] = None,
-                 max_consecutive_errors: int = 3):
+                 max_consecutive_errors: int = 3,
+                 pipeline_persist: bool = False):
         self.model_class = model_class
         self.advisor = advisor
         self.train_dataset_path = train_dataset_path
@@ -79,35 +172,103 @@ class TrialRunner:
         # otherwise loop forever, since errored trials refund their budget
         # slot (advisor.forget) and never count as completed.
         self.max_consecutive_errors = max_consecutive_errors
+        # Pipelined trial tail (docs/training.md): the persist tail of
+        # a completed trial runs on a single-slot background stage so
+        # the NEXT trial's propose/validate/init overlaps it. Off by
+        # default for direct construction (tests/benches that inspect
+        # meta right after run_one); the TrainWorker turns it on. With
+        # it on, run_one may return a still-RUNNING row whose tail is
+        # in flight — run() and drain_persist() settle them.
+        self._persist = _PersistStage() if pipeline_persist else None
 
     # --- Loop ---
 
     def run(self) -> List[Dict[str, Any]]:
-        """Run trials until the budget is exhausted; returns trial rows."""
+        """Run trials until the budget is exhausted; returns trial rows.
+
+        Always drains the persist stage on the way out (budget spent,
+        stop flag, crash): no trial row is left RUNNING with its tail
+        still queued."""
         done: List[Dict[str, Any]] = []
         consecutive_errors = 0
-        while not self._should_stop():
-            row = self.run_one()
-            if row is None:
-                break
-            done.append(row)
-            if row["status"] == TrialStatus.ERRORED:
-                consecutive_errors += 1
-                if consecutive_errors >= self.max_consecutive_errors:
-                    _log.error(
-                        "worker %s: %d consecutive trial failures; "
-                        "giving up on %s", self.worker_id,
-                        consecutive_errors, self.sub_train_job_id)
+        tail_failures_seen = 0
+        finished = False
+        try:
+            while not finished:
+                while not self._should_stop():
+                    row = self.run_one()
+                    if row is None:
+                        finished = True  # advisor: search is over
+                        break
+                    done.append(row)
+                    errored = row["status"] == TrialStatus.ERRORED
+                    if self._persist is not None:
+                        # A failed persist tail errored a trial RETRO-
+                        # actively — after run_one snapshotted its row
+                        # as RUNNING. Fold those into the breaker or a
+                        # deterministic tail failure (disk full) loops
+                        # forever against a trial-count budget.
+                        f = self._persist.failure_count()
+                        if f > tail_failures_seen:
+                            tail_failures_seen = f
+                            errored = True
+                    if errored:
+                        consecutive_errors += 1
+                        if consecutive_errors >= \
+                                self.max_consecutive_errors:
+                            _log.error(
+                                "worker %s: %d consecutive trial "
+                                "failures; giving up on %s",
+                                self.worker_id, consecutive_errors,
+                                self.sub_train_job_id)
+                            finished = True
+                            break
+                    else:
+                        consecutive_errors = 0
+                if finished:
                     break
-            else:
-                consecutive_errors = 0
+                # The budget LOOKED satisfied, but an in-flight persist
+                # tail counted toward it optimistically. Settle it and
+                # re-check: a tail that failed turned its trial ERRORED
+                # — the slot is refunded (as the pre-pipelining inline
+                # error path did) and the loop runs a replacement trial
+                # instead of under-delivering the trial count.
+                self.drain_persist()
+                if self._should_stop():
+                    finished = True
+        finally:
+            self.drain_persist()
+        if self._persist is not None:
+            # run_one snapshotted pipelined rows BEFORE their tails
+            # committed; after the drain every trial is terminal in the
+            # meta store — return what it actually says, not a stale
+            # RUNNING/params_id=None view.
+            done = [self.meta.get_trial(row["id"]) or row
+                    for row in done]
         return done
+
+    def drain_persist(self) -> None:
+        """Wait for the in-flight persist tail (no-op when the pipeline
+        is off). After this every submitted trial row is terminal."""
+        if self._persist is not None:
+            self._persist.drain()
+
+    def close(self) -> None:
+        if self._persist is not None:
+            self._persist.close()
 
     def _should_stop(self) -> bool:
         if self.stop_flag is not None and self.stop_flag.is_set():
             return True
-        n_done = len(self.meta.get_trials(self.sub_train_job_id,
-                                          status=TrialStatus.COMPLETED))
+
+        def n_completed() -> int:
+            return len(self.meta.get_trials(self.sub_train_job_id,
+                                            status=TrialStatus.COMPLETED))
+
+        # A pending persist tail is a completion the meta store can't
+        # see yet; counting it keeps the budget exact under pipelining.
+        n_done = (self._persist.completed_count(n_completed)
+                  if self._persist is not None else n_completed())
         return self.budget.exhausted(n_done)
 
     # --- One trial ---
@@ -115,7 +276,10 @@ class TrialRunner:
     def run_one(self, proposal: Optional[Proposal] = None,
                 ) -> Optional[Dict[str, Any]]:
         if proposal is None:
+            t_prop = time.monotonic()
             proposal = self.advisor.propose()
+            _phases.observe_phase("propose",
+                                  time.monotonic() - t_prop)
         if proposal is None:  # advisor side says: search is over
             return None
         # Warm-start params are resolved BEFORE knob validation: a
@@ -165,10 +329,19 @@ class TrialRunner:
         # harness's utilization probe, a test capture): the trial's
         # records go to the meta store AND keep flowing outward, and the
         # prior binding is restored afterwards instead of nulled.
+        # With the persist pipeline on, the meta-store writes are
+        # BUFFERED and flushed by the trial's persist tail (one less
+        # sqlite insert interleaved with device dispatch); the chained
+        # outward flow stays live either way.
         prior_sink = logger.current_sink()
+        buffering = self._persist is not None
+        log_buffer: List[Any] = []
 
         def _trial_sink(rec, _tid=trial_id, _prior=prior_sink):
-            self.meta.add_trial_log(_tid, rec)
+            if buffering:
+                log_buffer.append(rec)
+            else:
+                self.meta.add_trial_log(_tid, rec)
             if _prior is not None:
                 _prior(rec)
 
@@ -208,34 +381,59 @@ class TrialRunner:
                 # label context attributes the train loop's MFU gauge /
                 # step-time histogram to THIS trial — the loop itself
                 # has no idea which trial it runs for.
+                t_train = time.monotonic()
                 with metrics.label_context(trial=trial_id[:12]), \
                         trace_session(trial_trace_dir(trial_id)):
                     model.train(self.train_dataset_path,
                                 shared_params=shared, **train_kwargs)
+                _phases.observe_phase("train",
+                                      time.monotonic() - t_train)
+                t_eval = time.monotonic()
                 score = float(model.evaluate(self.val_dataset_path))
+                _phases.observe_phase("eval",
+                                      time.monotonic() - t_eval)
                 # A proposal may retrieve from one scope and save under
                 # another (PBT exploitation inherits the winner's
                 # weights but keeps writing its own lineage).
                 save_scope = proposal.meta.get("params_save_scope") \
                     or params_scope
-                params_id = self.params.save(
-                    model.dump_parameters(),
-                    session_id=self.sub_train_job_id,
-                    worker_id=save_scope, score=score)
+                # Device arrays pass through un-pulled (the ParamStore
+                # write-behind does the packed D2H in the background).
+                dumped = model.dump_parameters()
             finally:
                 model.destroy()
-            self.meta.mark_trial_completed(trial_id, score, params_id)
-            # Scoped checkpoints outlive the trial — the configuration's
-            # next rung resumes them; cleanup_scoped_checkpoints() runs
-            # when the sub-job is done. Unscoped crash-resume dirs are
-            # spent once the trial completes.
+            # Spend the unscoped crash-resume checkpoint dir NOW, by a
+            # synchronous metadata-cheap rename: it is keyed by
+            # (sub_train_job, knobs), not trial id, so with the
+            # pipelined tail a same-knobs successor trial could
+            # otherwise resume THIS trial's final checkpoint (training
+            # zero epochs) — or have its own fresh dir rmtree'd from
+            # under it. The bulky recursive delete of the tombstone
+            # stays in the tail.
+            ckpt_tomb = None
             if ckpt_dir and not ckpt_scope:
-                shutil.rmtree(ckpt_dir, ignore_errors=True)
+                tomb = f"{ckpt_dir}.spent-{trial_id[:8]}"
+                try:
+                    os.rename(ckpt_dir, tomb)
+                    ckpt_tomb = tomb
+                except OSError:
+                    pass  # no checkpoint was ever written
+            # Feedback is NOT deferred behind persistence: the score is
+            # final once evaluate returned, and the (possibly
+            # prefetching) advisor folds it in while the tail flushes.
+            # It runs BEFORE the tail submission on purpose: once the
+            # tail owns the trial's log buffer and terminal status, no
+            # later exception on this thread may touch them (the except
+            # below would race the persist thread's writes).
             self.advisor.feedback(proposal, score)
+            self._finish_trial(trial_id, score, dumped, save_scope,
+                               log_buffer, ckpt_tomb)
             _log.info("trial %s #%d done: score=%.4f (%.1fs)", trial_id[:8],
                       proposal.trial_no, score, time.time() - t0)
         except Exception:
             err = traceback.format_exc()
+            for rec in log_buffer:  # buffered records outlive the error
+                self.meta.add_trial_log(trial_id, rec)
             self.meta.mark_trial_errored(trial_id, err)
             # The advisor will never get feedback for this proposal; let it
             # release per-proposal state (e.g. ENAS pending REINFORCE meta).
@@ -257,6 +455,56 @@ class TrialRunner:
                     m.remove(trial=trial_id[:12])
         return self.meta.get_trial(trial_id)
 
+    def _finish_trial(self, trial_id: str, score: float, dumped: Any,
+                      save_scope: str, log_buffer: List[Any],
+                      ckpt_tomb: Optional[str]) -> None:
+        """The completed-trial persist tail: flush the buffered trial
+        logs, hand the dumped parameters to the ParamStore, mark the
+        trial COMPLETED, sweep the spent (already tombstone-renamed)
+        crash-resume checkpoint dir.
+
+        Runs inline when the pipeline is off; on the single-slot
+        persist stage otherwise — trial N+1's propose/validate/init
+        then overlaps trial N's persistence. A tail failure
+        retroactively marks the trial ERRORED (the advisor's feedback
+        stands — the score was real; only persistence failed)."""
+
+        def tail(commit: Callable[[Callable], None]) -> None:
+            t_persist = time.monotonic()
+            try:
+                for rec in log_buffer:
+                    self.meta.add_trial_log(trial_id, rec)
+                params_id = self.params.save(
+                    dumped, session_id=self.sub_train_job_id,
+                    worker_id=save_scope, score=score)
+                commit(lambda: self.meta.mark_trial_completed(
+                    trial_id, score, params_id))
+                # Scoped checkpoints outlive the trial — the
+                # configuration's next rung resumes them;
+                # cleanup_scoped_checkpoints() runs when the sub-job is
+                # done. The spent unscoped dir was tombstone-renamed on
+                # the trial thread; only its deletion is deferred here.
+                if ckpt_tomb:
+                    shutil.rmtree(ckpt_tomb, ignore_errors=True)
+            except Exception:
+                err = traceback.format_exc()
+                _log.warning("trial %s: persist tail failed; marking "
+                             "errored:\n%s", trial_id[:8], err)
+                if self._persist is not None:
+                    self._persist.note_failure()
+                try:
+                    self.meta.mark_trial_errored(trial_id, err)
+                except Exception:
+                    _log.exception("trial %s: could not record persist "
+                                   "failure", trial_id[:8])
+            finally:
+                _phases.observe_phase("persist",
+                                      time.monotonic() - t_persist)
+
+        if self._persist is not None:
+            self._persist.submit(tail)
+        else:
+            tail(lambda meta_write: meta_write())
 
     def cleanup_scoped_checkpoints(self) -> None:
         """Remove every scoped checkpoint dir of this sub-train-job.

@@ -18,8 +18,10 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from ..advisor.prefetch import PrefetchAdvisor
 from ..advisor.worker import RemoteAdvisor
 from ..bus import BaseBus, connect
+from ..config import _parse_bool
 from ..constants import EnvVars, ServiceStatus, TrialStatus
 from ..parallel.chips import ChipGroup
 from ..store import MetaStore, ParamStore
@@ -27,6 +29,13 @@ from ..utils.model_loader import load_model_class
 from .runner import TrialRunner
 
 _log = logging.getLogger(__name__)
+
+#: Opt-out knob for the worker's advisor-prefetch pipelining
+#: (NodeConfig.advisor_prefetch; docs/training.md). Default ON: the
+#: next proposal computes on a background thread while the current
+#: trial trains — the one-observation staleness this introduces is the
+#: same asynchrony N parallel workers sharing one advisor already have.
+ADVISOR_PREFETCH_ENV = "RAFIKI_TPU_ADVISOR_PREFETCH"
 
 
 class TrainWorker:
@@ -99,11 +108,21 @@ class TrainWorker:
             self.chips.bind_to_thread()
         self.meta.update_service(self.service_id,
                                  status=ServiceStatus.RUNNING)
+        # Pipeline the advisor by default (opt-out via
+        # RAFIKI_TPU_ADVISOR_PREFETCH=0): the next proposal computes
+        # while the current trial trains. close() runs on EVERY exit
+        # path — stop flag, budget exhaustion, crash — so the dangling
+        # prefetched proposal is always forget-ed back to the strategy.
+        advisor = self.advisor
+        prefetch: Optional[PrefetchAdvisor] = None
+        if _parse_bool(os.environ.get(ADVISOR_PREFETCH_ENV, "1")):
+            advisor = prefetch = PrefetchAdvisor(advisor)
         runner = TrialRunner(
-            model_class, self.advisor, job["train_dataset_path"],
+            model_class, advisor, job["train_dataset_path"],
             job["val_dataset_path"], self.meta, self.params, self.sub_id,
             model_id=sub["model_id"], worker_id=self.service_id,
-            budget=job["budget"], stop_flag=self.stop_flag)
+            budget=job["budget"], stop_flag=self.stop_flag,
+            pipeline_persist=True)
         try:
             runner.run()
             # The job is truly over (budget spent, not a mid-job stop
@@ -120,3 +139,10 @@ class TrainWorker:
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.ERRORED)
             raise
+        finally:
+            # run() already drained the persist stage; close() stops
+            # its worker thread, and the prefetch close refunds the
+            # never-handed-out proposal.
+            runner.close()
+            if prefetch is not None:
+                prefetch.close()

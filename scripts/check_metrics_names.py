@@ -36,7 +36,8 @@ import sys
 
 PREFIX = "rafiki_tpu_"
 
-SUBSYSTEMS = {"bus", "serving", "http", "train", "trace", "node"}
+SUBSYSTEMS = {"bus", "serving", "http", "train", "trial", "trace",
+              "node"}
 
 # _total marks counters (Prometheus convention); everything else is the
 # physical unit of a gauge/histogram.

@@ -141,3 +141,81 @@ def test_from_config_platform(tmp_path):
         assert p.app is None
     finally:
         p.shutdown()
+
+
+def test_trial_lifecycle_knobs(monkeypatch):
+    """r9: the residency-cache budgets + advisor prefetch are NodeConfig
+    fields with env parity and apply_env export."""
+    cfg = NodeConfig.from_env(env={
+        "RAFIKI_TPU_DATASET_CACHE_BYTES": "1024",
+        "RAFIKI_TPU_STAGE_CACHE_BYTES": "0",
+        "RAFIKI_TPU_ADVISOR_PREFETCH": "off",
+    })
+    assert cfg.dataset_cache_bytes == 1024
+    assert cfg.stage_cache_bytes == 0
+    assert cfg.advisor_prefetch is False
+    import os
+
+    # setenv sentinels (not delenv): apply_env() mutates os.environ
+    # outside monkeypatch's bookkeeping, and a delenv of an ABSENT var
+    # registers no undo — the non-default budgets below (stage cache 0!)
+    # would otherwise leak into every later test in the session.
+    for var in ("RAFIKI_TPU_DATASET_CACHE_BYTES",
+                "RAFIKI_TPU_STAGE_CACHE_BYTES",
+                "RAFIKI_TPU_ADVISOR_PREFETCH"):
+        monkeypatch.setenv(var, "unset-sentinel")
+    cfg.apply_env()
+    assert os.environ["RAFIKI_TPU_DATASET_CACHE_BYTES"] == "1024"
+    assert os.environ["RAFIKI_TPU_STAGE_CACHE_BYTES"] == "0"
+    assert os.environ["RAFIKI_TPU_ADVISOR_PREFETCH"] == "0"
+    # the caches honor the exported budgets immediately
+    from rafiki_tpu.model.dataset import dataset_cache_budget
+
+    assert dataset_cache_budget() == 1024
+    with pytest.raises(ValueError):
+        NodeConfig(dataset_cache_bytes=-1).validate()
+
+
+def test_every_nodeconfig_knob_is_documented():
+    """Tier-1 gate: scripts/check_knob_docs.py asserts every NodeConfig
+    env knob appears in docs/ops.md, so a new knob can't silently go
+    undocumented."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo_root, "scripts", "check_knob_docs.py"),
+         repo_root],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "documented in docs/ops.md" in proc.stdout
+
+
+def test_knob_docs_check_catches_missing(tmp_path):
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    (tmp_path / "rafiki_tpu").mkdir()
+    shutil.copy(os.path.join(repo_root, "rafiki_tpu", "config.py"),
+                tmp_path / "rafiki_tpu" / "config.py")
+    (tmp_path / "docs").mkdir()
+    # RAFIKI_TPU_METRICS_PORT present must NOT count as documenting
+    # RAFIKI_TPU_METRICS (delimited-token match, not substring).
+    (tmp_path / "docs" / "ops.md").write_text(
+        "| `RAFIKI_TPU_WORKDIR` | only one knob documented |\n"
+        "also mentions RAFIKI_TPU_METRICS_PORT in passing\n")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo_root, "scripts", "check_knob_docs.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "RAFIKI_TPU_DATASET_CACHE_BYTES" in proc.stdout
+    assert "NodeConfig.metrics (RAFIKI_TPU_METRICS)" in proc.stdout
+    assert "RAFIKI_TPU_WORKDIR" not in proc.stdout

@@ -1,8 +1,19 @@
+import os
+
 import numpy as np
+import pytest
 
 from rafiki_tpu.model import (load_corpus_dataset, load_image_dataset,
                               write_corpus_dataset, write_image_dataset_npz,
                               write_image_files_dataset)
+from rafiki_tpu.model import dataset as mod_dataset
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dataset_cache():
+    mod_dataset.clear_dataset_cache()
+    yield
+    mod_dataset.clear_dataset_cache()
 
 
 def test_npz_roundtrip(tmp_path):
@@ -39,6 +50,58 @@ def test_batching():
     shuffled = list(ds.batches(10, shuffle=True, seed=1))[0][1]
     assert not np.array_equal(shuffled, labels)
     assert set(shuffled) == set(labels)
+
+
+def _write(tmp_path, name, seed, n=10):
+    imgs = np.random.default_rng(seed).integers(
+        0, 255, (n, 8, 8, 1), dtype=np.uint8)
+    return write_image_dataset_npz(imgs, np.arange(n) % 2,
+                                   str(tmp_path / name), 2)
+
+
+def test_dataset_cache_hit_returns_same_object(tmp_path):
+    p = _write(tmp_path, "a.npz", seed=0)
+    ds1 = load_image_dataset(p)
+    ds2 = load_image_dataset(p)
+    assert ds2 is ds1  # no re-parse: the resident object is served
+
+
+def test_dataset_cache_invalidates_on_rewrite(tmp_path):
+    """A rewritten file (new mtime_ns/size fingerprint) is a different
+    dataset — never a stale hit."""
+    p = _write(tmp_path, "a.npz", seed=0)
+    ds1 = load_image_dataset(p)
+    _write(tmp_path, "a.npz", seed=1)
+    st = os.stat(p)  # force a distinct mtime even on coarse clocks
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    ds2 = load_image_dataset(p)
+    assert ds2 is not ds1
+    assert not np.array_equal(ds2.images, ds1.images)
+
+
+def test_dataset_cache_byte_budget_lru(tmp_path, monkeypatch):
+    pa = _write(tmp_path, "a.npz", seed=0)
+    pb = _write(tmp_path, "b.npz", seed=1)
+    pc = _write(tmp_path, "c.npz", seed=2)
+    one = mod_dataset._dataset_nbytes(load_image_dataset(pa))
+    mod_dataset.clear_dataset_cache()
+    # room for exactly two datasets
+    monkeypatch.setenv(mod_dataset.DATASET_CACHE_ENV,
+                       str(2 * one + 16))
+    a = load_image_dataset(pa)
+    b = load_image_dataset(pb)
+    load_image_dataset(pc)        # evicts a (LRU)
+    assert load_image_dataset(pb) is b   # still resident
+    assert load_image_dataset(pa) is not a  # was evicted, re-parsed
+
+
+def test_dataset_cache_disabled_and_oversized(tmp_path, monkeypatch):
+    p = _write(tmp_path, "a.npz", seed=0)
+    monkeypatch.setenv(mod_dataset.DATASET_CACHE_ENV, "0")
+    assert load_image_dataset(p) is not load_image_dataset(p)
+    # a dataset larger than the whole budget is served uncached
+    monkeypatch.setenv(mod_dataset.DATASET_CACHE_ENV, "16")
+    assert load_image_dataset(p) is not load_image_dataset(p)
 
 
 def test_corpus_roundtrip(tmp_path):

@@ -711,3 +711,125 @@ def test_empty_and_oversized_requests(bus):
     finally:
         mb.stop()
         worker.stop()
+
+
+# --- Straggler detection: latency-relative resubmit deadline (r9) ---
+
+def test_partial_wait_latency_relative_with_full_ewma(bus):
+    """With every planned replica measured, the straggler deadline is
+    K x the slowest planned EWMA (floored), not the fixed half-timeout
+    fraction — a fast fleet resubmits in milliseconds."""
+    from rafiki_tpu.predictor import predictor as pred_mod
+    from rafiki_tpu.predictor.predictor import _Shard
+
+    p = _predictor(bus, gather_timeout=30.0)
+    p._note_latency("wA1", 0.010)
+    p._note_latency("wA2", 0.020)
+    plan = [_Shard("wA1", "tA", 0, 4), _Shard("wA2", "tA", 4, 4)]
+    wait = p._partial_wait(plan)
+    assert wait == pytest.approx(
+        max(pred_mod._STRAGGLER_K * 0.020, pred_mod._STRAGGLER_MIN))
+    assert wait < 1.0  # nowhere near 0.5 * 30s
+
+
+def test_partial_wait_falls_back_without_full_ewma(bus):
+    """Any never-measured replica in the plan means no honest latency
+    basis yet: the fixed fraction stays — and it is also the ceiling
+    when EWMAs are huge (a penalized replica's inflated value must not
+    push the deadline PAST the fixed fraction)."""
+    from rafiki_tpu.predictor import predictor as pred_mod
+    from rafiki_tpu.predictor.predictor import _Shard
+
+    p = _predictor(bus, gather_timeout=10.0)
+    p._note_latency("wA1", 0.010)
+    plan = [_Shard("wA1", "tA", 0, 4), _Shard("wA2", "tA", 4, 4)]
+    assert p._partial_wait(plan) == pytest.approx(
+        10.0 * pred_mod._RESUBMIT_AT)
+    p._note_latency("wA2", 100.0)  # measured, but absurdly slow
+    assert p._partial_wait(plan) == pytest.approx(
+        10.0 * pred_mod._RESUBMIT_AT)
+
+
+def test_fast_fleet_resubmits_well_before_fixed_fraction(bus):
+    """End to end: after one warm batch establishes millisecond EWMAs,
+    a replica dying mid-gather is re-covered by its sibling far sooner
+    than the fixed half-timeout deadline (10s here) would allow."""
+    w1 = EchoWorker(bus, "wA1", trial_id="tA")
+    w2 = EchoWorker(bus, "wA2", trial_id="tA")
+    p = _predictor(bus, gather_timeout=20.0)
+    qs = list(range(8))
+    try:
+        assert p.predict(qs) == _expected(qs)  # warm: EWMAs for both
+        w1.dead = True
+        t0 = time.monotonic()
+        assert p.predict(qs) == _expected(qs)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, \
+            f"latency-relative deadline did not engage ({elapsed:.2f}s)"
+    finally:
+        w1.stop()
+        w2.stop()
+
+
+# --- Batcher-off direct path: per-client fairness (r9) ---
+
+def test_direct_path_client_share_caps_inflight(bus):
+    """With the micro-batcher OFF, the same client_share caps one
+    client key's in-flight queries: the hog's overflow bounces with
+    429 reason=client_share while another client keeps being served."""
+    worker = EchoWorker(bus, delay=0.4)  # slow: requests stay in flight
+    svc = _service(bus, microbatch=False, client_header="X-Client-Id",
+                   client_share=0.25, queue_cap=16)  # cap = 4 queries
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    results = {"hog_ok": 0, "hog_429": 0, "other_ok": 0}
+    lock = threading.Lock()
+
+    def post(n, client, key):
+        r = requests.post(url, json={"queries": list(range(n))},
+                          headers={"X-Client-Id": client}, timeout=30)
+        if r.status_code == 429:
+            body = r.json()
+            assert body["reason"] == "client_share", body
+            assert r.headers.get("Retry-After"), "missing Retry-After"
+            with lock:
+                results[key.replace("ok", "429")] += 1
+        else:
+            r.raise_for_status()
+            with lock:
+                results[key] += 1
+
+    try:
+        assert svc.batcher is None and svc._direct_cap == 4
+        hogs = [threading.Thread(target=post, args=(3, "hog", "hog_ok"))
+                for _ in range(6)]
+        [t.start() for t in hogs]
+        time.sleep(0.1)  # hog floods first; its slices are in flight
+        others = [threading.Thread(target=post,
+                                   args=(1, f"c{i}", "other_ok"))
+                  for i in range(4)]
+        [t.start() for t in others]
+        [t.join(timeout=30) for t in hogs + others]
+        assert results["hog_429"] > 0, results
+        assert results["other_ok"] == 4, results
+        assert svc.stats.snapshot()["rejected_by_reason"].get(
+            "client_share", 0) == results["hog_429"]
+        assert svc._direct_pending == {}  # fully released
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_direct_path_fairness_off_without_header(bus):
+    """No client header configured -> no per-key bound on the direct
+    path (pre-r9 behavior)."""
+    worker = EchoWorker(bus)
+    svc = _service(bus, microbatch=False)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    try:
+        assert svc._direct_cap == 0
+        r = requests.post(url, json={"queries": list(range(64))},
+                          headers={"X-Client-Id": "hog"}, timeout=30)
+        assert r.status_code == 200
+    finally:
+        _teardown(svc)
+        worker.stop()

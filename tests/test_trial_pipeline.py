@@ -1,0 +1,351 @@
+"""Cross-trial dataset residency + pipelined trial lifecycle (r9).
+
+Covers the two caches (host dataset cache in ``model/dataset.py``,
+device staging cache in ``model/jax_model.py``) and the TrialRunner's
+single-slot persist stage: LRU/byte-budget behavior, invalidation
+rules (file rewrite, mesh change), the never-donated guarantee, the
+counter-based zero-disk-load / zero-H2D regression for trial 2..N,
+and persist ordering / drain / retroactive-error semantics.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from rafiki_tpu.advisor.base import Proposal
+from rafiki_tpu.constants import BudgetOption, TrialStatus
+from rafiki_tpu.model import dataset as mod_dataset
+from rafiki_tpu.model import jax_model as mod_jax
+from rafiki_tpu.model.base import BaseModel
+from rafiki_tpu.model.dataset import (load_image_dataset,
+                                      write_image_dataset_npz)
+from rafiki_tpu.model.knobs import FixedKnob
+from rafiki_tpu.model.logger import logger
+from rafiki_tpu.models.feedforward import JaxFeedForward
+from rafiki_tpu.observe import phases
+from rafiki_tpu.parallel import build_mesh
+from rafiki_tpu.store import MetaStore, ParamStore
+from rafiki_tpu.worker.runner import TrialRunner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    mod_dataset.clear_dataset_cache()
+    mod_jax.clear_stage_cache()
+    yield
+    mod_dataset.clear_dataset_cache()
+    mod_jax.clear_stage_cache()
+
+
+def _write_ds(path, n=12, seed=0, hw=8):
+    rng = np.random.default_rng(seed)
+    imgs = rng.integers(0, 255, (n, hw, hw, 1), dtype=np.uint8)
+    labels = np.arange(n) % 3
+    return write_image_dataset_npz(imgs, labels, str(path), 3)
+
+
+# --- Device staging cache ---
+
+def test_stage_cache_hits_and_mesh_change_invalidates(tmp_path):
+    p = _write_ds(tmp_path / "a.npz")
+    ds = load_image_dataset(p)
+    mesh8 = build_mesh(jax.devices())
+    d1, l1 = mod_jax.staged_dataset_arrays(p, ds, mesh8)
+    d2, l2 = mod_jax.staged_dataset_arrays(p, ds, mesh8)
+    assert d2 is d1 and l2 is l1  # resident across calls
+    np.testing.assert_array_equal(np.asarray(d1), ds.images)
+    np.testing.assert_array_equal(np.asarray(l1),
+                                  ds.labels.astype(np.int32))
+    # A different chip group is a different key: staged arrays are
+    # never served across a mesh change.
+    mesh4 = build_mesh(jax.devices()[:4])
+    d3, _ = mod_jax.staged_dataset_arrays(p, ds, mesh4)
+    assert d3 is not d1
+    assert mod_jax.stage_cache_info()["entries"] == 2
+
+
+def test_stage_cache_byte_budget_lru_eviction(tmp_path, monkeypatch):
+    pa = _write_ds(tmp_path / "a.npz", seed=1)
+    pb = _write_ds(tmp_path / "b.npz", seed=2)
+    dsa, dsb = load_image_dataset(pa), load_image_dataset(pb)
+    one = int(dsa.images.nbytes) + 4 * dsa.size
+    monkeypatch.setenv(mod_jax.STAGE_CACHE_ENV, str(one + 8))
+    mesh = build_mesh(jax.devices())
+    da1, _ = mod_jax.staged_dataset_arrays(pa, dsa, mesh)
+    mod_jax.staged_dataset_arrays(pb, dsb, mesh)  # evicts a (LRU)
+    assert mod_jax.stage_cache_info()["entries"] == 1
+    da2, _ = mod_jax.staged_dataset_arrays(pa, dsa, mesh)
+    assert da2 is not da1  # a was re-staged after eviction
+
+
+def test_stage_cache_disabled_by_zero_budget(tmp_path, monkeypatch):
+    monkeypatch.setenv(mod_jax.STAGE_CACHE_ENV, "0")
+    p = _write_ds(tmp_path / "a.npz")
+    ds = load_image_dataset(p)
+    mesh = build_mesh(jax.devices())
+    d1, _ = mod_jax.staged_dataset_arrays(p, ds, mesh)
+    d2, _ = mod_jax.staged_dataset_arrays(p, ds, mesh)
+    assert d2 is not d1
+    assert mod_jax.stage_cache_info()["entries"] == 0
+
+
+FAST_KNOBS = {"hidden_layer_count": 1, "hidden_layer_units": 16,
+              "learning_rate": 3e-3, "batch_size": 64, "max_epochs": 5}
+
+
+def test_staged_arrays_never_donated_across_trainings(synth_image_data):
+    """Train twice on the same dataset: the second training (and its
+    eval) must find the FIRST training's staged buffers still valid —
+    nothing may have donated or deleted them."""
+    train_path, val_path = synth_image_data
+    scores = []
+    for _ in range(2):
+        m = JaxFeedForward(**JaxFeedForward.validate_knobs(FAST_KNOBS))
+        m.train(train_path)
+        scores.append(float(m.evaluate(val_path)))
+        m.destroy()
+    assert mod_jax.stage_cache_info()["entries"] == 2  # train + val
+    for data, labels in mod_jax._STAGE_CACHE.values():
+        assert not data.is_deleted() and not labels.is_deleted()
+        np.asarray(data)  # still readable end to end
+    # identical data + seed -> the cached path reproduces the score
+    assert scores[0] == pytest.approx(scores[1], abs=1e-6)
+
+
+# --- Zero disk loads / zero full-dataset H2D for trial 2..N ---
+
+class _FixedAdvisor:
+    def __init__(self, knobs):
+        self.knobs = knobs
+        self.n = 0
+        self.feedbacks = []
+
+    def propose(self):
+        self.n += 1
+        return Proposal(trial_no=self.n, knobs=dict(self.knobs))
+
+    def feedback(self, proposal, score):
+        self.feedbacks.append((proposal.trial_no, score))
+
+
+def test_trial_2_zero_disk_loads_and_zero_h2d(tmp_path,
+                                              synth_image_data):
+    train_path, val_path = synth_image_data
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "p"))
+    runner = TrialRunner(JaxFeedForward, _FixedAdvisor(FAST_KNOBS),
+                         train_path, val_path, meta, params, "sub-r9",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 3})
+    runner.run_one()  # trial 1 pays the misses
+    ds_before = phases.cache_counts("dataset")
+    st_before = phases.cache_counts("stage")
+    runner.run_one()  # trial 2 must be fully resident
+    ds_after = phases.cache_counts("dataset")
+    st_after = phases.cache_counts("stage")
+    assert ds_after.get("miss", 0) == ds_before.get("miss", 0)
+    assert st_after.get("miss", 0) == st_before.get("miss", 0)
+    # train + eval each hit both caches
+    assert ds_after.get("hit", 0) >= ds_before.get("hit", 0) + 2
+    assert st_after.get("hit", 0) >= st_before.get("hit", 0) + 2
+    meta.close()
+    params.close()
+
+
+# --- Pipelined persist tail ---
+
+CONFIG = {"width": FixedKnob(32)}
+
+
+def _fake_model(events):
+    class _Fake(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return CONFIG
+
+        def train(self, path, *, shared_params=None, **kw):
+            events.append(("train", time.monotonic()))
+            logger.log(msg="fake trained")
+            self._params = {"w": np.asarray(1.0)}
+
+        def evaluate(self, path):
+            return 0.5
+
+        def predict(self, queries):
+            return [0 for _ in queries]
+
+        def dump_parameters(self):
+            return dict(self._params)
+
+        def load_parameters(self, params):
+            self._params = dict(params)
+
+    return _Fake
+
+
+def test_persist_pipeline_overlaps_orders_and_drains(tmp_path,
+                                                     monkeypatch):
+    """Trial N+1's work overlaps trial N's (slow) persistence, meta
+    commits stay in trial order, the budget stays exact, and run()
+    drains — no RUNNING rows survive it."""
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "p"))
+    events = []
+    orig_save = params.save
+
+    def slow_save(ps, **kw):
+        events.append(("save_start", time.monotonic()))
+        time.sleep(0.15)
+        out = orig_save(ps, **kw)
+        events.append(("save_end", time.monotonic()))
+        return out
+
+    monkeypatch.setattr(params, "save", slow_save)
+    advisor = _FixedAdvisor({"width": 32})
+    runner = TrialRunner(_fake_model(events), advisor, "tr", "va",
+                         meta, params, "sub-pipe",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 3},
+                         pipeline_persist=True)
+    rows = runner.run()
+    runner.close()
+    # run() returns POST-drain rows: terminal status + params id, not
+    # the pre-commit RUNNING snapshots run_one took.
+    assert [r["status"] for r in rows] == [TrialStatus.COMPLETED] * 3
+    assert all(r["params_id"] for r in rows)
+    trials = sorted(meta.get_trials("sub-pipe"), key=lambda t: t["no"])
+    assert [t["status"] for t in trials] == [TrialStatus.COMPLETED] * 3
+    # budget exact despite the pipelined (meta-invisible) completions
+    assert advisor.n == 3
+    # strict per-trial ordering of the persisted commits
+    finished = [t["finished_at"] for t in trials]
+    assert finished == sorted(finished)
+    # overlap actually happened: some trial trained while the previous
+    # trial's save was still in flight
+    saves = [(t0, next(t1 for n1, t1 in events
+                       if n1 == "save_end" and t1 > t0))
+             for n0, t0 in events if n0 == "save_start"]
+    trains = [t for n, t in events if n == "train"]
+    assert any(s0 < t < s1 for t in trains for s0, s1 in saves), \
+        (events,)
+    # buffered trial logs were flushed by the tail
+    logs = meta.get_trial_logs(trials[0]["id"])
+    assert any(r["record"].get("values", {}).get("msg") ==
+               "fake trained" or "fake trained" in str(r["record"])
+               for r in logs)
+    meta.close()
+    params.close()
+
+
+def test_persist_failure_retroactively_errors_trial(tmp_path,
+                                                    monkeypatch):
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "p"))
+
+    def bad_save(ps, **kw):
+        raise RuntimeError("disk full (injected)")
+
+    monkeypatch.setattr(params, "save", bad_save)
+    advisor = _FixedAdvisor({"width": 32})
+    runner = TrialRunner(_fake_model([]), advisor, "tr", "va", meta,
+                         params, "sub-err",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 1},
+                         pipeline_persist=True)
+    row = runner.run_one()
+    assert row is not None
+    runner.drain_persist()
+    runner.close()
+    trial = meta.get_trials("sub-err")[0]
+    assert trial["status"] == TrialStatus.ERRORED
+    assert "disk full" in trial["error"]
+    # the score was real: feedback reached the advisor anyway
+    assert advisor.feedbacks == [(1, 0.5)]
+    meta.close()
+    params.close()
+
+
+def test_stop_flag_drains_no_running_rows(tmp_path, monkeypatch):
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "p"))
+    orig_save = params.save
+    monkeypatch.setattr(
+        params, "save",
+        lambda ps, **kw: (time.sleep(0.2), orig_save(ps, **kw))[1])
+    stop = threading.Event()
+
+    class _StopAfterOne(_FixedAdvisor):
+        def feedback(self, proposal, score):
+            super().feedback(proposal, score)
+            stop.set()  # supervisor stops the job mid-persist
+
+    runner = TrialRunner(_fake_model([]), _StopAfterOne({"width": 32}),
+                         "tr", "va", meta, params, "sub-stop",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 50},
+                         stop_flag=stop, pipeline_persist=True)
+    runner.run()
+    runner.close()
+    trials = meta.get_trials("sub-stop")
+    assert trials and all(t["status"] != TrialStatus.RUNNING
+                          for t in trials)
+    meta.close()
+    params.close()
+
+
+def test_repeated_tail_failures_trip_circuit_breaker(tmp_path,
+                                                     monkeypatch):
+    """A deterministic persist failure (disk full) must stop the loop
+    via the consecutive-error breaker even though each run_one snapshot
+    still said RUNNING — not spin forever against a trial-count budget
+    that can never be satisfied."""
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "p"))
+    monkeypatch.setattr(
+        params, "save",
+        lambda ps, **kw: (_ for _ in ()).throw(
+            RuntimeError("disk full (injected)")))
+    runner = TrialRunner(_fake_model([]), _FixedAdvisor({"width": 32}),
+                         "tr", "va", meta, params, "sub-breaker",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 50},
+                         pipeline_persist=True)
+    runner.run()  # must terminate
+    runner.close()
+    trials = meta.get_trials("sub-breaker")
+    assert 3 <= len(trials) <= 5  # breaker fired, not the 50-budget
+    assert all(t["status"] == TrialStatus.ERRORED for t in trials)
+    meta.close()
+    params.close()
+
+
+def test_failed_final_tail_refunds_budget_slot(tmp_path, monkeypatch):
+    """A persist failure on the trial that LOOKED like it satisfied the
+    budget must refund its slot after the drain (pre-pipelining
+    semantics): the loop runs a replacement trial instead of
+    under-delivering MODEL_TRIAL_COUNT."""
+    meta = MetaStore(":memory:")
+    params = ParamStore(str(tmp_path / "p"))
+    orig_save = params.save
+    calls = [0]
+
+    def flaky_save(ps, **kw):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("transient disk error (injected)")
+        return orig_save(ps, **kw)
+
+    monkeypatch.setattr(params, "save", flaky_save)
+    runner = TrialRunner(_fake_model([]), _FixedAdvisor({"width": 32}),
+                         "tr", "va", meta, params, "sub-refund",
+                         budget={BudgetOption.MODEL_TRIAL_COUNT: 2},
+                         pipeline_persist=True)
+    runner.run()
+    runner.close()
+    trials = meta.get_trials("sub-refund")
+    by_status = {}
+    for t in trials:
+        by_status[t["status"]] = by_status.get(t["status"], 0) + 1
+    assert by_status.get(TrialStatus.COMPLETED) == 2, by_status
+    assert by_status.get(TrialStatus.ERRORED) == 1, by_status
+    meta.close()
+    params.close()
