@@ -21,6 +21,11 @@ jobs contending for chip ranges, BASELINE config[4] (needs >= 2 devices;
 run on the CPU mesh via JAX_PLATFORMS=cpu
 XLA_FLAGS=--xla_force_host_platform_device_count=8).
 
+``--config analysis``: static-analysis gate smoke — runs
+``python -m rafiki_tpu.analysis --json`` and records the per-code
+finding counts (value = NEW findings; healthy is exactly 0). Excluded
+from the sweep: it is a gate, not a perf figure.
+
 The reference publishes no numbers (BASELINE.md): the first recorded run
 of each config on TPU establishes its baseline; the BASELINES table
 below holds those recorded figures per platform channel; update them
@@ -1203,6 +1208,36 @@ def main_attention() -> dict:
     return _emit("flash_attention_tflops", tflops, "TFLOP/s", **fields)
 
 
+def main_analysis() -> dict:
+    """Static-analysis smoke (docs/analysis.md): run the suite's own
+    ``--json`` CLI on this checkout and fold the per-code finding counts
+    into the bench record. The headline value is NEW findings — 0 is the
+    only healthy number (the suite is a gate, not a throughput metric),
+    so this config never participates in the perf sweep and vs_baseline
+    stays null off-accelerator like every other record."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.analysis", "--json"],
+        capture_output=True, text=True, cwd=root, timeout=600)
+    try:
+        report = json.loads(out.stdout)
+    except ValueError:
+        raise RuntimeError(
+            f"analysis CLI emitted no JSON (rc {out.returncode}): "
+            f"{out.stderr.strip()[:500]}")
+    return _emit(
+        "analysis_new_findings", float(report["new"]), "findings",
+        exit_code=out.returncode,
+        files=report["files"],
+        checkers=report["checkers"],
+        counts_per_code=report["counts_per_code"],
+        by_status=report["by_status"],
+        stale_baseline=len(report["stale_baseline"]))
+
+
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
                                         image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
@@ -1229,6 +1264,9 @@ _CONFIGS = {
     "enas": (main_enas, "enas_trials_per_hour", "trials/hour"),
     "roofline": (main_roofline, "lm_train_tokens_per_sec", "tokens/s"),
     "attention": (main_attention, "flash_attention_tflops", "TFLOP/s"),
+    # Not in _SWEEP_ORDER: a gate (0 new findings), not a perf figure —
+    # run explicitly via --config analysis.
+    "analysis": (main_analysis, "analysis_new_findings", "findings"),
 }
 
 

@@ -468,6 +468,31 @@ class Admin:
             return {"trace_id": trace_id, "n_spans": 0, "spans": []}
         return trace_mod.collect_trace(log_dir, trace_id)
 
+    def get_trial_phases(self) -> Dict[str, Any]:
+        """Cumulative trial-lifecycle phase breakdown + residency-cache
+        counters for the dashboard's trial view. Same visibility caveat
+        as the /status MFU gauge: resident-runner mode puts the workers
+        in THIS process so the registry has the series; subprocess
+        workers publish the same families on their own /metrics, which
+        this endpoint cannot see — ``resident`` says which case this is
+        so the UI can label an all-zero table honestly."""
+        from ..observe import metrics as obs_metrics
+        from ..observe import phases as obs_phases
+
+        totals = obs_phases.phase_totals()
+        resident = any(v["count"] for v in totals.values())
+        phases = {
+            p: {"count": int(v["count"]),
+                "total_s": round(v["sum"], 3),
+                "mean_ms": round(v["sum"] / v["count"] * 1e3, 1)
+                if v["count"] else 0.0}
+            for p, v in totals.items()}
+        caches = {c: obs_phases.cache_counts(c)
+                  for c in ("dataset", "stage")}
+        return {"enabled": obs_metrics.metrics_enabled(),
+                "resident": resident, "phases": phases,
+                "caches": caches}
+
     def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
         return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
 

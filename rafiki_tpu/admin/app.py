@@ -20,6 +20,9 @@ route-compatible so reference quickstart scripts port 1:1:
                                      server-side for the dashboard)
 - ``POST /inference_jobs/<id>/stop``
 - ``GET  /trace/<trace_id>``         stitched span timeline of one trace
+- ``GET  /trial_phases``             trial-lifecycle phase breakdown +
+                                     residency-cache counters (resident
+                                     workers only; see docs/training.md)
 - ``GET  /metrics``                  Prometheus exposition (auto-wired
                                      by ``JsonHttpServer``; no auth,
                                      like any scrape endpoint)
@@ -70,6 +73,7 @@ class AdminApp:
             ("GET", "/users", self._list_users),
             ("POST", "/users/<user_id>/ban", self._ban_user),
             ("GET", "/status", self._status),
+            ("GET", "/trial_phases", self._trial_phases),
             ("POST", "/datasets", self._create_dataset),
             ("GET", "/datasets", self._list_datasets),
             ("GET", "/services", self._list_services),
@@ -208,6 +212,10 @@ class AdminApp:
     def _status(self, params, body, ctx):
         self._auth(ctx)
         return 200, self.admin.get_status()
+
+    def _trial_phases(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_trial_phases()
 
     def _create_dataset(self, params, body, ctx):
         claims = self._auth(ctx, *_WRITE_TYPES)

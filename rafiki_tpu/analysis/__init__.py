@@ -1,0 +1,54 @@
+"""Repo-native static analysis: machine-checked concurrency,
+resource-lifecycle and drift invariants as tier-1 gates.
+
+Every checker here encodes a bug class this reproduction actually
+shipped and found by hand:
+
+- **RTA1xx guarded-state** — the ParamStore write-behind
+  row-before-file race (r6) was a cross-thread invariant nobody's eyes
+  caught until a cross-process reader crashed. The checker infers each
+  class's lock-guarded attribute set from ``with self._lock:`` bodies
+  and flags accesses outside any guarding lock, blocking calls made
+  while holding a lock, and lock-order cycles.
+- **RTA2xx thread-lifecycle** — the ``_PersistStage``/batcher/
+  write-behind pattern: every ``threading.Thread`` must be daemonized
+  or joined on some stop/close/drain path, every executor shut down.
+- **RTA3xx series-lifecycle** — the r7 leaked per-trial/per-instance
+  metric series: dynamically-labeled series need a matching
+  ``.remove(...)`` in the same module.
+- **RTA4xx donation/aliasing** — the r9 staged-arrays hazard: values
+  that escape into caches must never be passed at ``donate_argnums``
+  positions, and a donated name must not be read after the call.
+- **RTA5xx drift** — the former ``scripts/check_metrics_names.py``
+  and ``scripts/check_knob_docs.py``, folded in and extended: metric
+  naming, dashboard references, knob documentation, and every
+  ``RAFIKI_TPU_*`` env literal read anywhere must be a NodeConfig
+  field with ``apply_env`` parity.
+
+Stdlib-only (``ast``; no jax import — the suite runs in any
+environment that can run pytest). Entry points:
+
+    python -m rafiki_tpu.analysis [--changed] [--json] [--update-baseline]
+
+and programmatically :func:`run_suite`. Pre-existing findings are
+frozen in ``baseline.json`` next to this package (each with a reason);
+CI enforces **zero new findings**. One-off accepted findings are
+waived inline: ``# rta: disable=RTA101 <reason>`` (reason required).
+
+See ``docs/analysis.md`` for the checker catalog, the historical bug
+behind each code, and the waiver/baseline policy.
+"""
+
+from .core import (  # noqa: F401
+    Checker,
+    Finding,
+    RepoContext,
+    all_checkers,
+    baseline_path,
+    load_baseline,
+    register,
+    run_suite,
+)
+
+__all__ = ["Checker", "Finding", "RepoContext", "all_checkers",
+           "baseline_path", "load_baseline", "register", "run_suite"]

@@ -1,0 +1,9 @@
+"""Checker modules; importing this package registers them all."""
+
+from . import (  # noqa: F401
+    donation,
+    drift,
+    guarded_state,
+    series_lifecycle,
+    thread_lifecycle,
+)

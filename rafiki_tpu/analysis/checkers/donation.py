@@ -1,0 +1,316 @@
+"""RTA4xx — jax buffer donation vs escaped/cached values.
+
+Historical bug this encodes: the r9 staged-arrays hazard. The device
+staging cache keeps the replicated dataset arrays resident across
+trials; if any compiled step ever listed them in ``donate_argnums``,
+XLA would free the cached buffers out from under every later trial —
+a use-after-free that only manifests as corrupted results or an
+``is_deleted`` crash trials later. PR 4 shipped a never-donate guard
+(only the train state is donated) plus a defensive re-stage check;
+this checker makes the invariant mechanical.
+
+Mechanics (module-scope, two-level dataflow — no execution):
+
+- **Donating functions**: ``@jax.jit(donate_argnums=...)`` /
+  ``@partial(jax.jit, donate_argnums=...)`` decorated defs and
+  ``f2 = jax.jit(f, donate_argnums=...)`` bindings; plain-name
+  aliases (``exe = train_chunk``) inherit the donation signature.
+- **Forwarders**: a local function that passes its own parameter to a
+  donating function at a donated position donates that parameter
+  itself (the AOT ``dispatch`` wrapper pattern).
+- **Cache-tainted values**: names assigned (possibly through tuple
+  unpacking) from a call whose name mentions ``stage``/``cache``, or
+  from a subscript/attribute of a ``*_CACHE`` global.
+
+RTA401: a cache-tainted value is passed at a donated position.
+RTA402: a name passed at a donated position is read again later in
+the same scope with no rebind in between (use-after-donate); the
+``state, m = step(state, ...)`` rebind idiom is recognized as safe.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, RepoContext, register
+
+_CACHE_CALL_RE = re.compile(r"stage|cache", re.IGNORECASE)
+_CACHE_GLOBAL_RE = re.compile(r"_CACHE\b")
+
+
+def _last_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """``donate_argnums`` from a ``jax.jit``/``partial(jax.jit, ...)``
+    call expression, or None when it doesn't donate."""
+    is_jit = _last_name(call.func) == "jit"
+    is_partial = _last_name(call.func) == "partial" and call.args and \
+        _last_name(call.args[0]) == "jit"
+    if not (is_jit or is_partial):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = tuple(el.value for el in v.elts
+                            if isinstance(el, ast.Constant)
+                            and isinstance(el.value, int))
+                return out or None
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return None
+
+
+class _Scope:
+    """One function body (or the module body): tainted names, donating
+    call sites, assignments — enough for the RTA401/402 judgments."""
+
+    def __init__(self, node, name: str):
+        self.node = node
+        self.name = name
+        self.tainted: Set[str] = set()
+        # name -> lines where the name is (re)bound
+        self.binds: Dict[str, List[int]] = {}
+        # name -> lines where the name is read
+        self.loads: Dict[str, List[int]] = {}
+        self.calls: List[ast.Call] = []
+        self.aliases: Dict[str, Set[str]] = {}  # name -> aliased names
+
+    def body_stmts(self):
+        return self.node.body
+
+    def analyze(self) -> None:
+        # walk, but do not descend into nested function bodies — they
+        # are their own scopes (we still record the def line as a bind).
+        stack = list(self.body_stmts())
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.binds.setdefault(node.name, []).append(node.lineno)
+                continue
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    self._bind(tgt, node.value)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and \
+                    node.value is not None:
+                self._bind(node.target, node.value)
+            elif isinstance(node, ast.Call):
+                self.calls.append(node)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                self.loads.setdefault(node.id, []).append(node.lineno)
+            stack.extend(ast.iter_child_nodes(node))
+        # Taint closure over plain aliases (a = b chains), 2 rounds.
+        for _ in range(2):
+            for name, srcs in self.aliases.items():
+                if srcs & self.tainted:
+                    self.tainted.add(name)
+
+    def _bind(self, tgt: ast.AST, value: ast.AST) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            values = value.elts if isinstance(
+                value, (ast.Tuple, ast.List)) and \
+                len(value.elts) == len(tgt.elts) else \
+                [value] * len(tgt.elts)
+            for el, v in zip(tgt.elts, values):
+                self._bind(el, v)
+            return
+        if not isinstance(tgt, ast.Name):
+            return
+        self.binds.setdefault(tgt.id, []).append(tgt.lineno)
+        if _expr_tainted(value):
+            self.tainted.add(tgt.id)
+        elif isinstance(value, ast.Name):
+            self.aliases.setdefault(tgt.id, set()).add(value.id)
+
+
+def _expr_tainted(value: ast.AST) -> bool:
+    """Does this RHS pull from a staging/residency cache?"""
+    if isinstance(value, ast.Call):
+        name = _last_name(value.func)
+        if _CACHE_CALL_RE.search(name):
+            return True
+        # one level deep: _STAGE_CACHE.get(...)
+        if isinstance(value.func, ast.Attribute):
+            return _expr_tainted(value.func.value)
+        return False
+    if isinstance(value, ast.Subscript) or isinstance(value,
+                                                      ast.Attribute):
+        return _expr_tainted(value.value)
+    if isinstance(value, ast.Name):
+        return bool(_CACHE_GLOBAL_RE.search(value.id))
+    return False
+
+
+@register
+class DonationChecker(Checker):
+    name = "donation"
+    codes = ("RTA401", "RTA402")
+
+    def run(self, ctx: RepoContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in ctx.target_modules():
+            if mod.tree is None or "donate" not in mod.text:
+                continue
+            findings.extend(self._check_module(mod.rel, mod.tree))
+        return findings
+
+    # --- per module ---
+
+    def _check_module(self, rel: str, tree: ast.AST) -> List[Finding]:
+        donating: Dict[str, Dict[int, str]] = {}  # fn -> {pos: param}
+
+        # Pass A: decorated defs + jax.jit(f, donate_argnums=...) binds.
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        pos = _donate_positions(dec)
+                        if pos:
+                            donating[node.name] = self._params_at(
+                                node, pos)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                pos = _donate_positions(node.value)
+                if pos and node.value.args:
+                    inner = node.value.args[0]
+                    if _last_name(node.value.func) == "partial":
+                        inner = None  # partial(jax.jit, ...) is a decorator
+                    if isinstance(inner, ast.Name):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                donating[tgt.id] = {
+                                    p: f"arg{p}" for p in pos}
+
+        if not donating:
+            return []
+
+        # Pass B: plain-name aliases (exe = train_chunk) and forwarders
+        # (dispatch passes its param at a donated position), 2 rounds.
+        scopes = self._scopes(tree)
+        for _ in range(2):
+            for scope in scopes:
+                for stmt in ast.walk(scope.node):
+                    if isinstance(stmt, ast.Assign) and \
+                            isinstance(stmt.value, ast.Name) and \
+                            stmt.value.id in donating:
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name) and \
+                                    tgt.id not in donating:
+                                donating[tgt.id] = donating[
+                                    stmt.value.id]
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                fwd = self._forwarded_positions(node, donating)
+                if fwd and node.name not in donating:
+                    donating[node.name] = fwd
+
+        # Pass C: judge call sites per scope.
+        findings: List[Finding] = []
+        for scope in scopes:
+            scope.analyze()
+            findings.extend(
+                self._judge_scope(rel, scope, donating))
+        return findings
+
+    @staticmethod
+    def _params_at(node, positions) -> Dict[int, str]:
+        params = [a.arg for a in node.args.args]
+        return {p: (params[p] if p < len(params) else f"arg{p}")
+                for p in positions}
+
+    def _forwarded_positions(self, node, donating) -> Dict[int, str]:
+        """Positions of ``node``'s params that flow into a donated
+        position of a known donating function."""
+        params = [a.arg for a in node.args.args]
+        out: Dict[int, str] = {}
+        for call in [n for n in ast.walk(node)
+                     if isinstance(n, ast.Call)]:
+            sig = donating.get(_last_name(call.func))
+            if not sig:
+                continue
+            for pos, _pname in sig.items():
+                if pos < len(call.args) and \
+                        isinstance(call.args[pos], ast.Name):
+                    arg = call.args[pos].id
+                    if arg in params:
+                        out[params.index(arg)] = arg
+        return out
+
+    def _scopes(self, tree: ast.AST) -> List[_Scope]:
+        scopes = [_Scope(tree, "<module>")] if hasattr(tree, "body") \
+            else []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(_Scope(node, node.name))
+        return scopes
+
+    def _judge_scope(self, rel: str, scope: _Scope,
+                     donating: Dict[str, Dict[int, str]]
+                     ) -> List[Finding]:
+        findings: List[Finding] = []
+        for call in scope.calls:
+            sig = donating.get(_last_name(call.func))
+            if not sig:
+                continue
+            fname = _last_name(call.func)
+            for pos, pname in sig.items():
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if _expr_tainted(arg) or (
+                        isinstance(arg, ast.Name) and
+                        arg.id in scope.tainted):
+                    label = arg.id if isinstance(arg, ast.Name) \
+                        else ast.unparse(arg) if hasattr(ast, "unparse") \
+                        else "<expr>"
+                    findings.append(Finding(
+                        code="RTA401", path=rel, line=call.lineno,
+                        message=f"{label!r} comes from a staging/"
+                                f"residency cache but is passed at "
+                                f"donated position {pos} ({pname}) of "
+                                f"{fname}() — XLA will free the cached "
+                                f"buffer under every later consumer",
+                        hint="never donate cache-resident arrays; "
+                             "donate only the per-call state "
+                             "(train state / optimizer state)",
+                        anchor=f"{scope.name}:{fname}:{pos}"))
+                elif isinstance(arg, ast.Name):
+                    f = self._use_after_donate(rel, scope, call, arg.id,
+                                               fname, pos)
+                    if f is not None:
+                        findings.append(f)
+        return findings
+
+    def _use_after_donate(self, rel, scope: _Scope, call: ast.Call,
+                          name: str, fname: str,
+                          pos: int) -> Optional[Finding]:
+        later_loads = [ln for ln in scope.loads.get(name, [])
+                       if ln > call.lineno]
+        if not later_loads:
+            return None
+        first_load = min(later_loads)
+        rebinds = [ln for ln in scope.binds.get(name, [])
+                   if call.lineno <= ln <= first_load]
+        if rebinds:
+            return None  # the state, _ = step(state, ...) idiom
+        return Finding(
+            code="RTA402", path=rel, line=first_load,
+            message=f"{name!r} was donated to {fname}() on line "
+                    f"{call.lineno} and is read again here — a donated "
+                    f"buffer is deleted after the call",
+            hint="rebind the result (x, ... = f(x, ...)) or pass a "
+                 "copy at the donated position",
+            anchor=f"{scope.name}:{fname}:{pos}:use-after")

@@ -137,6 +137,7 @@ def serve_broker(host: str = "127.0.0.1", port: int = 0, *,
         # in a log line); clients' rafiki_tpu_bus_op_seconds series
         # carry backend="tcp" either way, so this is the disambiguator.
         if metrics.metrics_enabled():
+            # rta: disable=RTA301 backend is one of two fixed broker kinds, set once per process
             metrics.registry().gauge(
                 "rafiki_tpu_bus_broker_info",
                 "1 for the broker backend this process started"

@@ -99,9 +99,16 @@ class NodeConfig:
     # Device staging cache: the replicated uint8 dataset arrays stay
     # resident on the mesh across trials (never donated). 0 disables.
     stage_cache_bytes: int = 2 << 30
+    # Per-trial on-device staging threshold: datasets up to this many
+    # bytes are staged whole on the mesh (one H2D, index-gathered
+    # batches); larger ones fall back to per-chunk shipping.
+    stage_bytes: int = 2 << 30
     # TrainWorkers compute the NEXT proposal on a background thread
     # while the current trial trains (advisor/prefetch.py). Opt-out.
     advisor_prefetch: bool = True
+    # ParamStore write-behind: save() returns before the disk flush
+    # (store/params.py). Off = synchronous saves again.
+    params_write_behind: bool = True
 
     # --- Observability (docs/observability.md) ---
     metrics: bool = True                   # /metrics route + bus/http
@@ -109,6 +116,12 @@ class NodeConfig:
     trace_sample: float = 1.0              # fresh-trace sample rate 0..1
     #                                        (incoming X-Trace-Id always
     #                                        honored)
+    trace_max_mb: float = 64.0             # spans.jsonl size cap before
+    #                                        rolling to one .1 generation
+    # Metrics-only HTTP server for subprocess/docker worker runners
+    # (they have no HTTP surface of their own). 0 = off; spawned
+    # children inherit it via apply_env only when set.
+    metrics_port: int = 0
 
     # Fields whose env names predate this layer (back-compat).
     _ENV_MAP = {
@@ -226,8 +239,16 @@ class NodeConfig:
         if self.dataset_cache_bytes < 0 or self.stage_cache_bytes < 0:
             raise ValueError("dataset_cache_bytes and stage_cache_bytes "
                              "must be >= 0 (0 disables the cache)")
+        if self.stage_bytes < 0:
+            raise ValueError("stage_bytes must be >= 0 (0 forces "
+                             "per-chunk staging)")
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError("trace_sample must be within [0, 1]")
+        if self.trace_max_mb <= 0:
+            raise ValueError("trace_max_mb must be positive")
+        if not (0 <= self.metrics_port <= 65535):
+            raise ValueError(f"metrics_port {self.metrics_port} out of "
+                             f"range (0 = no standalone server)")
         if self.log_level.upper() not in (
                 "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
             raise ValueError(f"unknown log_level {self.log_level!r}")
@@ -281,16 +302,30 @@ class NodeConfig:
             os.environ.pop(self.env_name("serving_client_header"), None)
         # Trial-lifecycle knobs: the dataset/staging caches read their
         # budgets per call (model/dataset.py, model/jax_model.py); the
-        # TrainWorker reads the prefetch toggle when its loop starts.
+        # TrainWorker reads the prefetch toggle when its loop starts;
+        # the ParamStore reads the write-behind toggle per save.
         os.environ[self.env_name("dataset_cache_bytes")] = \
             str(self.dataset_cache_bytes)
         os.environ[self.env_name("stage_cache_bytes")] = \
             str(self.stage_cache_bytes)
+        os.environ[self.env_name("stage_bytes")] = str(self.stage_bytes)
         os.environ[self.env_name("advisor_prefetch")] = \
             "1" if self.advisor_prefetch else "0"
+        os.environ[self.env_name("params_write_behind")] = \
+            "1" if self.params_write_behind else "0"
         # Observability: the /metrics route and bus/http instrumentation
         # check RAFIKI_TPU_METRICS at construction; the trace edges read
-        # RAFIKI_TPU_TRACE_SAMPLE per request.
+        # RAFIKI_TPU_TRACE_SAMPLE per request, the span sink its size
+        # cap per flush.
         os.environ[self.env_name("metrics")] = \
             "1" if self.metrics else "0"
         os.environ[self.env_name("trace_sample")] = str(self.trace_sample)
+        os.environ[self.env_name("trace_max_mb")] = str(self.trace_max_mb)
+        # 0 = "no standalone metrics server": exporting "0" would make
+        # worker runners bind port 0 (a random free port) — pop instead,
+        # mirroring serving_client_header's absent-means-off contract.
+        if self.metrics_port:
+            os.environ[self.env_name("metrics_port")] = \
+                str(self.metrics_port)
+        else:
+            os.environ.pop(self.env_name("metrics_port"), None)

@@ -668,6 +668,7 @@ class JaxModel(BaseModel):
                 loss_acc = np.asarray(metrics)  # single D2H per chunk
                 # The asarray above is the chunk's real sync point, so
                 # the elapsed time is honest per-step wall time.
+                # rta: disable=RTA301 bound trial= labels; TrialRunner removes them at trial end (worker/runner.py)
                 _step_hist.observe(
                     (time.monotonic() - t_chunk) / k, **_mlabels)
                 ep_loss += float(loss_acc[0]) * k

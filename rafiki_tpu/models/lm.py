@@ -360,6 +360,7 @@ class JaxTransformerLM(BaseModel):
             if meter.mfu is not None:
                 from ..observe import metrics as _obs_metrics
 
+                # rta: disable=RTA301 bound trial= labels; TrialRunner removes them at trial end (worker/runner.py)
                 _obs_metrics.registry().gauge(
                     "rafiki_tpu_train_mfu_ratio",
                     "Model-FLOPs-utilization of the trial's chip group "

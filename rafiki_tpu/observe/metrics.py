@@ -134,6 +134,7 @@ class Gauge(Counter):
             self._values[key] = self._values.get(key, 0.0) + n
 
     def dec(self, n: float = 1.0, **labels: str) -> None:
+        # rta: disable=RTA301 registry plumbing: labels pass through; series lifecycle belongs to callers
         self.inc(-n, **labels)
 
 

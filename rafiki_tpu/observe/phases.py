@@ -78,6 +78,7 @@ def observe_phase(phase: str, seconds: float) -> None:
     """Record one phase duration. Always-on cheap (one histogram
     observe); ``RAFIKI_TPU_METRICS=0`` disables it wholesale."""
     if metrics.metrics_enabled():
+        # rta: disable=RTA301 phase is drawn from the fixed PHASES tuple; deliberately immortal (module docstring)
         _reg()["phase"].observe(seconds, phase=phase)
 
 
@@ -85,6 +86,7 @@ def cache_event(cache: str, event: str, n: int = 1) -> None:
     """``cache`` is ``"dataset"`` or ``"stage"``; ``event`` one of
     hit/miss/evict."""
     if metrics.metrics_enabled():
+        # rta: disable=RTA301 event is hit|miss|evict; deliberately immortal (module docstring)
         _reg()[f"{cache}_cache"].inc(n, event=event)
 
 

@@ -194,6 +194,8 @@ class ServingStats:
         hist = self._m["stage"]
         count = hist.count(service=self.service, stage=stage)
         total = hist.sum(service=self.service, stage=stage)
+        with self._lock:  # written under _lock by _observe_stage
+            stage_max = self._stage_max[stage]
 
         def ms(v: Optional[float]) -> float:
             return round(v * 1e3, 3) if v is not None else 0.0
@@ -201,7 +203,7 @@ class ServingStats:
         return {
             "count": count,
             "mean_ms": ms(total / count) if count else 0.0,
-            "max_ms": ms(self._stage_max[stage]),
+            "max_ms": ms(stage_max),
             "p50_ms": ms(hist.percentile(0.5, service=self.service,
                                          stage=stage)) if count else 0.0,
             "p95_ms": ms(hist.percentile(0.95, service=self.service,
@@ -212,6 +214,9 @@ class ServingStats:
         batches = self.batches
         batched_requests = self.batched_requests
         batched_queries = self.batched_queries
+        with self._lock:  # peaks are written under _lock
+            queue_depth_peak = self.queue_depth_peak
+            inflight_peak = self.inflight_peak
         return {
             "service": self.service,
             "requests": self.requests,
@@ -232,9 +237,9 @@ class ServingStats:
                 for labels, v in self._m["backpressure"].samples()
                 if labels.get("service") == self.service},
             "queue_depth": self.queue_depth,
-            "queue_depth_peak": self.queue_depth_peak,
+            "queue_depth_peak": queue_depth_peak,
             "inflight": self.inflight,
-            "inflight_peak": self.inflight_peak,
+            "inflight_peak": inflight_peak,
             # The last dispatched super-batch's adaptive fill window
             # (seconds) — converges toward the max under load, the min
             # under trickle.

@@ -230,6 +230,7 @@ class MicroBatcher:
         super-batch results is ready. Raises :class:`Backpressure` when
         the admission queue is full — or, with fairness on, when
         ``client``'s share of it is (the caller maps it to HTTP 429)."""
+        # rta: disable=RTA101 unlocked fast-path peek; start() re-checks under _cond
         if not self._started:
             self.start()
         n = len(queries)
@@ -309,6 +310,7 @@ class MicroBatcher:
         lo, hi = self.fill_window_min, self.fill_window_max
         if lo >= hi:
             return lo  # pinned: fixed-window mode
+        # rta: disable=RTA101 benign torn read (docstring): stale float sizes ONE window
         dt = self._dt_ewma
         if dt is None:
             return lo

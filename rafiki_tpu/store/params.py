@@ -97,10 +97,12 @@ class ParamStore:
             self._db.commit()
 
     def close(self) -> None:
-        if self._writer is not None and self._writer.is_alive():
+        with self._pending_lock:  # _writer is published under it
+            writer = self._writer
+        if writer is not None and writer.is_alive():
             self.flush()
             self._write_queue.put(None)  # writer-loop sentinel
-            self._writer.join(timeout=10.0)
+            writer.join(timeout=10.0)
         with self._lock:
             self._db.close()
 

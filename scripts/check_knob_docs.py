@@ -1,75 +1,42 @@
 #!/usr/bin/env python3
 """Static check: every ``RAFIKI_TPU_*`` NodeConfig env knob is
-documented in ``docs/ops.md``.
+documented in ``docs/ops.md``. **Thin shim** since the static-analysis
+suite landed — the real checker is
+``rafiki_tpu.analysis.checkers.drift`` (RTA503); run the whole suite
+with
 
-Run as a tier-1 test (tests/test_config.py invokes it) and standalone:
+    python -m rafiki_tpu.analysis
+
+This entrypoint keeps the historical contract (tests/test_config.py
+and docs reference it, and it still works against an arbitrary tree
+whose ``rafiki_tpu/config.py`` is loaded by file path — no jax, no
+package import):
 
     python scripts/check_knob_docs.py [repo_root]
-
-The knob surface grows one field at a time (r6 added five serving
-knobs, r7 two observability knobs, r9 three trial-lifecycle knobs) and
-nothing used to force the ops documentation to keep up. This check
-derives the authoritative env-name list from ``NodeConfig`` itself —
-every dataclass field's ``env_name()`` (including the ``_ENV_MAP``
-back-compat names) must appear verbatim in ``docs/ops.md``, so a new
-knob cannot silently go undocumented.
-
-``config.py`` is loaded by file path, NOT via the package import: the
-check must run without jax (and without triggering the package's
-heavier imports) in any environment that can run pytest.
 
 Exit code 0 = clean; 1 = missing knobs (printed one per line).
 """
 
 from __future__ import annotations
 
-import dataclasses
-import importlib.util
 import os
-import re
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-def load_node_config(root: str):
-    path = os.path.join(root, "rafiki_tpu", "config.py")
-    spec = importlib.util.spec_from_file_location("_rafiki_tpu_config",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    # dataclasses resolves field types through sys.modules[__module__];
-    # an unregistered module would break the @dataclass decorator.
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod.NodeConfig
+from rafiki_tpu.analysis.checkers import drift  # noqa: E402
 
 
 def main(root: str) -> int:
-    NodeConfig = load_node_config(root)
-    doc_path = os.path.join(root, "docs", "ops.md")
-    if not os.path.exists(doc_path):
-        print(f"{doc_path}: missing (the knob table lives here)")
-        return 1
-    with open(doc_path, encoding="utf-8") as f:
-        text = f.read()
-    missing = []
-    fields = dataclasses.fields(NodeConfig)
-    for f_ in fields:
-        env = NodeConfig.env_name(f_.name)
-        # Delimited-token match, not substring: RAFIKI_TPU_METRICS must
-        # not count as documented just because RAFIKI_TPU_METRICS_PORT
-        # appears somewhere.
-        if not re.search(re.escape(env) + r"(?![A-Z0-9_])", text):
-            missing.append(
-                f"docs/ops.md: NodeConfig.{f_.name} ({env}) is "
-                f"undocumented — add it to the knob table")
-    for p in missing:
-        print(p)
-    if not missing:
-        print(f"ok: all {len(fields)} NodeConfig knobs documented in "
+    findings, n_fields = drift.check_knob_docs(root)
+    for f in findings:
+        print(f"{f.path}: {f.message}")
+    if not findings:
+        print(f"ok: all {n_fields} NodeConfig knobs documented in "
               f"docs/ops.md")
-    return 1 if missing else 0
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
-                  os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__)))))
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else _REPO))

@@ -1,155 +1,43 @@
 #!/usr/bin/env python3
-"""Static check: every metric registered in the tree follows the
-``rafiki_tpu_<subsystem>_<name>_<unit>`` naming convention.
+"""Static check: metric naming convention + Grafana dashboard
+references. **Thin shim** since the static-analysis suite landed —
+the real checkers are ``rafiki_tpu.analysis.checkers.drift`` (RTA501
+metric names, RTA502 dashboard refs); run the whole suite with
 
-Run as a tier-1 test (tests/test_metrics.py invokes it) and standalone:
+    python -m rafiki_tpu.analysis
+
+This entrypoint keeps the historical contract (tests/test_metrics.py
+and docs reference it, and it still works against an arbitrary tree):
 
     python scripts/check_metrics_names.py [repo_root]
 
-The check is intentionally dumb and fast: it greps every ``.py`` file
-under ``rafiki_tpu/`` for string literals starting with ``rafiki_tpu_``
-that appear as the first argument of a ``counter(`` / ``gauge(`` /
-``histogram(`` call (however the registry is aliased), and validates:
-
-- full name matches ``rafiki_tpu_[a-z0-9]+(_[a-z0-9]+)+``
-- the SUBSYSTEM (token after the prefix) is in the known set
-- the UNIT (last token) is in the known set, and counters end in
-  ``_total``
-
-It ALSO cross-checks the Grafana dashboard JSONs under
-``docs/grafana/``: every ``rafiki_tpu_*`` metric a panel expression
-references (histogram ``_bucket``/``_sum``/``_count`` suffixes
-stripped) must be a name actually registered somewhere in the tree —
-so a renamed metric breaks this check instead of silently blanking a
-dashboard panel.
-
-Exit code 0 = clean; 1 = violations (printed one per line).
-Extending the subsystem/unit vocabulary is a deliberate edit HERE, so
-a typo'd metric name can't silently fork the namespace.
+Exit code 0 = clean; 1 = violations (printed one per line). The
+subsystem/unit vocabulary now lives in the drift checker — extending
+it remains a deliberate edit there.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-PREFIX = "rafiki_tpu_"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-SUBSYSTEMS = {"bus", "serving", "http", "train", "trial", "trace",
-              "node"}
-
-# _total marks counters (Prometheus convention); everything else is the
-# physical unit of a gauge/histogram.
-UNITS = {"total", "seconds", "ratio", "bytes", "queries", "batches",
-         "info"}
-
-NAME_RE = re.compile(r"^rafiki_tpu_[a-z0-9]+(?:_[a-z0-9]+)+$")
-
-# First string argument of a registry call, e.g.:
-#   reg.counter(\n    "rafiki_tpu_x_y_total", ...)
-CALL_RE = re.compile(
-    r"\b(counter|gauge|histogram)\(\s*\n?\s*"
-    r"[\"'](" + PREFIX + r"[a-zA-Z0-9_]*)[\"']")
-
-
-#: Any rafiki_tpu_* token inside a dashboard JSON (panel exprs,
-#: label_values templating queries, ...).
-DASH_TOKEN_RE = re.compile(r"\brafiki_tpu_[a-z0-9_]+\b")
-
-#: Exposition-level suffixes a histogram's series carry beyond its
-#: registered name.
-HIST_SUFFIXES = ("_bucket", "_sum", "_count")
-
-
-def check_file(path: str, registered=None) -> list:
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    problems = []
-    for match in CALL_RE.finditer(text):
-        kind, name = match.group(1), match.group(2)
-        if registered is not None:
-            registered.add(name)
-        line = text[:match.start()].count("\n") + 1
-        where = f"{path}:{line}"
-        if not NAME_RE.match(name):
-            problems.append(f"{where}: {name!r} is not "
-                            f"rafiki_tpu_<subsystem>_<name>_<unit>")
-            continue
-        tokens = name[len(PREFIX):].split("_")
-        if tokens[0] not in SUBSYSTEMS:
-            problems.append(
-                f"{where}: {name!r} subsystem {tokens[0]!r} not in "
-                f"{sorted(SUBSYSTEMS)} (extend the set in "
-                f"scripts/check_metrics_names.py if intentional)")
-        unit = tokens[-1]
-        if unit not in UNITS:
-            problems.append(
-                f"{where}: {name!r} unit {unit!r} not in "
-                f"{sorted(UNITS)}")
-        if kind == "counter" and unit != "total":
-            problems.append(
-                f"{where}: counter {name!r} must end in _total")
-        if kind != "counter" and unit == "total":
-            problems.append(
-                f"{where}: {kind} {name!r} must not end in _total")
-    return problems
-
-
-def check_dashboard(path: str, registered: set) -> list:
-    """Every metric a dashboard references must be a registered name
-    (after stripping the histogram exposition suffixes)."""
-    import json
-
-    with open(path, encoding="utf-8") as f:
-        try:
-            text = f.read()
-            json.loads(text)  # a broken dashboard import is a failure
-        except json.JSONDecodeError as e:
-            return [f"{path}: invalid JSON ({e})"]
-    problems = []
-    for name in sorted(set(DASH_TOKEN_RE.findall(text))):
-        base = name
-        for suffix in HIST_SUFFIXES:
-            if base.endswith(suffix) and base[:-len(suffix)] in registered:
-                base = base[:-len(suffix)]
-                break
-        if base not in registered:
-            problems.append(
-                f"{path}: references {name!r}, which no code path "
-                f"registers (renamed metric? update the dashboard)")
-    return problems
+from rafiki_tpu.analysis.checkers import drift  # noqa: E402
 
 
 def main(root: str) -> int:
-    pkg = os.path.join(root, "rafiki_tpu")
-    problems = []
-    registered: set = set()
-    n_files = 0
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                n_files += 1
-                problems.extend(check_file(os.path.join(dirpath, fn),
-                                           registered))
-    grafana = os.path.join(root, "docs", "grafana")
-    n_dash = 0
-    if os.path.isdir(grafana):
-        for fn in sorted(os.listdir(grafana)):
-            if fn.endswith(".json"):
-                n_dash += 1
-                problems.extend(check_dashboard(
-                    os.path.join(grafana, fn), registered))
-    for p in problems:
-        print(p)
-    if not problems:
+    findings, registered, n_files = drift.check_metric_names(root)
+    dash_findings, n_dash = drift.check_dashboards(root, registered)
+    findings.extend(dash_findings)
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        print(f"{os.path.join(root, f.path)}:{f.line}: {f.message}")
+    if not findings:
         print(f"ok: {n_files} files + {n_dash} dashboard(s), all "
               f"metric names conform")
-    return 1 if problems else 0
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
-                  os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__)))))
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else _REPO))

@@ -1,0 +1,36 @@
+"""True positives for RTA4xx: a cache-resident array at a donated
+position (through the AOT-dispatch forwarder, the r9 hazard shape) and
+a read-after-donate."""
+
+from functools import partial
+
+import jax
+
+_STAGE_CACHE = {}
+
+
+def staged_dataset_arrays(key):
+    return _STAGE_CACHE[key]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def train_chunk(state, data, sels):
+    return state
+
+
+def dispatch(state, data, sels):
+    exe = train_chunk  # AOT fallback alias: dispatch forwards donation
+    return exe(state, data, sels)
+
+
+def train(key):
+    data_dev, labels_dev = staged_dataset_arrays(key)
+    state = object()
+    state = dispatch(state, data_dev, [0])      # <- RTA401 (pos 1)
+    return state, labels_dev
+
+
+def use_after_donate():
+    state = object()
+    out = train_chunk(state, [1], [0])          # donates state...
+    return state, out                           # <- RTA402 (state read)

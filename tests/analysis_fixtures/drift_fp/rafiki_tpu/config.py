@@ -1,0 +1,23 @@
+"""Fixture NodeConfig with every contract honored: the knob is
+documented and exported by apply_env. NO findings expected."""
+
+import os
+from dataclasses import dataclass
+
+_PREFIX = "RAFIKI_TPU_"
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    workdir: str = "./rafiki_workdir"
+    tidy_knob: int = 7
+
+    _ENV_MAP = {}
+
+    @classmethod
+    def env_name(cls, field: str) -> str:
+        return cls._ENV_MAP.get(field, _PREFIX + field.upper())
+
+    def apply_env(self) -> None:
+        os.environ[self.env_name("workdir")] = self.workdir
+        os.environ[self.env_name("tidy_knob")] = str(self.tidy_knob)

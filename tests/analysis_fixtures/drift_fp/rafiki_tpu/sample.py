@@ -1,0 +1,14 @@
+"""Fixture with clean metric names and a properly-plumbed knob read.
+NO findings expected."""
+
+import os
+
+
+def register(reg):
+    reg.counter("rafiki_tpu_bus_retries_total")
+    reg.histogram("rafiki_tpu_bus_wait_seconds")
+    reg.gauge("rafiki_tpu_serving_queue_depth_queries")
+
+
+def knobs():
+    return os.environ.get("RAFIKI_TPU_TIDY_KNOB", "7")

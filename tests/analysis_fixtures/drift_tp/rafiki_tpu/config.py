@@ -1,0 +1,22 @@
+"""Fixture NodeConfig: ``mystery_knob`` is undocumented (RTA503) and
+read by sample.py without an apply_env export (RTA505)."""
+
+import os
+from dataclasses import dataclass
+
+_PREFIX = "RAFIKI_TPU_"
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    workdir: str = "./rafiki_workdir"
+    mystery_knob: int = 7
+
+    _ENV_MAP = {}
+
+    @classmethod
+    def env_name(cls, field: str) -> str:
+        return cls._ENV_MAP.get(field, _PREFIX + field.upper())
+
+    def apply_env(self) -> None:
+        os.environ[self.env_name("workdir")] = self.workdir

@@ -1,0 +1,18 @@
+"""Fixture drift violations: bad metric names (RTA501), a rogue env
+literal (RTA504), and a NodeConfig knob read that apply_env never
+exports (RTA505)."""
+
+import os
+
+
+def register(reg):
+    reg.counter("rafiki_tpu_serving_widgets")        # RTA501: no unit
+    reg.gauge("rafiki_tpu_mystery_thing_ratio")      # RTA501: subsystem
+    reg.counter("rafiki_tpu_bus_retries_seconds")    # RTA501: not _total
+    reg.histogram("rafiki_tpu_bus_wait_seconds")     # ok
+
+
+def knobs():
+    rogue = os.environ.get("RAFIKI_TPU_ROGUE_TWEAK", "1")   # RTA504
+    known = os.environ.get("RAFIKI_TPU_MYSTERY_KNOB", "7")  # RTA505
+    return rogue, known

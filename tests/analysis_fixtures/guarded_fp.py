@@ -1,0 +1,94 @@
+"""False-positive guards for RTA1xx: everything here is correct and
+must produce NO findings.
+
+Covers the repo's real idioms: __init__ publication, the
+caller-holds-the-lock private helper, Condition.wait under the lock,
+atomic primitives (Event/Queue), sequential (non-nested) lock use, and
+the snapshot-under-lock-act-outside pattern.
+"""
+
+import queue
+import threading
+import time
+
+
+class ProperlyGuarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()       # atomic: never "guarded"
+        self._inbox = queue.Queue()          # atomic: never "guarded"
+        self._items = []
+        self._depth = 0
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._depth += 1
+            self._cond.notify_all()
+
+    def take(self):
+        with self._cond:
+            while not self._items:
+                if self._stop.is_set():      # Event read: fine anywhere
+                    return None
+                self._cond.wait(0.1)         # Condition.wait releases
+            return self._drain_locked()
+
+    def _drain_locked(self):
+        # Private helper: every call site holds _cond, so touching
+        # _items/_depth here is correct (the _drain_into pattern).
+        out = list(self._items)
+        self._items.clear()
+        self._depth = 0
+        return out
+
+    def snapshot_then_act(self):
+        with self._cond:
+            snapshot = list(self._items)
+        # Blocking work AFTER release — correct, must not be RTA102.
+        time.sleep(0.01)
+        return snapshot
+
+    def stop(self):
+        self._stop.set()                     # atomic; no lock needed
+        self._inbox.put(None)                # queue is thread-safe
+
+
+class SequentialLocks:
+    """Takes two locks one AFTER the other (never nested): no ordering
+    edge, no cycle."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._x = 0
+        self._y = 0
+
+    def both(self):
+        with self._a:
+            self._x += 1
+        with self._b:
+            self._y += 1
+
+    def both_reversed(self):
+        with self._b:
+            self._y -= 1
+        with self._a:
+            self._x -= 1
+
+
+class ReentrantHelper:
+    """RLock re-acquisition is legal — must not be RTA103."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rows = []
+
+    def insert(self, row):
+        with self._lock:
+            self._insert_locked(row)
+
+    def _insert_locked(self, row):
+        with self._lock:
+            self._rows.append(row)

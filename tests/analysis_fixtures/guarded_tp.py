@@ -1,0 +1,70 @@
+"""True positives for RTA1xx: unguarded access, blocking call under a
+lock, lock-order cycle, non-reentrant re-acquisition."""
+
+import threading
+import time
+
+
+class UnguardedAccess:
+    """RTA101: _depth is written under _lock but read without it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+
+    def push(self):
+        with self._lock:
+            self._depth += 1
+
+    def depth(self):
+        return self._depth  # <- RTA101
+
+
+class BlockingUnderLock:
+    """RTA102: sleeps (and reads a file) while holding the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._payload = None
+
+    def refresh(self, path):
+        with self._lock:
+            time.sleep(0.1)                   # <- RTA102
+            with open(path) as f:             # <- RTA102
+                self._payload = f.read()
+
+
+class LockOrderCycle:
+    """RTA103: a() takes _a then _b; b() takes _b then _a."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._n = 0
+
+    def a(self):
+        with self._a:
+            with self._b:
+                self._n += 1
+
+    def b(self):
+        with self._b:
+            with self._a:
+                self._n -= 1
+
+
+class SelfDeadlock:
+    """RTA103: re-acquires a non-reentrant Lock through a helper every
+    caller enters with the lock already held."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def insert(self, row):
+        with self._lock:
+            self._insert_locked(row)
+
+    def _insert_locked(self, row):
+        with self._lock:  # <- RTA103 (Lock, not RLock)
+            self._rows.append(row)

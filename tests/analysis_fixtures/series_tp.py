@@ -1,0 +1,17 @@
+"""True positive for RTA3xx: per-instance labeled series with no
+.remove() anywhere in the module — the r7 leak class verbatim."""
+
+from rafiki_tpu.observe import metrics
+
+
+class LeakyStats:
+    def __init__(self, service):
+        self.service = service
+        self._requests = metrics.registry().counter(
+            "rafiki_tpu_serving_requests_total")
+
+    def admitted(self):
+        self._requests.inc(service=self.service)  # <- RTA301
+
+    def stop(self):
+        pass  # no .remove(service=...): series outlive every instance

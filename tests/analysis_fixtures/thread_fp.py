@@ -1,0 +1,60 @@
+"""False-positive guards for RTA2xx: daemonized, joined (directly and
+via the loop-over-a-tuple idiom), daemon-assigned-later, local joined
+threads, and a shut-down executor. NO findings expected."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class DaemonThread:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+
+class JoinedPair:
+    """The micro-batcher pattern: two threads joined from stop() via a
+    loop over a tuple."""
+
+    def __init__(self):
+        self._batcher = threading.Thread(target=self._run)
+        self._gatherer = threading.Thread(target=self._run)
+        self._batcher.daemon = True
+        self._gatherer.daemon = True
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        for t in (self._batcher, self._gatherer):
+            if t.is_alive():
+                t.join(timeout=5.0)
+
+
+class DirectJoin:
+    def start(self):
+        self._writer = threading.Thread(target=self._run)
+        self._writer.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._writer.join(timeout=10.0)
+
+
+class ShutdownPool:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1)
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+
+
+def scoped_worker():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()

@@ -1,0 +1,22 @@
+"""True positives for RTA2xx: a thread neither daemonized nor joined,
+and an executor the class never shuts down."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class WedgesOnExit:
+    def start(self):
+        self._worker = threading.Thread(target=self._run)  # <- RTA201
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+
+class LeakedPool:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1)     # <- RTA202
+
+    def submit(self, fn):
+        return self._pool.submit(fn)

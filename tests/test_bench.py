@@ -107,6 +107,28 @@ def test_sweep_emits_one_line_with_per_config_records():
     assert ("error" in attn) == (attn["platform"] not in ("axon", "tpu"))
 
 
+def test_analysis_config_records_finding_counts():
+    """The static-analysis gate smoke: one record, value = NEW findings
+    (0 on a clean tree), per-code counts folded in for the bench
+    artifact. Runs the real CLI subprocess, like production."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--config", "analysis"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.splitlines()[-1])
+    assert rec["metric"] == "analysis_new_findings"
+    assert rec["value"] == 0.0 and rec["exit_code"] == 0
+    assert rec["unit"] == "findings"
+    assert all(k.startswith("RTA") for k in rec["counts_per_code"])
+    assert set(rec["by_status"]) <= {"baselined", "waived", "new"}
+    assert rec["files"] > 50 and rec["checkers"]
+
+
 @pytest.mark.slow
 @pytest.mark.slower
 def test_sweep_heavy_configs_run_on_cpu_mesh():

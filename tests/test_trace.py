@@ -378,6 +378,17 @@ def test_admin_trace_route_and_metrics(tmp_path):
         status = requests.get(base + "/status", headers=hdr,
                               timeout=10).json()
         assert "mfu" in status
+        # /trial_phases feeds the dashboard's phase-breakdown panel:
+        # all six phases present (zero-count here — no resident trials)
+        # and authenticated like every other admin read.
+        tp = requests.get(base + "/trial_phases", headers=hdr,
+                          timeout=10).json()
+        assert set(tp["phases"]) == {"propose", "load", "stage",
+                                     "train", "eval", "persist"}
+        assert set(tp["caches"]) == {"dataset", "stage"}
+        assert "resident" in tp and "enabled" in tp
+        assert requests.get(base + "/trial_phases",
+                            timeout=10).status_code == 401
     finally:
         platform.shutdown()
         trace.configure(None)

@@ -287,10 +287,13 @@ def test_dashboard_upload_and_log_elements(http_platform):
     for el in ("nd-upload", "nd-file", "nd-name", "nd-task",  # datasets
                "nm-src-file",                 # model .py file upload
                "services", "svclog",          # per-service log view
-               "infstats", "infstats-summary"):  # serving stats panel
+               "infstats", "infstats-summary",  # serving stats panel
+               "phases", "phases-caches"):      # trial phase breakdown
         assert f'id="{el}"' in text, f"missing dashboard element #{el}"
     # the panel is fed by the admin's server-side /stats proxy
     assert "/stats" in text and "refreshInfStats" in text
+    # the phase panel reads the admin's /trial_phases aggregation
+    assert "/trial_phases" in text and "refreshTrialPhases" in text
 
 
 def test_oversized_upload_rejected_413(http_platform):
