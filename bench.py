@@ -26,6 +26,13 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8).
 finding counts (value = NEW findings; healthy is exactly 0). Excluded
 from the sweep: it is a gate, not a perf figure.
 
+``--config chaos``: closed-loop recovery under a seeded fault plan
+(docs/robustness.md) — availability (headline; 1.0 = zero dropped
+queries while replicas are being hard-killed and respawned) and
+time-to-full-recovery per injure->recover cycle, plus the
+injection-site hot-path A/B (fault plane disabled vs armed-empty).
+Excluded from the sweep: it injures its own stack.
+
 The reference publishes no numbers (BASELINE.md): the first recorded run
 of each config on TPU establishes its baseline; the BASELINES table
 below holds those recorded figures per platform channel; update them
@@ -1238,6 +1245,208 @@ def main_analysis() -> dict:
         stale_baseline=len(report["stale_baseline"]))
 
 
+def main_chaos() -> dict:
+    """Config[chaos]: closed-loop recovery under a seeded fault plan
+    (docs/robustness.md). Not a perf figure — the config injures its own
+    stack — so like ``analysis`` it never joins the sweep. Two parts:
+
+    - **Hot-path A/B** of the injection sites themselves: MemoryBus
+      push+pop ops/s with the fault plane DISABLED (construction stores
+      ``None`` — byte-for-byte the pre-fault path) vs ARMED with an
+      empty plan (hooks live, nothing fires). ``test_faults.py`` proves
+      the disabled behavior unchanged; this records the speed side of
+      the zero-overhead contract, and the armed/disabled ratio bounds
+      what arming costs production.
+    - **The chaos loop**: a 2-bin ensemble serving stack built with the
+      plane armed-quiet, then repeatedly injured under the seeded plan —
+      one replica dies HARD mid-load (meta row RUNNING, registration
+      stale), ``supervise()`` respawns it, the Predictor folds the
+      respawn back into its shard plans. Availability (headline) is
+      answered/total over EVERY query sent across all cycles — 1.0
+      means the partial-bin degrade dropped nothing while the loop
+      closed; time-to-full-recovery per cycle (hard death -> full-bin
+      plans restored) feeds the adaptive-windows estimator so the
+      record carries ``n_windows``/``spread`` like every other config.
+    """
+    import tempfile
+    import threading
+
+    import requests
+
+    from rafiki_tpu import faults
+    from rafiki_tpu.bus.memory import MemoryBus
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.constants import (BudgetOption, ServiceStatus,
+                                      ServiceType, TaskType, UserType)
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.observe.metrics import registry
+    from rafiki_tpu.platform import LocalPlatform
+
+    # Seeded so the probabilistic bus jitter replays: same plan + seed
+    # = same per-rule decision sequence (docs/robustness.md).
+    seed = int(os.environ.get(faults.SEED_ENV, "0") or "0")
+    plan = "worker.crash:n=1;bus.delay:p=0.02,ms=2"
+
+    # --- Hot-path A/B: disabled vs armed-empty ------------------------
+    n_ops = 3000
+
+    def bus_window(bus):
+        def window() -> float:
+            t0 = time.time()
+            for i in range(n_ops):
+                bus.push("bench-q", i)
+                bus.pop("bench-q")
+            return 2 * n_ops / (time.time() - t0)
+        return window
+
+    faults.set_plan(None)  # hard-disarm (overrides any env plan)
+    ops_off, _ = _adaptive_windows(bus_window(MemoryBus()))
+    faults.set_plan("")    # armed, zero rules: hooks live, silent
+    ops_armed, _ = _adaptive_windows(bus_window(MemoryBus()))
+
+    # --- Chaos loop (plane stays armed-quiet through construction, so
+    # every bus/http/worker site built below holds a live hook) -------
+    counts = {"total": 0, "answered": 0}
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            train_path, val_path = make_synthetic_image_dataset_compat(
+                tmp, n_train=1024, n_val=256)
+            platform = LocalPlatform(workdir=tmp + "/plat", http=True,
+                                     supervise_interval=0)
+            try:
+                user = platform.admin.create_user(
+                    "chaos@x.c", "pw", UserType.MODEL_DEVELOPER)
+                model = platform.admin.create_model(
+                    user["id"], "ff", TaskType.IMAGE_CLASSIFICATION,
+                    "rafiki_tpu.models.feedforward:JaxFeedForward")
+                job = platform.admin.create_train_job(
+                    user["id"], "chaos", TaskType.IMAGE_CLASSIFICATION,
+                    [model["id"]],
+                    {BudgetOption.MODEL_TRIAL_COUNT: 2},
+                    train_path, val_path)
+                assert platform.admin.wait_until_train_job_done(
+                    job["id"], timeout=1200)
+                inf = platform.admin.create_inference_job(
+                    user["id"], job["id"], max_models=2)
+                host = platform.admin.get_inference_job(
+                    inf["id"])["predictor_host"]
+                url = f"http://{host}/predict"
+                pred_svc = next(
+                    s for s in platform.meta.get_services()
+                    if s["service_type"] == ServiceType.PREDICT)
+                psvc = platform.container.get(pred_svc["id"])
+                # Bound the partial-bin wait for queries caught
+                # mid-crash (the dead bin has no sibling to resubmit
+                # to, so they pay one full gather before degrading).
+                psvc.predictor.gather_timeout = 4.0
+                cache = Cache(platform.bus)
+
+                val = load_image_dataset(val_path)
+                batch = [encode_payload(val.images[i]) for i in range(3)]
+
+                def predict() -> None:
+                    counts["total"] += 1
+                    r = requests.post(url, json={"queries": batch},
+                                      timeout=300)
+                    if r.status_code != 200:
+                        return
+                    preds = r.json().get("predictions") or []
+                    if len(preds) == len(batch) and \
+                            all(p is not None for p in preds):
+                        counts["answered"] += 1
+
+                predict()  # warm: registration waits, EWMAs seeded
+                deadline = time.monotonic() + 120
+                while len(cache.running_workers(inf["id"])) < 2:
+                    # Both bins must serve BEFORE the injuring starts —
+                    # a 1-replica stack has no full-bin state to
+                    # restore and the cycle would "measure" nothing.
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            "only %d/2 replicas registered; chaos "
+                            "needs both bins live before injuring"
+                            % len(cache.running_workers(inf["id"])))
+                    time.sleep(0.2)
+
+                def live_inference_ids():
+                    return [s["id"] for s in platform.meta.get_services()
+                            if s["service_type"] == ServiceType.INFERENCE
+                            and s["status"] == ServiceStatus.RUNNING]
+
+                def cycle() -> float:
+                    """Injure once, recover fully; seconds from the hard
+                    death to restored full-bin shard plans."""
+                    faults.set_plan(plan, seed=seed)
+                    dead_at = None
+                    deadline = time.monotonic() + 120
+                    while dead_at is None:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError("injected crash never "
+                                               "fired")
+                        predict()
+                        for sid in live_inference_ids():
+                            w = platform.container.get(sid)
+                            if w is not None and not w.running:
+                                dead_at = time.monotonic()
+                    restarted = platform.services.supervise()
+                    if len(restarted) != 1:
+                        raise RuntimeError(
+                            f"supervise respawned {len(restarted)} "
+                            "workers, expected 1")
+                    deadline = time.monotonic() + 300
+                    while len(psvc.predictor._choose_workers()) < 2:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError("respawned replica never "
+                                               "rejoined the plan")
+                        predict()
+                        time.sleep(0.05)
+                    predict()  # full-bin ensembles again
+                    return time.monotonic() - dead_at
+
+                recoveries: list = []
+
+                def window() -> float:
+                    s = cycle()
+                    recoveries.append(round(s, 2))
+                    return 1.0 / s  # higher = better for the estimator
+
+                rate, fields = _adaptive_windows(window)
+                fields.pop("windows", None)  # rates; recoveries carry it
+                platform.admin.stop_inference_job(inf["id"])
+            finally:
+                platform.shutdown()
+    finally:
+        faults.set_plan(None)
+
+    reg = registry()
+    c = reg.find("rafiki_tpu_fault_injections_total")
+    injections = {f"{lab['site']}.{lab['kind']}": v
+                  for lab, v in (c.samples() if c is not None else [])}
+    c = reg.find("rafiki_tpu_node_restarts_total")
+    respawns = (c.value(service_type=ServiceType.INFERENCE)
+                if c is not None else 0.0)
+    c = reg.find("rafiki_tpu_serving_replica_quarantines_total")
+    quarantines = (sum(v for _, v in c.samples())
+                   if c is not None else 0.0)
+
+    availability = (counts["answered"] / counts["total"]
+                    if counts["total"] else 0.0)
+    return _emit(
+        "chaos_availability", availability, "fraction", **fields,
+        fault_plan=plan, fault_seed=seed,
+        time_to_full_recovery_s=round(1.0 / rate, 2),
+        recovery_s_windows=recoveries,
+        queries_total=counts["total"],
+        queries_answered=counts["answered"],
+        inference_respawns=respawns,
+        replica_quarantines=quarantines,
+        fault_injections=injections,
+        bus_ops_per_s_disabled=round(ops_off, 1),
+        bus_ops_per_s_armed_empty=round(ops_armed, 1),
+        fault_hook_overhead_ratio=round(ops_armed / ops_off, 3)
+        if ops_off else None)
+
+
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
                                         image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
@@ -1267,6 +1476,10 @@ _CONFIGS = {
     # Not in _SWEEP_ORDER: a gate (0 new findings), not a perf figure —
     # run explicitly via --config analysis.
     "analysis": (main_analysis, "analysis_new_findings", "findings"),
+    # Not in _SWEEP_ORDER either: the chaos config injures its own
+    # serving stack (seeded fault plan -> recovery loop); its value is
+    # availability + time-to-full-recovery, not throughput.
+    "chaos": (main_chaos, "chaos_availability", "fraction"),
 }
 
 
@@ -1336,8 +1549,13 @@ def _main_cli() -> None:
         # queue — sharding there measures pure overhead), so a CPU
         # fallback for that config gets 2 virtual devices (no-op when
         # the accelerator serves, or when XLA_FLAGS already pins one).
+        # chaos needs allocation headroom for 2 replica bins PLUS a
+        # respawn while the just-finished train worker may still hold
+        # its chip — on a 1-device box the second bin would never
+        # launch and the recovery loop would have nothing to restore.
         ensure_platform(n_virtual_devices=(
-            2 if args.config == "serving-concurrent" else None))
+            2 if args.config == "serving-concurrent"
+            else 3 if args.config == "chaos" else None))
         import jax
 
         platform = jax.default_backend()

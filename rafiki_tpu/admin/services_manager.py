@@ -29,8 +29,10 @@ import math
 import os
 from typing import Any, Dict, List, Optional
 
-from ..constants import (BudgetOption, EnvVars, ServiceStatus, ServiceType)
+from ..constants import (BudgetOption, EnvVars, InferenceJobStatus,
+                         ServiceStatus, ServiceType)
 from ..container.manager import ContainerManager
+from ..observe import metrics as _metrics
 from ..parallel.chips import ChipAllocator
 from ..store import MetaStore
 
@@ -83,6 +85,16 @@ class ServicesManager:
         # rows whose node_id is NULL; a join node stopping/sweeping the
         # primary's legacy services would disrupt its running jobs.
         self.adopt_unowned = adopt_unowned
+        # Lazy bus connection for reaping dead workers' stale
+        # registrations (subprocess/docker modes; thread mode borrows
+        # the container's shared bus instead).
+        self._reap_bus = None
+        # Dead inference replicas whose respawn failed for CAPACITY
+        # (add_inference_worker -> None while the job was live): the
+        # service row is already ERRORED, so the RUNNING scan will
+        # never see them again — each sweep retries these until a
+        # replica lands or the job stops.
+        self._pending_respawns: List[Dict[str, Any]] = []
 
     # --- Launch plumbing ---
 
@@ -471,52 +483,190 @@ class ServicesManager:
     # --- Supervision (SURVEY.md §5: failure detection / recovery) ---
 
     def supervise(self) -> List[str]:
-        """One sweep: mark dead services ERRORED, restart train workers.
+        """One sweep: mark dead services ERRORED, restart train workers
+        and inference replicas.
 
-        Trial rows are idempotent (a crashed trial stays ERRORED; the
-        advisor re-proposes), so recovery is a fresh worker on the same
-        chip range. Returns the ids of restarted services.
+        Train recovery: trial rows are idempotent (a crashed trial
+        stays ERRORED; the advisor re-proposes), so recovery is a fresh
+        worker on the same chip range. Inference recovery: a dead
+        replica's trial bin loses serving capacity (and, when it was
+        the bin's last replica, its ensemble vote), so a fresh replica
+        is attached for the same bin while the job is still live — the
+        Predictor's registry scan folds it into the next shard plan.
+        Returns the ids of restarted services.
         """
-        restarted = []
-        # Node-scoped: this node's container manager can only judge what
-        # IT launched. Foreign rows are swept by lease expiry instead;
-        # NULL-node rows (pre-upgrade databases) are adopted as local.
-        for svc in self.meta.get_services(status=ServiceStatus.RUNNING):
-            own = self._ownership(svc)
-            if own == "unowned-skip":
-                continue
-            if own == "foreign":
-                if not self._lease_fresh(svc):
-                    self.meta.update_service(svc["id"],
-                                             status=ServiceStatus.ERRORED)
-                    _log.warning("lease expired on %s from node %s; "
-                                 "marked errored", svc["id"][:8],
-                                 svc["node_id"])
-                continue
-            if self.container.service_alive(svc["container_id"] or svc["id"]):
-                continue
-            self.meta.update_service(svc["id"], status=ServiceStatus.ERRORED)
-            self._release_chips_of(svc)
-            if svc["service_type"] != ServiceType.TRAIN:
-                continue
-            rows = self.meta._select(
-                "SELECT * FROM train_job_workers WHERE service_id = ?",
-                (svc["id"],))
-            if not rows:
-                continue
-            sub_id = rows[0]["sub_train_job_id"]
-            # shared_ok mirrors admission: a worker that was admitted
-            # time-sliced (full slice) could otherwise never restart —
-            # exclusive allocation on the still-full slice returns None
-            # and the job would keep an advisor but zero workers.
-            new_svc = self.add_train_worker(
-                sub_id, chips_per_trial=len(svc.get("chips") or [1]),
-                shared_ok=self._sharing_ok())
-            if new_svc is not None:
-                restarted.append(new_svc["id"])
-                _log.warning("restarted dead train worker %s as %s",
-                             svc["id"][:8], new_svc["id"][:8])
+        restarted: List[str] = []
+        # Dead replicas whose earlier respawn failed for capacity are
+        # already ERRORED — invisible to the RUNNING scan below, so
+        # only this queue can ever retry them. Swapped out here,
+        # retried AFTER the scan: chips the scan releases this very
+        # sweep can then satisfy the retry. A retry that fails for
+        # capacity again re-queues itself.
+        pending, self._pending_respawns = self._pending_respawns, []
+        try:
+            # Node-scoped: this node's container manager can only
+            # judge what IT launched. Foreign rows are swept by lease
+            # expiry instead; NULL-node rows (pre-upgrade databases)
+            # are adopted as local.
+            for svc in self.meta.get_services(
+                    status=ServiceStatus.RUNNING):
+                own = self._ownership(svc)
+                if own == "unowned-skip":
+                    continue
+                if own == "foreign":
+                    if not self._lease_fresh(svc):
+                        self.meta.update_service(
+                            svc["id"], status=ServiceStatus.ERRORED)
+                        _log.warning("lease expired on %s from node "
+                                     "%s; marked errored",
+                                     svc["id"][:8], svc["node_id"])
+                    continue
+                if self.container.service_alive(svc["container_id"]
+                                                or svc["id"]):
+                    continue
+                self.meta.update_service(svc["id"],
+                                         status=ServiceStatus.ERRORED)
+                self._release_chips_of(svc)
+                new_svc = None
+                if svc["service_type"] == ServiceType.TRAIN:
+                    new_svc = self._respawn_train_worker(svc)
+                elif svc["service_type"] == ServiceType.INFERENCE:
+                    try:
+                        new_svc = self._respawn_inference_worker(svc)
+                    except Exception:
+                        # A failed launch (container error, transient
+                        # meta/bus trouble) must not orphan the
+                        # replica: queue it — the ERRORED row can
+                        # never re-enter this scan.
+                        _log.exception(
+                            "respawn of dead inference worker %s "
+                            "failed; queued for retry", svc["id"][:8])
+                        self._pending_respawns.append(svc)
+                self._note_restart(svc, new_svc, restarted)
+            while pending:
+                self._note_restart(
+                    pending[0],
+                    self._respawn_inference_worker(pending[0],
+                                                   reap=False),
+                    restarted)
+                # Popped only AFTER the attempt resolved (a no-capacity
+                # None already re-queued it on the fresh list).
+                pending.pop(0)
+        finally:
+            # An exception mid-sweep must not orphan un-retried
+            # replicas: their rows are ERRORED, invisible to every
+            # future RUNNING scan, so this queue is their only way
+            # back into a bin.
+            self._pending_respawns.extend(pending)
         return restarted
+
+    def _note_restart(self, svc: Dict[str, Any],
+                      new_svc: Optional[Dict[str, Any]],
+                      restarted: List[str]) -> None:
+        if new_svc is None:
+            return
+        restarted.append(new_svc["id"])
+        _log.warning("restarted dead %s worker %s as %s",
+                     svc["service_type"], svc["id"][:8],
+                     new_svc["id"][:8])
+        if _metrics.metrics_enabled():
+            # rta: disable=RTA301 service_type is the bounded ServiceType vocabulary; supervise counters are deliberately immortal
+            _metrics.registry().counter(
+                "rafiki_tpu_node_restarts_total",
+                "Dead services respawned by the supervise "
+                "sweep, by service type").inc(
+                    service_type=svc["service_type"])
+
+    def _respawn_train_worker(self, svc: Dict[str, Any],
+                              ) -> Optional[Dict[str, Any]]:
+        rows = self.meta._select(
+            "SELECT * FROM train_job_workers WHERE service_id = ?",
+            (svc["id"],))
+        if not rows:
+            return None
+        sub_id = rows[0]["sub_train_job_id"]
+        # shared_ok mirrors admission: a worker that was admitted
+        # time-sliced (full slice) could otherwise never restart —
+        # exclusive allocation on the still-full slice returns None
+        # and the job would keep an advisor but zero workers.
+        return self.add_train_worker(
+            sub_id, chips_per_trial=len(svc.get("chips") or [1]),
+            shared_ok=self._sharing_ok())
+
+    def _respawn_inference_worker(self, svc: Dict[str, Any],
+                                  reap: bool = True,
+                                  ) -> Optional[Dict[str, Any]]:
+        """Fresh replica for a dead inference worker's trial bin.
+
+        Only while the job itself is still live: a worker dying because
+        its job was stopped must not resurrect serving capacity the
+        operator just tore down. ``add_inference_worker`` already
+        admits with ``shared_ok`` (same liveness fallback as the train
+        respawn path); a None return for CAPACITY (this node's chips
+        exhausted, even time-sliced) queues the replica on
+        ``_pending_respawns`` — the bin stays degraded (the Predictor
+        keeps serving partial-bin ensembles) and every later sweep
+        retries until chips free or the job stops. ``reap=False`` on
+        those retries: the stale registration was reaped at death."""
+        rows = self.meta._select(
+            "SELECT * FROM inference_job_workers WHERE service_id = ?",
+            (svc["id"],))
+        if not rows:
+            return None
+        job_id = rows[0]["inference_job_id"]
+        trial_id = rows[0]["trial_id"]
+        if reap:
+            # A hard-killed worker never ran its unregister path: reap
+            # its stale bus registration so the Predictor's registry
+            # scan stops planning shards onto a ghost replica (the
+            # respawned worker registers under its own fresh id).
+            self._reap_worker_registration(job_id, svc["id"])
+        job = self.meta.get_inference_job(job_id)
+        if job is None or job["status"] not in (
+                InferenceJobStatus.STARTED, InferenceJobStatus.RUNNING):
+            return None
+        n_chips = len(svc.get("chips") or [1])
+        # Probe capacity BEFORE add_inference_worker: that path names
+        # its allocation after a freshly-created service row, so a
+        # no-capacity attempt leaves an orphan STOPPED row — tolerable
+        # once, but this queue retries every sweep and would otherwise
+        # grow the services table without bound on a saturated node.
+        probe = f"respawn-probe:{svc['id']}"
+        group = self.allocator.allocate(n_chips, name=probe,
+                                        shared_ok=self._sharing_ok())
+        if group is None:
+            self._pending_respawns.append(svc)
+            return None
+        self.allocator.release(probe)
+        new_svc = self.add_inference_worker(job_id, trial_id,
+                                            chips_per_worker=n_chips)
+        if new_svc is None:
+            # Lost the probe-to-admit race; the next sweep retries.
+            self._pending_respawns.append(svc)
+        return new_svc
+
+    def _reap_worker_registration(self, job_id: str,
+                                  service_id: str) -> None:
+        """Best-effort delete of a dead worker's bus registration.
+
+        Thread mode reuses the container's shared bus; subprocess /
+        docker modes reconnect by URI. A broker outage here is benign —
+        a restarted broker forgot the registration anyway."""
+        try:
+            bus = getattr(getattr(self.container, "ctx", None),
+                          "bus", None)
+            if bus is None:
+                from ..bus import connect
+
+                if self._reap_bus is None:
+                    self._reap_bus = connect(self.bus_uri)
+                bus = self._reap_bus
+            from ..cache import Cache
+
+            Cache(bus).unregister_worker(job_id, service_id)
+        except (ConnectionError, OSError, RuntimeError):
+            _log.warning("could not reap bus registration of dead "
+                         "worker %s", service_id[:8], exc_info=True)
 
     # --- Utilization (BASELINE north star: ≥90% chip utilization) ---
 

@@ -40,6 +40,17 @@ def bus_op_histogram() -> Optional["metrics.Histogram"]:
         "pops include wait time)")
 
 
+def bus_reconnect_counter() -> Optional["metrics.Counter"]:
+    """Reconnect-attempt counter for the tcp client (None when metrics
+    are disabled, decided at construction like the op histogram)."""
+    if not metrics.metrics_enabled():
+        return None
+    return metrics.registry().counter(
+        "rafiki_tpu_bus_reconnects_total",
+        "TCP bus client reconnect attempts after a transport failure "
+        "(backend is always tcp)")
+
+
 class BaseBus(abc.ABC):
     # --- Queues ---
 

@@ -8,6 +8,7 @@ from collections import defaultdict, deque
 from typing import Any, List, Optional
 
 from .base import BaseBus, bus_op_histogram, queue_kind
+from .. import faults
 
 
 class MemoryBus(BaseBus):
@@ -36,16 +37,28 @@ class MemoryBus(BaseBus):
         self._kv: dict = {}
         # None when RAFIKI_TPU_METRICS=0 (decided at construction).
         self._hist = bus_op_histogram()
+        # None when the fault plane is disabled (decided at
+        # construction): the hot path then pays ONE attribute check.
+        self._fault = faults.site_hook("bus")
 
     def _record(self, op: str, queue: str, t0: float) -> None:
         if self._hist is not None:
             self._hist.observe(time.monotonic() - t0, backend="memory",
                                op=op, kind=queue_kind(queue))
 
+    def _inject(self, op: str, queue: str) -> bool:
+        """Evaluate the fault plan for one op. Returns True when the
+        op should be discarded (``faults.should_drop``); ``delay``
+        sleeps inside, ``disconnect`` raises from inside."""
+        return faults.should_drop(self._fault(op=op,
+                                              kind=queue_kind(queue)), op)
+
     # --- Queues ---
 
     def push(self, queue: str, value: Any) -> None:
         t0 = time.monotonic()
+        if self._fault is not None and self._inject("push", queue):
+            return
         with self._cond:
             self._queues[queue].append(value)
             self._cond.notify_all()
@@ -54,6 +67,9 @@ class MemoryBus(BaseBus):
     def push_many(self, items) -> None:
         items = list(items)
         t0 = time.monotonic()
+        if self._fault is not None and \
+                self._inject("push_many", items[0][0] if items else ""):
+            return
         with self._cond:
             for queue, value in items:
                 self._queues[queue].append(value)
@@ -62,6 +78,8 @@ class MemoryBus(BaseBus):
 
     def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:
         t0 = time.monotonic()
+        if self._fault is not None:
+            self._inject("pop", queue)
         value = self._pop(queue, timeout)
         self._record("pop", queue, t0)
         return value
@@ -82,6 +100,8 @@ class MemoryBus(BaseBus):
     def pop_all(self, queue: str, max_items: int = 0,
                 timeout: float = 0.0) -> List[Any]:
         t0 = time.monotonic()
+        if self._fault is not None:
+            self._inject("pop_all", queue)
         first = self._pop(queue, timeout)
         if first is None:
             self._record("pop_all", queue, t0)
@@ -114,10 +134,14 @@ class MemoryBus(BaseBus):
     # --- Key-value ---
 
     def set(self, key: str, value: Any) -> None:
+        if self._fault is not None:
+            self._inject("set", key)
         with self._lock:
             self._kv[key] = value
 
     def get(self, key: str) -> Optional[Any]:
+        if self._fault is not None:
+            self._inject("get", key)
         with self._lock:
             return self._kv.get(key)
 

@@ -13,6 +13,9 @@ blocked ``pop`` in one thread never serialises another thread's traffic.
 from __future__ import annotations
 
 import json
+import os
+import random
+import select
 import socket
 import socketserver
 import struct
@@ -21,11 +24,44 @@ from typing import Any, List, Optional
 
 import time
 
-from .base import BaseBus, bus_op_histogram, queue_kind
+from .base import (BaseBus, bus_op_histogram, bus_reconnect_counter,
+                   queue_kind)
 from .memory import MemoryBus
+from .. import faults
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 256 * 1024 * 1024
+
+#: Ops safe to retry even after their frame was FULLY sent (the broker
+#: may have executed them): pure reads, and writes whose replay is a
+#: no-op (set = same value, del/qdel = already gone). ``push``/``pop``
+#: families are NOT here — replaying a sent push duplicates a frame,
+#: replaying a sent pop loses the popped item.
+_IDEMPOTENT_OPS = frozenset(
+    {"get", "keys", "qlen", "ping", "set", "del", "qdel"})
+
+#: Ceiling on one backoff sleep (the exponential is bounded twice: per
+#: sleep here, and in total by the retry budget).
+_RETRY_MAX_SLEEP = 2.0
+
+
+def _peer_closed(sock: socket.socket) -> bool:
+    """Whether an IDLE cached socket has a close (or stray bytes)
+    queued. The protocol is strict request/response, so between ops the
+    peer owes us nothing: a socket polling READABLE means EOF (broker
+    died / restarted) or framing skew — either way it must not carry
+    the next frame. Zero-timeout poll, never a recv: on a socket
+    with a Python-level timeout, recv — even MSG_DONTWAIT — parks in
+    the interpreter's readiness wait first. ``poll`` rather than
+    ``select``: select raises ValueError on fds >= FD_SETSIZE, and
+    treating that as "closed" would re-dial the broker on EVERY op in
+    a high-fd process."""
+    try:
+        p = select.poll()
+        p.register(sock, select.POLLIN)
+        return bool(p.poll(0))
+    except (OSError, ValueError):
+        return True
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -179,36 +215,82 @@ class BusOpError(RuntimeError):
 
 
 class BusClient(BaseBus):
-    def __init__(self, host: str, port: int, timeout: float = 300.0):
+    def __init__(self, host: str, port: int, timeout: float = 300.0,
+                 retry_base_s: Optional[float] = None,
+                 retry_total_s: Optional[float] = None):
         self.host, self.port = host, port
         # Socket-level timeout; must exceed any blocking-pop timeout so the
         # server, not the transport, decides when a pop gives up.
         self._sock_timeout = timeout
         self._local = threading.local()
+        # Reconnection policy (docs/robustness.md): after a transport
+        # failure, frame-UNSENT ops and idempotent reads retry on a
+        # bounded exponential backoff with jitter until the total
+        # budget lapses — a broker restart heals instead of failing
+        # every in-flight op. Knob precedence matches NodeConfig:
+        # constructor arg > RAFIKI_TPU_BUS_RETRY_* env > default.
+        from ..config import NodeConfig
+
+        if retry_base_s is None:
+            retry_base_s = float(os.environ.get(
+                NodeConfig.env_name("bus_retry_base_s"), "0.05"))
+        if retry_total_s is None:
+            retry_total_s = float(os.environ.get(
+                NodeConfig.env_name("bus_retry_total_s"), "15.0"))
+        self._retry_base = max(1e-3, retry_base_s)
+        self._retry_total = max(0.0, retry_total_s)
         # One timing site (_call) covers every op against EITHER broker
         # (Python BusServer or the C++ native one — the client is the
         # only Python-side hop the native path has). None when
         # RAFIKI_TPU_METRICS=0, decided at construction.
         self._hist = bus_op_histogram()
+        self._m_reconnects = bus_reconnect_counter()
+        # None when the fault plane is disabled (construction-time).
+        self._fault = faults.site_hook("bus")
 
-    def _sock(self) -> socket.socket:
+    def _sock(self, timeout_cap: Optional[float] = None,
+              ) -> socket.socket:
         sock = getattr(self._local, "sock", None)
+        if sock is not None and _peer_closed(sock):
+            # A broker that died while this socket sat idle leaves a
+            # FIN/RST already queued: catching it HERE turns the next
+            # op into the safe frame-UNSENT case. Without the check the
+            # first post-restart send "succeeds" into the kernel buffer
+            # and the failure surfaces at recv — frame-SENT, where a
+            # non-idempotent op must propagate rather than retry.
+            self._drop()
+            sock = None
         if sock is None:
+            timeout = self._sock_timeout
+            if timeout_cap is not None:
+                timeout = min(timeout, max(timeout_cap, 1e-3))
             sock = socket.create_connection((self.host, self.port),
-                                            timeout=self._sock_timeout)
+                                            timeout=timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._local.sock = sock
         return sock
 
     def _call(self, req: dict) -> Any:
-        if self._hist is None:
-            return self._call_inner(req)
         # push_many carries its queues inside "items"; label by the
         # first one so the serving scatter records kind="query" exactly
         # as the memory backend does.
         queue = req.get("queue")
         if queue is None and req.get("items"):
             queue = req["items"][0].get("queue")
+        if self._fault is not None:
+            op = str(req.get("op"))
+            try:
+                act = self._fault(op=op, kind=queue_kind(queue))
+            except ConnectionError:
+                # Injected disconnect: drop the cached socket too, so
+                # the NEXT op reconnects — exactly what a detected
+                # broker death looks like from this side.
+                self._drop()
+                raise
+            if faults.should_drop(act, op):
+                return None
+        if self._hist is None:
+            return self._call_inner(req)
         t0 = time.monotonic()
         try:
             return self._call_inner(req)
@@ -218,26 +300,76 @@ class BusClient(BaseBus):
                 op=str(req.get("op")), kind=queue_kind(queue))
 
     def _call_inner(self, req: dict) -> Any:
-        # Retry ONLY when the send itself failed (a stale cached socket —
-        # the broker never saw a complete frame, so resending is safe).
-        # Once the frame is fully sent, the op may have executed: retrying
-        # would duplicate non-idempotent ops (double feedback) or lose
-        # popped items, so a response-side failure propagates instead.
-        try:
-            sock = self._sock()
-            _send_frame(sock, req)
-        except (ConnectionError, OSError):
-            self._drop()
-            sock = self._sock()
-            _send_frame(sock, req)
-        try:
-            resp = _recv_frame(sock)
-        except (ConnectionError, OSError):
-            self._drop()
-            raise
-        if not resp.get("ok"):
-            raise BusOpError(f"bus error: {resp.get('error')}")
-        return resp.get("value")
+        """One op, with bounded-backoff reconnection.
+
+        The retry boundary is FRAME-SENT vs FRAME-UNSENT: a failure
+        before ``_send_frame`` returned means the broker never saw a
+        complete frame (length-prefixed framing — a partial frame never
+        dispatches), so resending is always safe. Once the frame is
+        fully sent the op may have executed, so only ``_IDEMPOTENT_OPS``
+        may retry past that point: replaying a sent ``push`` would
+        duplicate a frame (double feedback), replaying a sent ``pop``
+        would lose the popped item — those propagate instead.
+
+        Retry schedule: the first reconnect is immediate (the common
+        stale-cached-socket case — the broker is fine, our idle socket
+        was closed), then exponential backoff with jitter from
+        ``bus_retry_base_s``, each sleep capped, the whole affair
+        bounded by ``bus_retry_total_s`` (0 = legacy single resend).
+        """
+        op = str(req.get("op"))
+        retry_sent = op in _IDEMPOTENT_OPS
+        deadline: Optional[float] = None
+        attempt = 0
+        while True:
+            sent = False
+            try:
+                # Reconnects under a nonzero budget bound their connect
+                # AND recv by what's left of it: a blackholed broker
+                # (SYNs dropped, no RST) must not park a 15 s-budget op
+                # for the full 300 s socket timeout per attempt. Budget
+                # 0 keeps the legacy uncapped single resend.
+                cap = None
+                if deadline is not None and self._retry_total > 0:
+                    cap = deadline - time.monotonic()
+                sock = self._sock(timeout_cap=cap)
+                _send_frame(sock, req)
+                sent = True
+                if cap is not None and not retry_sent:
+                    # The frame is SENT on a non-idempotent op: past
+                    # this point a failure propagates (never retried),
+                    # so the budget no longer applies — restore the
+                    # full window or a blocking pop legitimately held
+                    # by the broker longer than the remaining budget
+                    # would spuriously time out and lose its reply.
+                    sock.settimeout(self._sock_timeout)
+                resp = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                self._drop()
+                if sent and not retry_sent:
+                    raise
+                attempt += 1
+                if deadline is None:
+                    deadline = time.monotonic() + self._retry_total
+                if self._m_reconnects is not None:
+                    self._m_reconnects.inc()
+                if attempt == 1:
+                    continue  # stale socket: one immediate reconnect
+                delay = min(self._retry_base * (2 ** (attempt - 2))
+                            * (0.5 + random.random()),  # jitter [0.5, 1.5)
+                            _RETRY_MAX_SLEEP)
+                if time.monotonic() + delay >= deadline:
+                    raise
+                time.sleep(delay)
+                continue
+            if cap is not None:
+                # The retry succeeded on a budget-capped socket; restore
+                # the full timeout so the cached socket keeps serving
+                # long blocking pops.
+                sock.settimeout(self._sock_timeout)
+            if not resp.get("ok"):
+                raise BusOpError(f"bus error: {resp.get('error')}")
+            return resp.get("value")
 
     def _drop(self) -> None:
         sock = getattr(self._local, "sock", None)

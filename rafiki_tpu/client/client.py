@@ -24,13 +24,29 @@ class ClientError(RuntimeError):
         self.status = status
 
 
+#: Extra attempts past the first for retryable requests, and the capped
+#: exponential transport-failure backoff between them. Only GETs retry
+#: transport failures (a dropped connection mid-POST may have executed
+#: — /predict must never silently double-submit); a 429 retries ANY
+#: method, because 429 means the server REJECTED the request before
+#: doing any work (the micro-batcher's admission bound), which makes
+#: the resend exactly-once safe.
+_RETRIES = 3
+_RETRY_BASE_S = 0.2
+_RETRY_MAX_SLEEP_S = 2.0
+#: Ceiling on an honored Retry-After (a confused server must not park
+#: the client for minutes).
+_RETRY_AFTER_CAP_S = 10.0
+
+
 class Client:
     def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, retries: int = _RETRIES):
         self._base = f"http://{admin_host}:{admin_port}"
         self._timeout = timeout
         self._token: Optional[str] = None
         self._session = requests.Session()
+        self._retries = max(0, retries)
 
     # --- Plumbing ---
 
@@ -40,16 +56,41 @@ class Client:
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
         url = (base or self._base) + path
-        resp = self._session.request(method, url, json=body or None,
-                                     headers=headers, timeout=self._timeout)
-        try:
-            data = resp.json()
-        except ValueError:
-            data = {"error": resp.text}
-        if resp.status_code >= 400:
-            raise ClientError(resp.status_code,
-                              data.get("error", "unknown error"))
-        return data
+        attempt = 0
+        while True:
+            try:
+                resp = self._session.request(
+                    method, url, json=body or None, headers=headers,
+                    timeout=self._timeout)
+            except (requests.ConnectionError, requests.Timeout):
+                # Transport failure: the request may or may not have
+                # reached the server — only idempotent GETs retry.
+                if method.upper() != "GET" or attempt >= self._retries:
+                    raise
+                attempt += 1
+                time.sleep(min(_RETRY_BASE_S * (2 ** (attempt - 1)),
+                               _RETRY_MAX_SLEEP_S))
+                continue
+            try:
+                data = resp.json()
+            except ValueError:
+                data = {"error": resp.text}
+            if resp.status_code == 429 and attempt < self._retries:
+                # Admission backpressure (rejected before execution;
+                # resend is safe for any method). The batcher has sent
+                # Retry-After since the micro-batching PR; honor it,
+                # capped, falling back to the backoff schedule.
+                attempt += 1
+                try:
+                    delay = float(resp.headers.get("Retry-After", ""))
+                except (TypeError, ValueError):
+                    delay = _RETRY_BASE_S * (2 ** (attempt - 1))
+                time.sleep(min(max(delay, 0.0), _RETRY_AFTER_CAP_S))
+                continue
+            if resp.status_code >= 400:
+                raise ClientError(resp.status_code,
+                                  data.get("error", "unknown error"))
+            return data
 
     # --- Auth ---
 

@@ -110,6 +110,23 @@ class NodeConfig:
     # (store/params.py). Off = synchronous saves again.
     params_write_behind: bool = True
 
+    # --- Robustness (docs/robustness.md) ---
+    # Fault-injection plan (rafiki_tpu/faults.py): ";"-separated
+    # site.kind:params rules injected at the bus / http / worker seams.
+    # "" = fault plane disabled (injection sites are strict no-ops).
+    fault_plan: str = ""
+    # PRNG seed for probabilistic (p=) fault rules: a seeded plan
+    # replays the same per-rule decision sequence.
+    fault_seed: int = 0
+    # TCP bus client reconnection (bus/tcp.py): base backoff step for
+    # the bounded exponential retry after a transport failure, and the
+    # total retry budget. 0 budget = legacy behavior (one immediate
+    # resend of an unsent frame, then fail). Only frame-UNSENT ops and
+    # idempotent reads retry — a non-idempotent op whose frame was
+    # fully sent is never blindly replayed across a broker restart.
+    bus_retry_base_s: float = 0.05
+    bus_retry_total_s: float = 15.0
+
     # --- Observability (docs/observability.md) ---
     metrics: bool = True                   # /metrics route + bus/http
     #                                        instrumentation wiring
@@ -242,6 +259,17 @@ class NodeConfig:
         if self.stage_bytes < 0:
             raise ValueError("stage_bytes must be >= 0 (0 forces "
                              "per-chunk staging)")
+        if self.bus_retry_base_s <= 0:
+            raise ValueError("bus_retry_base_s must be positive")
+        if self.bus_retry_total_s < 0:
+            raise ValueError("bus_retry_total_s must be >= 0 "
+                             "(0 disables the retry budget)")
+        if self.fault_plan.strip():
+            # Parse now: a typo'd chaos plan must fail the node's
+            # construction, not silently inject nothing.
+            from .faults import FaultPlan
+
+            FaultPlan.parse(self.fault_plan, seed=self.fault_seed)
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError("trace_sample must be within [0, 1]")
         if self.trace_max_mb <= 0:
@@ -313,6 +341,20 @@ class NodeConfig:
             "1" if self.advisor_prefetch else "0"
         os.environ[self.env_name("params_write_behind")] = \
             "1" if self.params_write_behind else "0"
+        # Robustness: the fault plane and the tcp bus client read these
+        # at construction; an empty plan is popped (absent = disabled),
+        # matching the serving_client_header absent-means-off contract.
+        if self.fault_plan.strip():
+            os.environ[self.env_name("fault_plan")] = self.fault_plan
+            os.environ[self.env_name("fault_seed")] = \
+                str(self.fault_seed)
+        else:
+            os.environ.pop(self.env_name("fault_plan"), None)
+            os.environ.pop(self.env_name("fault_seed"), None)
+        os.environ[self.env_name("bus_retry_base_s")] = \
+            str(self.bus_retry_base_s)
+        os.environ[self.env_name("bus_retry_total_s")] = \
+            str(self.bus_retry_total_s)
         # Observability: the /metrics route and bus/http instrumentation
         # check RAFIKI_TPU_METRICS at construction; the trace edges read
         # RAFIKI_TPU_TRACE_SAMPLE per request, the span sink its size

@@ -47,6 +47,15 @@ _STRAGGLER_K = 4.0
 #: noise.
 _STRAGGLER_MIN = 0.025
 
+#: Ceiling on the quarantine backoff multiplier: a replica that missed
+#: deadlines on N consecutive probes is quarantined for
+#: ``gather_timeout * min(2**(N-1), _QUARANTINE_MAX_MULT)`` before the
+#: next probe. Bounded so a long-dead replica whose registration was
+#: never reaped still gets re-probed eventually (a respawn could reuse
+#: its id), but a persistently dead one costs one partial deadline per
+#: ~16 gather timeouts instead of one per timeout.
+_QUARANTINE_MAX_MULT = 16
+
 
 class _Shard:
     """One slice of a super-batch bound for one replica worker."""
@@ -149,9 +158,16 @@ class Predictor:
         # worker_id -> monotonic time of its last penalty. A penalized
         # replica gets a zero slice (its EWMA only refreshes on
         # replies, which it no longer gets), so the penalty is dropped
-        # after one probe interval — a recovered replica rejoins the
-        # plan; a still-dead one costs one partial deadline per probe.
+        # after its quarantine interval — a recovered replica rejoins
+        # the plan on the next probe.
         self._penalized: Dict[str, float] = {}
+        # worker_id -> consecutive missed-deadline count. Drives the
+        # exponential quarantine (see _quarantine_s): each failed probe
+        # DOUBLES the next quarantine (capped), so a still-dead replica
+        # stops costing one partial deadline per gather timeout.
+        # Strikes outlive penalty expiry on purpose (expiry IS the
+        # probe) and reset only on a real reply.
+        self._strikes: Dict[str, int] = {}
         # ThreadingHTTPServer handler threads (batcher-off mode) and
         # the micro-batcher's scatter thread all route through
         # _choose_workers/_plan_shards; the rr cursor, bin memo, and
@@ -164,6 +180,7 @@ class Predictor:
         # can join the serving and shard families.
         self.service = service or f"pred-{uuid.uuid4().hex[:8]}"
         self._m_shards = self._m_resubmits = self._m_replica = None
+        self._m_quarantines = None
         if _metrics.metrics_enabled():
             reg = _metrics.registry()
             self._m_shards = reg.counter(
@@ -177,12 +194,18 @@ class Predictor:
                 "rafiki_tpu_serving_replica_gather_seconds",
                 "Per-replica scatter->reply latency (worker= short "
                 "replica id)")
+            self._m_quarantines = reg.counter(
+                "rafiki_tpu_serving_replica_quarantines_total",
+                "Replicas penalized out of the shard plan after a "
+                "missed deadline (quarantine backs off exponentially "
+                "per consecutive strike)")
 
     def close(self) -> None:
         """Drop this predictor's metric series (per-instance ``service``
         label; a resident runner deploying/stopping frontends would
         otherwise grow the registry forever)."""
-        for m in (self._m_shards, self._m_resubmits, self._m_replica):
+        for m in (self._m_shards, self._m_resubmits, self._m_replica,
+                  self._m_quarantines):
             if m is not None:
                 m.remove(service=self.service)
 
@@ -238,14 +261,18 @@ class Predictor:
                 self._penalized = {w: t for w, t
                                    in self._penalized.items()
                                    if w in live}
-            # Expire penalties one probe interval old: a penalized
+                self._strikes = {w: n for w, n
+                                 in self._strikes.items()
+                                 if w in live}
+            # Expire penalties whose quarantine lapsed: a penalized
             # replica's slice is ~zero, so only dropping the penalty
             # lets its EWMA refresh — a recovered replica rejoins the
-            # plan; a still-dead one costs one partial deadline per
-            # probe (and correctness is covered by the resubmit).
+            # plan on this probe; a still-dead one strikes again and
+            # its NEXT quarantine doubles (correctness is covered by
+            # the resubmit either way).
             now = time.monotonic()
             for w in [w for w, t in self._penalized.items()
-                      if now - t >= self.gather_timeout]:
+                      if now - t >= self._quarantine_s(w)]:
                 del self._penalized[w]
                 self._lat.pop(w, None)
             groups: Dict[str, List[str]] = {}
@@ -274,6 +301,14 @@ class Predictor:
 
     # --- Shard planning (data-parallel replica serving) ---
 
+    def _quarantine_s(self, worker_id: str) -> float:
+        """Caller holds ``_state_lock``. Seconds a penalized replica
+        sits out before its next probe: one gather timeout on the first
+        strike, doubling per consecutive strike, capped."""
+        strikes = self._strikes.get(worker_id, 1)
+        return self.gather_timeout * float(
+            min(1 << max(0, strikes - 1), _QUARANTINE_MAX_MULT))
+
     def _note_latency(self, worker_id: str, seconds: float) -> None:
         if seconds < 0:
             return
@@ -282,6 +317,10 @@ class Predictor:
             self._lat[worker_id] = (seconds if prev is None else
                                     _LAT_ALPHA * seconds +
                                     (1.0 - _LAT_ALPHA) * prev)
+            # A real reply proves the replica alive: the strike count
+            # resets so its next penalty (if any) starts the quarantine
+            # ladder over at one gather timeout.
+            self._strikes.pop(worker_id, None)
             # A penalized worker stays quarantined until the probe
             # expiry in _group_replicas even if a straggler reply lands
             # here: clearing the penalty early would leave the poisoned
@@ -295,16 +334,23 @@ class Predictor:
 
     def _penalize(self, worker_id: str) -> None:
         """A shard timed out on this replica: inflate its EWMA so the
-        next plans lean on siblings. The penalty expires after one
-        probe interval (see ``_plan_shards``): a penalized replica's
+        next plans lean on siblings, and strike it. The penalty expires
+        after its quarantine interval (exponential in consecutive
+        strikes, capped — see ``_quarantine_s``): a penalized replica's
         slice is ~zero, so its EWMA would otherwise never refresh and
-        one transient timeout would starve it forever."""
+        one transient timeout would starve it forever; a replica that
+        keeps missing probes backs off instead of costing one partial
+        deadline per gather timeout."""
         import time
 
         with self._state_lock:
             prev = self._lat.get(worker_id, self.gather_timeout)
             self._lat[worker_id] = max(prev * 2.0, self.gather_timeout)
             self._penalized[worker_id] = time.monotonic()
+            self._strikes[worker_id] = \
+                self._strikes.get(worker_id, 0) + 1
+        if self._m_quarantines is not None:
+            self._m_quarantines.inc(service=self.service)
 
     def _plan_shards(self, n: int) -> Tuple[List[_Shard],
                                             Dict[str, List[str]]]:

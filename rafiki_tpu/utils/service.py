@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..observe import metrics, trace
+from .. import faults
 
 _log = logging.getLogger(__name__)
 
@@ -134,6 +135,9 @@ class JsonHttpServer:
             max_body = int(os.environ.get("RAFIKI_TPU_MAX_UPLOAD_MB",
                                           "256")) * 1024 * 1024
         self.max_body = max_body
+        # None when the fault plane is disabled (construction-time):
+        # the dispatch path then pays one attribute check per request.
+        self._fault = faults.site_hook("http")
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -192,6 +196,19 @@ class JsonHttpServer:
                     match = pattern.match(parsed.path)
                     if match is None:
                         continue
+                    if outer._fault is not None:
+                        # Injected 5xx replies BEFORE dispatch (the
+                        # handler never runs — a crashed/overloaded
+                        # frontend from the client's side); an injected
+                        # timeout stalls inside the hook, then the
+                        # request proceeds (the client may have given
+                        # up — exactly the deadline-exceeded shape).
+                        act = outer._fault(op=method, route=route)
+                        if act is not None and act[0] == "error":
+                            self._reply(act[1], {
+                                "error": f"injected: http.error "
+                                         f"({act[1]})"})
+                            return
                     # Trace edge: honor an incoming X-Trace-Id, else
                     # mint a fresh (sampled) trace; bind it for the
                     # handler so downstream code (batcher admission,

@@ -14,6 +14,7 @@ import os
 import threading
 from typing import Any, Optional
 
+from .. import faults
 from ..bus import BaseBus, BusOpError
 from ..cache import Cache
 from ..constants import ServiceStatus
@@ -179,6 +180,9 @@ class InferenceWorker:
         self.stop_flag = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._model: Optional[Any] = None
+        # None when the fault plane is disabled (construction-time):
+        # the dispatch path then pays one attribute check per burst.
+        self._fault = faults.site_hook("worker")
 
     # --- Lifecycle ---
 
@@ -342,17 +346,35 @@ class InferenceWorker:
                 self._complete_batch(*pending)
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.STOPPED)
+        except faults.InjectedCrash:
+            # Injected kill -9: die HARD — no ERRORED meta update, no
+            # bus unregistration. The meta row stays RUNNING and the
+            # registration stays stale, exactly the wreckage a real
+            # hard kill leaves, so the supervise sweep (dead thread ->
+            # ERRORED -> respawn) and the Predictor's quarantine are
+            # what recovery actually exercises.
+            _log.error("inference worker %s: injected crash; dying "
+                       "hard (row left RUNNING, registration stale)",
+                       self.service_id)
+            raise
         except Exception:
             _log.exception("inference worker %s crashed", self.service_id)
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.ERRORED)
+            self._unregister_best_effort()
             raise
-        finally:
-            try:
-                self.cache.unregister_worker(self.inference_job_id,
-                                             self.service_id)
-            except (ConnectionError, OSError, RuntimeError):
-                pass  # broker gone; nothing to unregister from
+        else:
+            self._unregister_best_effort()
+
+    def _unregister_best_effort(self) -> None:
+        """Drop this worker's bus registration on the way out (crash or
+        clean stop — NOT an injected crash, which must leave it stale).
+        A dead/restarted broker forgot it anyway."""
+        try:
+            self.cache.unregister_worker(self.inference_job_id,
+                                         self.service_id)
+        except (ConnectionError, OSError, RuntimeError):
+            pass  # broker gone; nothing to unregister from
 
     def _dispatch_batch(self, items: list):
         """Flatten a burst into ONE chip-side predict dispatch; returns
@@ -363,6 +385,12 @@ class InferenceWorker:
         every trace id the burst carried."""
         import time as _time
 
+        if self._fault is not None:
+            # worker.slow sleeps inside the hook (a straggling
+            # replica); worker.crash raises InjectedCrash through the
+            # serve loop — crash-on-nth-predict counts these dispatch
+            # calls, so n= targets an exact burst.
+            self._fault(op="predict")
         trace_ctxs = trace.extract_frames(items)
         flat: list = []
         spans: list = []  # (item, start, count, is_batch)

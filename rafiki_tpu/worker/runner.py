@@ -27,6 +27,7 @@ from ..model.base import BaseModel
 from ..model.logger import logger
 from ..observe import metrics, trace_session, trial_trace_dir
 from ..observe import phases as _phases
+from ..observe import trace as _trace
 from ..store import MetaStore, ParamStore
 
 _log = logging.getLogger(__name__)
@@ -468,17 +469,33 @@ class TrialRunner:
         then overlaps trial N's persistence. A tail failure
         retroactively marks the trial ERRORED (the advisor's feedback
         stands — the score was real; only persistence failed)."""
+        # Span context for the tail, resolved on THIS (trial) thread:
+        # the ambient context when one exists (an admin-triggered run),
+        # else a context whose trace id IS the trial id — so
+        # ``GET /trace/<trial_id>`` shows the persist tail's timeline
+        # (the carried r9 item: where does post-train time go). The
+        # thread-local is lost across the persist-stage hop, hence the
+        # capture here, not inside ``tail``.
+        ctx = _trace.current() or _trace.TraceContext(str(trial_id))
 
         def tail(commit: Callable[[Callable], None]) -> None:
             t_persist = time.monotonic()
+            wall0 = time.time()
+            flush_s = save_s = commit_s = 0.0
             try:
+                t = time.monotonic()
                 for rec in log_buffer:
                     self.meta.add_trial_log(trial_id, rec)
+                flush_s = time.monotonic() - t
+                t = time.monotonic()
                 params_id = self.params.save(
                     dumped, session_id=self.sub_train_job_id,
                     worker_id=save_scope, score=score)
+                save_s = time.monotonic() - t
+                t = time.monotonic()
                 commit(lambda: self.meta.mark_trial_completed(
                     trial_id, score, params_id))
+                commit_s = time.monotonic() - t
                 # Scoped checkpoints outlive the trial — the
                 # configuration's next rung resumes them;
                 # cleanup_scoped_checkpoints() runs when the sub-job is
@@ -498,8 +515,16 @@ class TrialRunner:
                     _log.exception("trial %s: could not record persist "
                                    "failure", trial_id[:8])
             finally:
-                _phases.observe_phase("persist",
-                                      time.monotonic() - t_persist)
+                dur = time.monotonic() - t_persist
+                _phases.observe_phase("persist", dur)
+                # One span with the stage breakdown in attrs (no-op
+                # without a configured span sink).
+                _trace.record_event(
+                    "trial.persist", self.worker_id, [ctx], wall0, dur,
+                    attrs={"trial_id": str(trial_id)[:12],
+                           "log_flush_ms": round(flush_s * 1e3, 3),
+                           "params_save_ms": round(save_s * 1e3, 3),
+                           "meta_commit_ms": round(commit_s * 1e3, 3)})
 
         if self._persist is not None:
             self._persist.submit(tail)

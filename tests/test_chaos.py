@@ -1,0 +1,292 @@
+"""Chaos matrix: injected faults driving the closed recovery loop.
+
+The fault plane (rafiki_tpu/faults.py) injures the real stack — no
+mocks — and the assertions are on RECOVERY, not the injury: a replica
+killed mid-load must come back via supervise respawn + Predictor
+replan with zero dropped queries; a broker restart must heal through
+the tcp client's frame-unsent retry and the workers' registration
+lease; a respawn with no chip capacity must degrade loudly, not crash
+the sweep."""
+
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu import faults
+from rafiki_tpu.cache import Cache, encode_payload
+from rafiki_tpu.constants import (BudgetOption, InferenceJobStatus,
+                                  ServiceStatus, ServiceType, TaskType,
+                                  UserType)
+from rafiki_tpu.model import load_image_dataset
+from rafiki_tpu.platform import LocalPlatform
+
+FF_CLASS = "rafiki_tpu.models.feedforward:JaxFeedForward"
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _trained_job(platform, synth_image_data, n_trials=1, name="ff-chaos"):
+    train_path, val_path = synth_image_data
+    dev = platform.admin.create_user(f"{name}@x.c", "pw",
+                                     UserType.MODEL_DEVELOPER)
+    model = platform.admin.create_model(
+        dev["id"], name, TaskType.IMAGE_CLASSIFICATION, FF_CLASS)
+    job = platform.admin.create_train_job(
+        dev["id"], name, TaskType.IMAGE_CLASSIFICATION, [model["id"]],
+        {BudgetOption.MODEL_TRIAL_COUNT: n_trials},
+        train_path, val_path)
+    assert platform.admin.wait_until_train_job_done(job["id"],
+                                                    timeout=600)
+    return dev, job
+
+
+def test_replica_killed_midload_respawns_and_replans(tmp_path,
+                                                     synth_image_data):
+    """The tentpole loop, end to end: an injected hard crash kills one
+    of two single-replica trial bins mid-load (meta row left RUNNING,
+    bus registration stale — a kill -9). Every in-flight and subsequent
+    query must still be answered (partial-bin degrade), supervise()
+    must notice the dead thread, respawn a replica for the SAME trial
+    bin and reap the stale registration, and the Predictor's next plans
+    must fold the respawned replica back in — full-bin ensembles
+    restored, zero dropped queries throughout."""
+    platform = LocalPlatform(workdir=str(tmp_path / "plat"), http=True,
+                             supervise_interval=0)
+    try:
+        dev, job = _trained_job(platform, synth_image_data, n_trials=2)
+        # Arm the plane QUIETLY before the serving stack is built: the
+        # workers' construction-time hooks exist, nothing fires yet.
+        faults.set_plan("")
+        inf = platform.admin.create_inference_job(dev["id"], job["id"],
+                                                  max_models=2)
+        host = platform.admin.get_inference_job(
+            inf["id"])["predictor_host"]
+        pred_svc = next(s for s in platform.meta.get_services()
+                        if s["service_type"] == ServiceType.PREDICT)
+        psvc = platform.container.get(pred_svc["id"])
+        # Short gather timeout: the dead bin has no sibling, so queries
+        # caught mid-crash wait one full gather before degrading to
+        # partial-bin — keep that window test-sized.
+        psvc.predictor.gather_timeout = 4.0
+
+        _, val_path = synth_image_data
+        ds = load_image_dataset(val_path)
+        batch = [encode_payload(ds.images[i]) for i in range(3)]
+
+        def predict():
+            r = requests.post(f"http://{host}/predict",
+                              json={"queries": batch}, timeout=180)
+            assert r.status_code == 200, r.text
+            preds = r.json()["predictions"]
+            assert len(preds) == len(batch)
+            assert all(p is not None for p in preds), \
+                "dropped query (no surviving bin voted)"
+            return preds
+
+        predict()  # warm path: both bins serve, EWMAs seeded
+        cache = Cache(platform.bus)
+        workers0 = set(cache.running_workers(inf["id"]))
+        assert len(workers0) == 2
+        inf_svcs = {s["id"]: s for s in platform.meta.get_services()
+                    if s["service_type"] == ServiceType.INFERENCE}
+        assert set(inf_svcs) == workers0
+
+        # Kill exactly ONE replica on its next predict dispatch.
+        faults.set_plan("worker.crash:n=1")
+        deadline = time.monotonic() + 60
+        dead_id = None
+        while dead_id is None and time.monotonic() < deadline:
+            predict()  # zero dropped queries, before/during/after
+            for sid in workers0:
+                worker = platform.container.get(sid)
+                if worker is not None and not worker.running:
+                    dead_id = sid
+            time.sleep(0.05)
+        assert dead_id is not None, "injected crash never fired"
+
+        # Hard death: the row is still RUNNING (no graceful ERRORED
+        # update) and the registration is stale — supervise's problem.
+        assert platform.meta.get_service(dead_id)["status"] == \
+            ServiceStatus.RUNNING
+        assert dead_id in set(cache.running_workers(inf["id"]))
+
+        restarted = platform.services.supervise()
+        assert len(restarted) == 1
+        new_svc = platform.meta.get_service(restarted[0])
+        assert new_svc["service_type"] == ServiceType.INFERENCE
+        assert platform.meta.get_service(dead_id)["status"] == \
+            ServiceStatus.ERRORED
+        # Same trial bin as the dead replica, and the stale
+        # registration was reaped.
+        dead_bin = next(
+            w["trial_id"] for w in
+            platform.meta.get_inference_job_workers(inf["id"])
+            if w["service_id"] == dead_id)
+        new_bin = next(
+            w["trial_id"] for w in
+            platform.meta.get_inference_job_workers(inf["id"])
+            if w["service_id"] == new_svc["id"])
+        assert new_bin == dead_bin
+        assert dead_id not in set(cache.running_workers(inf["id"]))
+
+        # The respawned replica registers after its (warm) model load;
+        # the Predictor's registry scan then plans both bins again.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            live = set(cache.running_workers(inf["id"]))
+            if new_svc["id"] in live:
+                break
+            time.sleep(0.2)
+        assert new_svc["id"] in set(cache.running_workers(inf["id"]))
+        assert len(psvc.predictor._choose_workers()) == 2
+        preds = predict()  # full-bin ensembles again
+        assert len(preds) == len(batch)
+
+        # Recovery was counted (closed loop is observable).
+        from rafiki_tpu.observe.metrics import registry
+        c = registry().find("rafiki_tpu_node_restarts_total")
+        assert c is not None
+        assert c.value(service_type=ServiceType.INFERENCE) >= 1
+        platform.admin.stop_inference_job(inf["id"])
+    finally:
+        platform.shutdown()
+
+
+def test_broker_restart_mid_scatter_recovers(tmp_path, synth_image_data,
+                                             monkeypatch):
+    """A broker restart between scatters must heal transparently: the
+    predictor's next push_many hits its stale socket (frame UNSENT —
+    the send itself fails), reconnects on the bounded backoff, and
+    resends safely; the workers' registration lease re-populates the
+    fresh broker. ONE post-restart request must succeed end to end —
+    no request-level retry loop — and each query gets exactly one
+    prediction (no duplicated non-idempotent ops)."""
+    from rafiki_tpu.bus import serve_broker
+
+    monkeypatch.setenv("RAFIKI_TPU_WORKER_REREGISTER", "1.0")
+    broker = serve_broker("127.0.0.1", 0, native=False)
+    port = broker.port
+    platform = LocalPlatform(workdir=str(tmp_path / "plat"),
+                             bus_uri=broker.uri, http=True,
+                             supervise_interval=0)
+    try:
+        dev, job = _trained_job(platform, synth_image_data, n_trials=1,
+                                name="ff-broker")
+        inf = platform.admin.create_inference_job(dev["id"], job["id"],
+                                                  max_models=1)
+        host = platform.admin.get_inference_job(
+            inf["id"])["predictor_host"]
+        _, val_path = synth_image_data
+        ds = load_image_dataset(val_path)
+        batch = [encode_payload(ds.images[i]) for i in range(4)]
+
+        r = requests.post(f"http://{host}/predict",
+                          json={"queries": batch}, timeout=180)
+        assert r.status_code == 200
+
+        broker.stop()
+        time.sleep(0.5)
+        broker = serve_broker("127.0.0.1", port, native=False)
+
+        # One request, no retries: the scatter's transport retry plus
+        # the worker's 1s re-registration lease carry it through.
+        r = requests.post(f"http://{host}/predict",
+                          json={"queries": batch}, timeout=180)
+        assert r.status_code == 200, r.text
+        preds = r.json()["predictions"]
+        assert len(preds) == len(batch)
+        assert all(p is not None for p in preds)
+        platform.admin.stop_inference_job(inf["id"])
+    finally:
+        platform.shutdown()
+        broker.stop()
+
+
+def test_supervise_inference_respawn_no_capacity_and_stopped_job(
+        tmp_path, monkeypatch):
+    """The two guarded edges of the inference-respawn path, on
+    fabricated meta rows (no training, fast):
+
+    - no capacity: the allocator returns None -> the sweep marks the
+      dead replica ERRORED, restarts nothing, and does not crash —
+      but queues the replica, and the NEXT sweep respawns it once
+      capacity frees (the ERRORED row is invisible to the RUNNING
+      scan, so only the pending queue can ever retry it);
+    - stopped job: a dead replica of a STOPPED job is never
+      resurrected (no allocation is even attempted), and a pending
+      respawn of a stopped job is dropped, not retried forever."""
+    platform = LocalPlatform(workdir=str(tmp_path / "plat"), http=False,
+                             supervise_interval=0)
+    try:
+        meta = platform.meta
+        node = platform.services.node_id
+        job = meta.create_inference_job("u-x", "tj-x",
+                                        InferenceJobStatus.RUNNING)
+        svc = meta.create_service(ServiceType.INFERENCE,
+                                  ServiceStatus.RUNNING, chips=[0],
+                                  node_id=node)
+        meta.add_inference_job_worker(svc["id"], job["id"], "trial-x")
+
+        # --- no capacity: allocate() -> None ---
+        monkeypatch.setattr(platform.services.allocator, "allocate",
+                            lambda *a, **kw: None)
+        restarted = platform.services.supervise()
+        assert restarted == []
+        assert meta.get_service(svc["id"])["status"] == \
+            ServiceStatus.ERRORED
+        live = [s for s in meta.get_services()
+                if s["service_type"] == ServiceType.INFERENCE
+                and s["status"] in (ServiceStatus.DEPLOYING,
+                                    ServiceStatus.RUNNING)]
+        assert live == []
+        assert [p["id"] for p in platform.services._pending_respawns] \
+            == [svc["id"]]
+
+        # --- a sweep that dies mid-scan must not orphan the queue
+        # (the ERRORED row can never re-enter the RUNNING scan, so a
+        # dropped queue entry would be permanent degradation) ---
+        monkeypatch.setattr(
+            platform.services.meta, "get_services",
+            lambda **kw: (_ for _ in ()).throw(RuntimeError("db busy")))
+        with pytest.raises(RuntimeError, match="db busy"):
+            platform.services.supervise()
+        assert [p["id"] for p in platform.services._pending_respawns] \
+            == [svc["id"]]
+
+        # --- capacity frees: the next sweep retries the pending
+        # respawn (stubbed admission — the real path needs a trained
+        # trial; what's under test is the retry wiring) ---
+        monkeypatch.undo()
+        admitted = []
+        monkeypatch.setattr(
+            platform.services, "add_inference_worker",
+            lambda job_id, trial_id, **kw: (
+                admitted.append((job_id, trial_id)) or {"id": "svc-new"}))
+        restarted = platform.services.supervise()
+        assert restarted == ["svc-new"]
+        assert admitted == [(job["id"], "trial-x")]
+        assert platform.services._pending_respawns == []
+
+        # --- stopped job: status gate short-circuits ---
+        monkeypatch.undo()
+        meta.update_inference_job(job["id"],
+                                  status=InferenceJobStatus.STOPPED)
+        svc2 = meta.create_service(ServiceType.INFERENCE,
+                                   ServiceStatus.RUNNING, chips=[0],
+                                   node_id=node)
+        meta.add_inference_job_worker(svc2["id"], job["id"], "trial-x")
+        free_before = platform.allocator.free_chips
+        restarted = platform.services.supervise()
+        assert restarted == []
+        assert meta.get_service(svc2["id"])["status"] == \
+            ServiceStatus.ERRORED
+        assert platform.allocator.free_chips == free_before
+        assert platform.services._pending_respawns == []
+    finally:
+        platform.shutdown()

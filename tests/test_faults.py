@@ -1,0 +1,464 @@
+"""Fault-injection plane: plan grammar, determinism, injection sites,
+the zero-overhead-when-disabled contract, and the tcp bus client's
+bounded-backoff reconnection semantics (frame-sent vs frame-unsent)."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu import faults
+from rafiki_tpu.bus import BusClient, BusServer, MemoryBus
+from rafiki_tpu.observe.metrics import registry
+from rafiki_tpu.utils.service import JsonHttpServer
+
+COUNTER = "rafiki_tpu_fault_injections_total"
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane(monkeypatch):
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    monkeypatch.delenv(faults.SEED_ENV, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _injections_total() -> float:
+    c = registry().find(COUNTER)
+    if c is None:
+        return 0.0
+    return sum(v for _, v in c.samples())
+
+
+# --- Plan grammar ------------------------------------------------------
+
+class TestPlanGrammar:
+    def test_parse_multi_rule(self):
+        plan = faults.FaultPlan.parse(
+            "bus.drop:op=push; http.error:code=502,route=/predict ;"
+            "worker.crash:n=3")
+        assert {s for s in plan.rules} == {"bus", "http", "worker"}
+        assert plan.rules["http"][0].params["code"] == "502"
+
+    def test_unknown_site_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown"):
+            faults.FaultPlan.parse("bus.explode")
+        with pytest.raises(ValueError, match="unknown"):
+            faults.FaultPlan.parse("gpu.delay:ms=5")
+
+    def test_malformed_param_rejected(self):
+        with pytest.raises(ValueError, match="key=value"):
+            faults.FaultPlan.parse("bus.delay:ms")
+        with pytest.raises(ValueError):
+            faults.FaultPlan.parse("bus.delay:ms=abc")
+        with pytest.raises(ValueError):
+            faults.FaultPlan.parse("worker.crash:n=two")
+
+    def test_unknown_param_key_rejected(self):
+        """A typo'd key ("probability=", capital "N=") must fail the
+        parse, not silently leave the rule firing on every call with
+        defaults — a chaos run would measure the wrong plan while
+        claiming the typed one."""
+        with pytest.raises(ValueError, match="unknown param"):
+            faults.FaultPlan.parse("bus.delay:probability=0.02,ms=2")
+        with pytest.raises(ValueError, match="unknown param"):
+            faults.FaultPlan.parse("worker.crash:N=2")
+
+    def test_multiple_selection_params_rejected(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            faults.FaultPlan.parse("bus.delay:p=0.5,n=3")
+
+    def test_set_plan_rejects_bad_plan(self):
+        with pytest.raises(ValueError):
+            faults.set_plan("bus.nope")
+        assert not faults.enabled()
+
+
+# --- Rule selection ----------------------------------------------------
+
+class TestRuleSelection:
+    def test_nth_fires_exactly_once(self):
+        plan = faults.FaultPlan.parse("bus.drop:n=3,op=push")
+        hits = [plan.fire("bus", op="push") for _ in range(10)]
+        assert [h is not None for h in hits] == \
+            [False, False, True] + [False] * 7
+
+    def test_every_fires_periodically(self):
+        plan = faults.FaultPlan.parse("bus.drop:every=3,op=push")
+        hits = [plan.fire("bus", op="push") is not None
+                for _ in range(9)]
+        assert hits == [False, False, True] * 3
+
+    def test_probability_replays_under_same_seed(self):
+        def draw(seed):
+            plan = faults.FaultPlan.parse("bus.drop:p=0.5,op=push",
+                                          seed=seed)
+            return [plan.fire("bus", op="push") is not None
+                    for _ in range(64)]
+
+        assert draw(7) == draw(7)
+        assert draw(7) != draw(8)
+        assert any(draw(7)) and not all(draw(7))
+
+    def test_match_filters(self):
+        plan = faults.FaultPlan.parse("bus.drop:op=push,kind=query")
+        assert plan.fire("bus", op="push", kind="reply") is None
+        assert plan.fire("bus", op="pop", kind="query") is None
+        assert plan.fire("bus", op="push", kind="query") is not None
+        # Unmatched calls must not advance n= counters.
+        plan = faults.FaultPlan.parse("http.error:n=2,route=/a")
+        assert plan.fire("http", op="GET", route="/b") is None
+        assert plan.fire("http", op="GET", route="/a") is None
+        assert plan.fire("http", op="GET", route="/a") is not None
+
+
+# --- Zero-overhead guard ----------------------------------------------
+
+class TestZeroOverheadWhenDisabled:
+    def test_site_hook_is_none(self):
+        for site in faults.SITES:
+            assert faults.site_hook(site) is None
+
+    def test_memory_bus_hot_path_unchanged(self):
+        bus = MemoryBus()
+        assert bus._fault is None
+        before = _injections_total()
+        for i in range(50):
+            bus.push("q", i)
+        assert bus.pop_all("q", timeout=0.0) == list(range(50))
+        bus.set("k", {"v": 1})
+        assert bus.get("k") == {"v": 1}
+        assert _injections_total() == before
+
+    def test_http_server_hot_path_unchanged(self):
+        server = JsonHttpServer(
+            [("GET", "/ping", lambda p, b, c: (200, {"ok": True}))],
+            host="127.0.0.1", name="t-faults-off").start()
+        try:
+            assert server._fault is None
+            before = _injections_total()
+            r = requests.get(
+                f"http://127.0.0.1:{server.port}/ping", timeout=5)
+            assert r.status_code == 200 and r.json() == {"ok": True}
+            assert _injections_total() == before
+        finally:
+            server.stop()
+
+    def test_armed_empty_plan_fires_nothing(self):
+        faults.set_plan("")
+        assert faults.enabled()
+        bus = MemoryBus()
+        assert bus._fault is not None
+        before = _injections_total()
+        bus.push("q", 1)
+        assert bus.pop("q") == 1
+        assert _injections_total() == before
+
+
+# --- set_plan re-arming ------------------------------------------------
+
+def test_set_plan_rearms_live_sites():
+    faults.set_plan("")  # armed, quiet: sites get hooks
+    bus = MemoryBus()
+    bus.push("q", 1)
+    assert bus.pop("q") == 1
+    faults.set_plan("bus.drop:op=push")  # injure mid-flight
+    bus.push("q", 2)
+    assert bus.pop("q", timeout=0.0) is None
+    faults.set_plan(None)  # disarm: same hook object goes quiet
+    bus.push("q", 3)
+    assert bus.pop("q") == 3
+
+
+# --- Memory bus sites --------------------------------------------------
+
+class TestMemoryBusInjection:
+    def test_drop_loses_push_only(self):
+        faults.set_plan("bus.drop:op=push")
+        bus = MemoryBus()
+        bus.push("q", 1)
+        assert bus.pop("q", timeout=0.0) is None
+        # Non-push ops ignore a drop verdict entirely.
+        faults.set_plan("bus.drop")
+        bus._queues.clear()
+        bus.push("q2", 1)  # dropped (matches any op)
+        faults.set_plan("bus.drop:op=pop")
+        bus.push("q2", 2)
+        assert bus.pop("q2") == 2
+
+    def test_drop_push_many(self):
+        faults.set_plan("bus.drop:op=push_many,kind=query")
+        bus = MemoryBus()
+        bus.push_many([("q:w1", {"a": 1}), ("q:w2", {"a": 2})])
+        assert bus.pop("q:w1", timeout=0.0) is None
+        assert bus.pop("q:w2", timeout=0.0) is None
+        # reply-kind frames unaffected
+        bus.push_many([("r:b1", {"a": 3})])
+        assert bus.pop("r:b1") == {"a": 3}
+
+    def test_delay_sleeps(self):
+        faults.set_plan("bus.delay:ms=60,op=push")
+        bus = MemoryBus()
+        t0 = time.monotonic()
+        bus.push("q", 1)
+        assert time.monotonic() - t0 >= 0.05
+        assert bus.pop("q") == 1  # delayed, not lost
+
+    def test_disconnect_raises(self):
+        faults.set_plan("bus.disconnect:n=1")
+        bus = MemoryBus()
+        with pytest.raises(ConnectionError, match="injected"):
+            bus.push("q", 1)
+        bus.push("q", 2)  # n=1 spent; next op sails through
+        assert bus.pop("q") == 2
+
+    def test_injections_are_counted(self):
+        faults.set_plan("bus.drop:op=push")
+        bus = MemoryBus()
+        c_before = _injections_total()
+        for i in range(3):
+            bus.push("q", i)
+        c = registry().find(COUNTER)
+        assert c is not None
+        assert c.value(site="bus", kind="drop") >= 3
+        assert _injections_total() - c_before == 3
+
+
+# --- HTTP site ---------------------------------------------------------
+
+class TestHttpInjection:
+    def _server(self, name):
+        return JsonHttpServer(
+            [("GET", "/ping", lambda p, b, c: (200, {"ok": True})),
+             ("GET", "/boom", lambda p, b, c: (200, {"ok": True}))],
+            host="127.0.0.1", name=name).start()
+
+    def test_error_replies_before_dispatch(self):
+        faults.set_plan("http.error:n=1,code=503")
+        server = self._server("t-faults-err")
+        try:
+            url = f"http://127.0.0.1:{server.port}/ping"
+            r1 = requests.get(url, timeout=5)
+            assert r1.status_code == 503
+            assert "injected" in r1.json()["error"]
+            assert requests.get(url, timeout=5).status_code == 200
+        finally:
+            server.stop()
+
+    def test_route_filter(self):
+        faults.set_plan("http.error:route=/boom,code=500")
+        server = self._server("t-faults-route")
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert requests.get(base + "/ping",
+                                timeout=5).status_code == 200
+            assert requests.get(base + "/boom",
+                                timeout=5).status_code == 500
+        finally:
+            server.stop()
+
+    def test_timeout_stalls_then_serves(self):
+        faults.set_plan("http.timeout:ms=80,n=1")
+        server = self._server("t-faults-stall")
+        try:
+            t0 = time.monotonic()
+            r = requests.get(f"http://127.0.0.1:{server.port}/ping",
+                             timeout=5)
+            assert time.monotonic() - t0 >= 0.06
+            assert r.status_code == 200
+        finally:
+            server.stop()
+
+
+# --- TCP bus client: injection + reconnection --------------------------
+
+class _FrameEatingServer:
+    """Accepts connections, reads ONE full frame, then closes the
+    connection without replying — the worst-case broker death: the
+    client's frame was fully SENT but no response will ever come."""
+
+    def __init__(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self.connections = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        hdr = struct.Struct(">I")
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self.connections += 1
+            try:
+                raw = b""
+                while len(raw) < hdr.size:
+                    chunk = conn.recv(hdr.size - len(raw))
+                    if not chunk:
+                        break
+                    raw += chunk
+                if len(raw) == hdr.size:
+                    want = hdr.unpack(raw)[0]
+                    got = 0
+                    while got < want:
+                        chunk = conn.recv(min(65536, want - got))
+                        if not chunk:
+                            break
+                        got += len(chunk)
+            finally:
+                conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TestTcpReconnect:
+    def test_injected_disconnect_drops_socket(self):
+        server = BusServer().start()
+        try:
+            faults.set_plan("bus.disconnect:n=1,op=push")
+            client = BusClient(server.host, server.port)
+            assert client.ping()  # op filter: ping unaffected
+            with pytest.raises(ConnectionError, match="injected"):
+                client.push("q", 1)
+            # The cached socket was dropped; the next op reconnects.
+            client.push("q", 2)
+            assert client.pop("q") == 2
+            client.close()
+        finally:
+            server.stop()
+
+    def test_broker_restart_heals_idempotent_ops(self):
+        server = BusServer().start()
+        host, port = server.host, server.port
+        client = BusClient(host, port, retry_base_s=0.02,
+                           retry_total_s=10.0)
+        client.set("k", {"v": 1})
+        server.stop()
+        # Restart on the SAME port (allow_reuse_address) — the new
+        # broker has fresh (empty) state, like a real process restart.
+        server2 = BusServer(host=host, port=port).start()
+        try:
+            # get retries through the stale socket + any races and
+            # completes against the new broker (state forgotten).
+            assert client.get("k") is None
+            client.set("k", {"v": 2})
+            assert client.get("k") == {"v": 2}
+            client.close()
+        finally:
+            server2.stop()
+
+    def test_sent_non_idempotent_op_is_never_replayed(self):
+        eater = _FrameEatingServer()
+        try:
+            client = BusClient("127.0.0.1", eater.port, timeout=5.0,
+                               retry_base_s=0.02, retry_total_s=5.0)
+            with pytest.raises((ConnectionError, OSError)):
+                client.push("q", 1)
+            # The frame was fully sent when the connection died: a push
+            # must NOT be resent (the broker may have executed it) —
+            # exactly one connection means zero replays.
+            assert eater.connections == 1
+            client.close()
+        finally:
+            eater.stop()
+
+    def test_sent_idempotent_op_retries_until_budget(self):
+        eater = _FrameEatingServer()
+        try:
+            client = BusClient("127.0.0.1", eater.port, timeout=5.0,
+                               retry_base_s=0.02, retry_total_s=0.4)
+            t0 = time.monotonic()
+            with pytest.raises((ConnectionError, OSError)):
+                client.get("k")
+            elapsed = time.monotonic() - t0
+            # Idempotent read: retried across reconnects until the
+            # budget lapsed (>= immediate retry + backed-off attempts).
+            assert eater.connections >= 2
+            assert elapsed < 5.0  # bounded by the budget, not hung
+            client.close()
+        finally:
+            eater.stop()
+
+    def test_zero_budget_is_legacy_single_resend(self):
+        eater = _FrameEatingServer()
+        try:
+            client = BusClient("127.0.0.1", eater.port, timeout=5.0,
+                               retry_total_s=0.0)
+            with pytest.raises((ConnectionError, OSError)):
+                client.get("k")
+            # One immediate reconnect (stale-socket legacy behavior),
+            # then fail: exactly two connections.
+            assert eater.connections <= 2
+            client.close()
+        finally:
+            eater.stop()
+
+    def test_reconnects_are_counted(self):
+        eater = _FrameEatingServer()
+        try:
+            client = BusClient("127.0.0.1", eater.port, timeout=5.0,
+                               retry_base_s=0.02, retry_total_s=0.3)
+            c = registry().find("rafiki_tpu_bus_reconnects_total")
+            before = c.value() if c is not None else 0.0
+            with pytest.raises((ConnectionError, OSError)):
+                client.get("k")
+            c = registry().find("rafiki_tpu_bus_reconnects_total")
+            assert c is not None and c.value() > before
+            client.close()
+        finally:
+            eater.stop()
+
+
+# --- NodeConfig integration -------------------------------------------
+
+class TestNodeConfigFaultKnobs:
+    def test_validate_rejects_bad_plan(self):
+        from rafiki_tpu.config import NodeConfig
+
+        with pytest.raises(ValueError):
+            NodeConfig(fault_plan="bus.explode").validate()
+        NodeConfig(fault_plan="bus.delay:ms=5").validate()
+
+    def test_apply_env_roundtrip(self, monkeypatch, tmp_path):
+        from rafiki_tpu.config import NodeConfig
+
+        # setenv (not delenv) so monkeypatch restores the pre-test
+        # state even though apply_env() mutates os.environ directly.
+        for var in (faults.PLAN_ENV, faults.SEED_ENV,
+                    "RAFIKI_TPU_BUS_RETRY_BASE_S",
+                    "RAFIKI_TPU_BUS_RETRY_TOTAL_S"):
+            monkeypatch.setenv(var, "unset-sentinel")
+        cfg = NodeConfig(workdir=str(tmp_path),
+                         fault_plan="worker.crash:n=2", fault_seed=9,
+                         bus_retry_base_s=0.1, bus_retry_total_s=3.0)
+        cfg.validate()
+        cfg.apply_env()
+        import os
+
+        assert os.environ[faults.PLAN_ENV] == "worker.crash:n=2"
+        assert os.environ[faults.SEED_ENV] == "9"
+        assert os.environ["RAFIKI_TPU_BUS_RETRY_BASE_S"] == "0.1"
+        assert os.environ["RAFIKI_TPU_BUS_RETRY_TOTAL_S"] == "3.0"
+        # The plane arms from the env at the next construction.
+        faults.reset()
+        assert faults.enabled()
+        # An empty plan pops the env (absent = disabled).
+        NodeConfig(workdir=str(tmp_path)).apply_env()
+        assert faults.PLAN_ENV not in os.environ
+        faults.reset()
+        assert not faults.enabled()

@@ -456,6 +456,34 @@ def test_penalized_replica_recovers_after_probe_interval(bus):
         steady.stop()
 
 
+def test_quarantine_backoff_doubles_per_strike_and_resets(bus):
+    """A still-dead replica must stop costing one partial deadline per
+    gather timeout: each consecutive missed probe doubles its
+    quarantine (capped), and one real reply resets the ladder."""
+    from rafiki_tpu.predictor.predictor import _QUARANTINE_MAX_MULT
+
+    p = _predictor(bus, gather_timeout=1.0)
+    try:
+        p._penalize("w")
+        assert p._quarantine_s("w") == 1.0  # first strike: one timeout
+        p._penalize("w")
+        assert p._quarantine_s("w") == 2.0  # probe missed again
+        for _ in range(10):
+            p._penalize("w")
+        assert p._quarantine_s("w") == float(_QUARANTINE_MAX_MULT)
+        p._note_latency("w", 0.01)  # a real reply proves it alive
+        assert "w" not in p._strikes
+        p._penalize("w")
+        assert p._quarantine_s("w") == 1.0  # ladder starts over
+        # Strikes outlive penalty expiry on purpose: expiry IS the
+        # probe, so only a reply (not mere re-planning) resets them.
+        p._penalized.pop("w")
+        p._penalize("w")
+        assert p._quarantine_s("w") == 2.0
+    finally:
+        p.close()
+
+
 def test_partial_bin_degrades_not_stalls(bus):
     """A dead single-replica bin (no sibling to resubmit to) costs only
     its own vote: the other bin's predictions still come back."""
