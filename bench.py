@@ -609,10 +609,280 @@ def main_serving_openloop() -> dict:
         pipeline_speedup=round(qps_on / qps_off, 3))
 
 
+#: --workload override for serving-concurrent (set by _main_cli):
+#: None = the default uniform-traffic matrix; "zipf[:s[:keys]]" = the
+#: edge-cache + tier A/B under zipf-keyed traffic.
+_WORKLOAD = None
+
+
+def _serving_zipf_ab(workload: str) -> dict:
+    """``--workload zipf:<s>:<keys>`` — the edge cache + tiered serving
+    A/B (ISSUE r12): cache+tier ON vs OFF, same stack otherwise, under
+    zipf-keyed single-query traffic (the regime the cache exists for:
+    most requests repeat a small hot key set).
+
+    ONE platform trains a 2-trial job and serves it twice at
+    ``max_models=2`` (two bins, so the tier path is real): job E with
+    ``RAFIKI_TPU_SERVING_CACHE_BYTES=64MB`` +
+    ``RAFIKI_TPU_SERVING_TIER_THRESHOLD``, job F with both popped (the
+    disabled path every other config also runs). 8 closed-loop clients
+    send single-query requests whose key rank is drawn zipf(s) over
+    ``keys`` distinct query frames; E/F windows interleave per round so
+    box noise lands on both. Sides record their own windows + spread;
+    p50 comes from each predictor's OWN http histogram as bucket
+    deltas around the measured phase. The OFF side's /metrics is also
+    asserted to carry ZERO cache/tier series (the disabled-mode
+    discipline, recorded as ``off_new_series``)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+    import requests
+
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.config import NodeConfig
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.observe.metrics import (bucket_percentile,
+                                            parse_exposition)
+    from rafiki_tpu.platform import LocalPlatform
+
+    parts = workload.split(":")
+    zipf_s = float(parts[1]) if len(parts) > 1 and parts[1] else 1.1
+    n_keys = int(parts[2]) if len(parts) > 2 and parts[2] else 64
+    n_clients, window_s, rounds = 8, 10.0, 4
+    cache_env = NodeConfig.env_name("serving_cache_bytes")
+    ttl_env = NodeConfig.env_name("serving_cache_ttl_s")
+    tier_env = NodeConfig.env_name("serving_tier_threshold")
+
+    def start_job(admin, cache, user_id, job_id, warm_batch, want=2):
+        inf = admin.create_inference_job(user_id, job_id, max_models=2)
+        deadline = time.time() + 600
+        while len(cache.running_workers(inf["id"])) < want \
+                and time.time() < deadline:
+            time.sleep(0.5)
+        n_workers = len(cache.running_workers(inf["id"]))
+        assert n_workers >= want, f"{n_workers}/{want} bins registered"
+        host = admin.get_inference_job(inf["id"])["predictor_host"]
+        r = requests.post(f"http://{host}/predict",
+                          json={"queries": warm_batch}, timeout=300)
+        r.raise_for_status()
+        return inf["id"], host
+
+    def http_buckets(host, http_service):
+        metrics = parse_exposition(
+            requests.get(f"http://{host}/metrics", timeout=30).text)
+        out = {}
+        for labels, v in metrics.get(
+                "rafiki_tpu_http_request_seconds_bucket", []):
+            if labels.get("service") != http_service or \
+                    labels.get("route") != "/predict":
+                continue
+            le = labels.get("le")
+            bound = float("inf") if le == "+Inf" else float(le)
+            out[bound] = out.get(bound, 0) + int(v)
+        return out
+
+    def delta_percentiles_ms(before, after, qs=(0.5, 0.95, 0.99)):
+        deltas = sorted((le, after.get(le, 0) - before.get(le, 0))
+                        for le in after)
+        if not deltas or deltas[-1][1] <= 0:
+            return None
+        out = []
+        for q in qs:
+            v = bucket_percentile(deltas, q)
+            out.append(round(v * 1e3, 3) if v is not None else None)
+        return out
+
+    def zipf_window(url, frames, probs, seed, duration=None):
+        counts = [0] * n_clients
+        errors: list = []
+        stop = threading.Event()
+
+        def client(i: int) -> None:
+            rng = np.random.default_rng(seed * 1000 + i)
+            session = requests.Session()
+            try:
+                while not stop.is_set():
+                    k = int(rng.choice(len(frames), p=probs))
+                    r = session.post(url, json={"query": frames[k]},
+                                     timeout=300)
+                    r.raise_for_status()
+                    counts[i] += 1
+            except Exception as e:  # surfaced by the caller
+                errors.append(e)
+                stop.set()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(duration if duration is not None else window_s)
+        stop.set()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"bench client failed: {errors[0]}")
+        return sum(counts) / (time.monotonic() - t0)
+
+    def service_samples(host, name):
+        metrics = parse_exposition(
+            requests.get(f"http://{host}/metrics", timeout=30).text)
+        return metrics.get(name, [])
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256)
+        for env in (cache_env, ttl_env, tier_env):
+            os.environ.pop(env, None)
+        # Two A/B jobs x two bins on a small box: lift the time-sliced
+        # tenancy cap so both stacks fit (same move as the uniform
+        # matrix; restored afterwards).
+        share_env = "RAFIKI_TPU_MAX_CHIP_SHARE"
+        prior_share = os.environ.get(share_env)
+        os.environ.setdefault(share_env, "8")
+        platform = LocalPlatform(workdir=f"{tmp}/plat")
+        try:
+            admin = platform.admin
+            cache = Cache(platform.bus)
+            user = admin.create_user("cc@x.c", "pw",
+                                     UserType.MODEL_DEVELOPER)
+            model = admin.create_model(
+                user["id"], "ff-cc", TaskType.IMAGE_CLASSIFICATION,
+                "rafiki_tpu.models.feedforward:JaxFeedForward")
+            job = admin.create_train_job(
+                user["id"], "cc", TaskType.IMAGE_CLASSIFICATION,
+                [model["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 2},
+                train_path, val_path)
+            assert admin.wait_until_train_job_done(job["id"],
+                                                   timeout=1200)
+            val = load_image_dataset(val_path)
+            frames = [encode_payload(val.images[i % val.size])
+                      for i in range(n_keys)]
+            ranks = np.arange(1, n_keys + 1, dtype=np.float64)
+            probs = ranks ** -zipf_s
+            probs /= probs.sum()
+            warm = frames[:8]
+
+            # Job E: cache + tier ON. TTL far beyond the run so only
+            # promotion/eviction could drop entries mid-measurement.
+            os.environ[cache_env] = str(64 << 20)
+            os.environ[ttl_env] = "600"
+            os.environ[tier_env] = "0.05"
+            try:
+                inf_e, host_e = start_job(admin, cache, user["id"],
+                                          job["id"], warm)
+            finally:
+                for env in (cache_env, ttl_env, tier_env):
+                    os.environ.pop(env, None)
+            # Job F: both OFF — the disabled path, same stack.
+            inf_f, host_f = start_job(admin, cache, user["id"],
+                                      job["id"], warm)
+
+            stats_e = requests.get(f"http://{host_e}/stats",
+                                   timeout=30).json()
+            stats_f = requests.get(f"http://{host_f}/stats",
+                                   timeout=30).json()
+            assert stats_e.get("cache"), stats_e
+            assert stats_e.get("tier_threshold"), stats_e
+            assert stats_f.get("cache") is None, stats_f
+            assert not stats_f.get("tier_threshold"), stats_f
+
+            url_e = f"http://{host_e}/predict"
+            url_f = f"http://{host_f}/predict"
+            # Warm (untimed): XLA batch buckets + second-touch
+            # admission (a key must miss twice before it caches).
+            zipf_window(url_e, frames, probs, seed=99, duration=4.0)
+            zipf_window(url_f, frames, probs, seed=99, duration=4.0)
+            before_e = http_buckets(host_e, stats_e["http_service"])
+            before_f = http_buckets(host_f, stats_f["http_service"])
+            # Cache events are snapshot-delta'd around the measured
+            # phase exactly like the latency buckets: the warm windows
+            # exist to PAY the second-touch admission misses, and
+            # counting them would understate the measured hit rate.
+            ev_before = dict((requests.get(f"http://{host_e}/stats",
+                                           timeout=30).json()["cache"]
+                              or {}).get("events", {}))
+            vals_e: list = []
+            vals_f: list = []
+            for r in range(rounds):
+                vals_e.append(zipf_window(url_e, frames, probs, seed=r))
+                vals_f.append(zipf_window(url_f, frames, probs, seed=r))
+                if _settled(vals_e) and _settled(vals_f):
+                    break
+            p50_e = delta_percentiles_ms(
+                before_e, http_buckets(host_e, stats_e["http_service"]))
+            p50_f = delta_percentiles_ms(
+                before_f, http_buckets(host_f, stats_f["http_service"]))
+            stats_e = requests.get(f"http://{host_e}/stats",
+                                   timeout=30).json()
+            ev_after = (stats_e.get("cache") or {}).get("events", {})
+            events = {k: v - ev_before.get(k, 0)
+                      for k, v in ev_after.items()
+                      if v - ev_before.get(k, 0)}
+            hits = events.get("hit", 0)
+            misses = events.get("miss", 0)
+            tier_mix = {
+                labels["outcome"]: int(v)
+                for labels, v in service_samples(
+                    host_e, "rafiki_tpu_serving_tier_total")
+                if labels.get("service") == stats_e.get("service")}
+            avoided = {
+                labels["source"]: round(v, 3)
+                for labels, v in service_samples(
+                    host_e,
+                    "rafiki_tpu_serving_chip_seconds_avoided_total")
+                if labels.get("service") == stats_e.get("service")}
+            # Disabled mode must register ZERO cache/tier series on F.
+            off_series = [
+                (name, labels)
+                for name in ("rafiki_tpu_serving_cache_total",
+                             "rafiki_tpu_serving_cache_bytes",
+                             "rafiki_tpu_serving_tier_total",
+                             "rafiki_tpu_serving_chip_seconds_"
+                             "avoided_total")
+                for labels, _ in service_samples(host_f, name)
+                if labels.get("service") == stats_f.get("service")]
+            assert not off_series, off_series
+            for inf in (inf_e, inf_f):
+                admin.stop_inference_job(inf)
+        finally:
+            platform.shutdown()
+            if prior_share is None:
+                os.environ.pop(share_env, None)
+            else:
+                os.environ[share_env] = prior_share
+
+    best_e, best_f = max(vals_e), max(vals_f)
+    return _emit(
+        "serving_concurrent_qps", best_e, "queries/s",
+        workload=f"zipf:{zipf_s}:{n_keys}",
+        n_clients=n_clients,
+        n_windows=len(vals_e),
+        spread=round((best_e - min(vals_e)) / best_e, 3),
+        spread_off=round((best_f - min(vals_f)) / best_f, 3),
+        windows_cache_tier_on=[round(v, 2) for v in vals_e],
+        windows_cache_tier_off=[round(v, 2) for v in vals_f],
+        qps_cache_tier_on=round(best_e, 2),
+        qps_cache_tier_off=round(best_f, 2),
+        cache_tier_speedup=round(best_e / best_f, 3),
+        latency_ms_p50_p95_p99_on=p50_e,
+        latency_ms_p50_p95_p99_off=p50_f,
+        cache_hit_rate=round(hits / (hits + misses), 3)
+        if (hits + misses) else None,
+        cache_events=events,
+        coalesce_count=events.get("coalesce", 0),
+        tier_outcomes=tier_mix,
+        chip_seconds_avoided=avoided,
+        off_new_series=0)
+
+
 def main_serving_concurrent() -> dict:
     """Closed-loop concurrent serving: N clients against the predictor
     HTTP frontend — micro-batcher ON vs OFF (ISSUE r6) and replica
-    sharding ON vs OFF (ISSUE r8).
+    sharding ON vs OFF (ISSUE r8); with ``--workload zipf:<s>:<keys>``
+    the edge-cache + tier A/B instead (``_serving_zipf_ab``).
 
     The closed-loop config[3] (``serving``) hammers with 16 clients of
     64-query batches — big enough that per-request scatter overhead
@@ -660,6 +930,9 @@ def main_serving_concurrent() -> dict:
                                             histogram_percentiles_ms,
                                             parse_exposition)
     from rafiki_tpu.platform import LocalPlatform
+
+    if _WORKLOAD and _WORKLOAD.startswith("zipf"):
+        return _serving_zipf_ab(_WORKLOAD)
 
     n_clients, per_request = 8, 4
     shard_request = 32  # queries/request in the sharding A/B windows
@@ -1531,7 +1804,26 @@ def _main_cli() -> None:
         "--config", default=None, choices=sorted(_CONFIGS) + ["sweep"],
         help="one config, or 'sweep' for all. Default: sweep on the "
              "accelerator, 'trials' on CPU fallback.")
+    parser.add_argument(
+        "--workload", default=None,
+        help="serving-concurrent traffic shape: default = the uniform "
+             "matrix; 'zipf[:<s>[:<keys>]]' (e.g. zipf:1.1:64) = the "
+             "edge-cache + tiered-serving A/B under zipf-keyed "
+             "single-query traffic.")
     args = parser.parse_args()
+    if args.workload is not None:
+        if not args.workload.startswith("zipf"):
+            parser.error(f"unknown --workload {args.workload!r} "
+                         f"(expected zipf[:<s>[:<keys>]])")
+        if args.config != "serving-concurrent":
+            # The zipf A/B needs serving-concurrent's device
+            # provisioning (4 virtual devices below); silently riding
+            # a sweep would hang the 2-bin deploys AND replace the
+            # sweep's serving baseline with a different experiment.
+            parser.error("--workload only applies to "
+                         "--config serving-concurrent")
+        global _WORKLOAD
+        _WORKLOAD = args.workload
 
     # Resolve the platform BEFORE any backend touch. The site hook
     # latches jax_platforms to the accelerator regardless of
@@ -1548,13 +1840,17 @@ def _main_cli() -> None:
         # on its OWN device (co-owners of one chip serialize on its
         # queue — sharding there measures pure overhead), so a CPU
         # fallback for that config gets 2 virtual devices (no-op when
-        # the accelerator serves, or when XLA_FLAGS already pins one).
+        # the accelerator serves, or when XLA_FLAGS already pins one);
+        # the zipf workload variant deploys TWO 2-bin jobs (cache+tier
+        # on vs off) and only the first group of a deploy may
+        # time-slice, so it needs 4.
         # chaos needs allocation headroom for 2 replica bins PLUS a
         # respawn while the just-finished train worker may still hold
         # its chip — on a 1-device box the second bin would never
         # launch and the recovery loop would have nothing to restore.
         ensure_platform(n_virtual_devices=(
-            2 if args.config == "serving-concurrent"
+            (4 if _WORKLOAD else 2)
+            if args.config == "serving-concurrent"
             else 3 if args.config == "chaos" else None))
         import jax
 

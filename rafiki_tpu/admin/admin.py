@@ -13,7 +13,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..constants import (BudgetOption, InferenceJobStatus, ModelAccessRight,
-                         TrainJobStatus, TrialStatus, UserType)
+                         ServiceStatus, TrainJobStatus, TrialStatus,
+                         UserType)
 from ..model.knobs import knob_config_to_json
 from ..store import MetaStore, ParamStore
 from ..utils import auth
@@ -41,6 +42,14 @@ class Admin:
             self.meta.create_user(
                 superadmin_email, auth.hash_password(superadmin_password),
                 UserType.SUPERADMIN)
+        # Serializes promote_trial: its validate -> launch -> wait ->
+        # swap sequence spans a registration wait, and two concurrent
+        # promotes of the same trial would BOTH pass the already-served
+        # check and both burn a chip allocation. Promotion is a rare
+        # control-plane act; one node-wide lock is the simple fix.
+        import threading
+
+        self._promote_lock = threading.Lock()
 
     # --- Auth / users ---
 
@@ -426,6 +435,164 @@ class Admin:
                           claims: Optional[Dict[str, Any]] = None,
                           ) -> Dict[str, Any]:
         return dict(self._owned_inference_job(inference_job_id, claims))
+
+    def promote_trial(self, inference_job_id: str, trial_id: str,
+                      replace_trial_id: Optional[str] = None,
+                      register_timeout: float = 180.0,
+                      claims: Optional[Dict[str, Any]] = None,
+                      ) -> Dict[str, Any]:
+        """Promote a trained trial into a RUNNING inference job's
+        serving ensemble — the online half of train→serve, without a
+        job restart.
+
+        A worker for ``trial_id`` is launched and *waited for* (its bus
+        registration is the moment the Predictor can plan shards onto
+        it); only then are ``replace_trial_id``'s workers stopped (omit
+        it for an additive promotion that grows the ensemble by one
+        bin). Finally the predictor frontend's edge cache is
+        invalidated — synchronously, BEFORE this call returns — so no
+        request arriving after the promotion can be answered from a
+        pre-promotion cache entry: the epoch bump also voids any
+        still-in-flight pre-promotion scatter's insert. In-flight
+        requests (including coalesced cache waiters) that scattered
+        before the swap complete against the old ensemble, exactly like
+        any request racing a deploy.
+
+        Promotions are serialized node-wide (``_promote_lock``): the
+        validate→launch→wait→swap sequence spans a registration wait,
+        so a concurrent duplicate promote would otherwise pass the
+        already-served check too and double-allocate.
+        """
+        with self._promote_lock:
+            return self._promote_trial_locked(
+                inference_job_id, trial_id, replace_trial_id,
+                register_timeout, claims)
+
+    def _promote_trial_locked(self, inference_job_id: str,
+                              trial_id: str,
+                              replace_trial_id: Optional[str],
+                              register_timeout: float,
+                              claims: Optional[Dict[str, Any]],
+                              ) -> Dict[str, Any]:
+        import time as _time
+
+        from ..cache import Cache as _BusCache
+
+        job = self._owned_inference_job(inference_job_id, claims)
+        if job["status"] != InferenceJobStatus.RUNNING:
+            raise ValueError(
+                f"inference job {inference_job_id} is not RUNNING")
+        trial = self.meta.get_trial(trial_id)
+        if trial is None:
+            raise ValueError(f"unknown trial {trial_id}")
+        if trial["status"] != TrialStatus.COMPLETED or \
+                not trial.get("params_id"):
+            raise ValueError(
+                f"trial {trial_id} is not COMPLETED with saved params")
+        sub = self.meta.get_sub_train_job(trial["sub_train_job_id"])
+        if sub is None or sub["train_job_id"] != job["train_job_id"]:
+            raise ValueError(
+                f"trial {trial_id} does not belong to train job "
+                f"{job['train_job_id']}")
+        from .services_manager import _ACTIVE, PREDICTOR_TRIAL
+
+        # Mapping rows outlive their services (a replaced bin's row
+        # stays for history): only ACTIVE services define what is
+        # currently served.
+        rows = []
+        for w in self.meta.get_inference_job_workers(inference_job_id):
+            if w["trial_id"] == PREDICTOR_TRIAL:
+                continue
+            svc = self.meta.get_service(w["service_id"])
+            if svc is not None and svc["status"] in _ACTIVE:
+                rows.append(w)
+        served_bins = {w["trial_id"] for w in rows}
+        if any(trial_id in str(b).split(",") for b in served_bins):
+            raise ValueError(
+                f"trial {trial_id} is already served by this job")
+        old_rows: List[Dict[str, Any]] = []
+        if replace_trial_id is not None:
+            for w in rows:
+                members = str(w["trial_id"]).split(",")
+                if replace_trial_id not in members:
+                    continue
+                if len(members) > 1:
+                    raise ValueError(
+                        f"bin {w['trial_id']!r} packs several trials; "
+                        f"promotion cannot surgically replace one "
+                        f"member — replace the whole bin")
+                old_rows.append(w)
+            if not old_rows:
+                raise ValueError(
+                    f"trial {replace_trial_id} is not a served bin of "
+                    f"this job")
+        new_svc = self.services.add_inference_worker(inference_job_id,
+                                                     trial_id)
+        if new_svc is None:
+            raise RuntimeError(
+                "no chips available for the promoted trial's worker")
+        # The new bin must be LIVE (registered on the bus — workers
+        # register only after their model load + warm-up) before the
+        # old one is torn down, or the swap would drop the bin's vote.
+        bus_cache = _BusCache(self.services.serving_bus())
+        deadline = _time.monotonic() + register_timeout
+        while new_svc["id"] not in \
+                bus_cache.running_workers(inference_job_id):
+            if _time.monotonic() >= deadline:
+                self.services._stop_service(new_svc["id"])
+                raise RuntimeError(
+                    f"promoted worker {new_svc['id'][:8]} did not "
+                    f"register within {register_timeout}s; promotion "
+                    f"rolled back")
+            svc_row = self.meta.get_service(new_svc["id"])
+            if svc_row and svc_row["status"] == ServiceStatus.ERRORED:
+                # A self-errored worker never reaches the supervise
+                # sweep (it scans RUNNING rows only): release its chips
+                # here or the allocation leaks until the job stops.
+                self.services._stop_service(new_svc["id"])
+                raise RuntimeError(
+                    f"promoted worker {new_svc['id'][:8]} errored "
+                    f"during startup")
+            # rta: disable=RTA102 deliberate: _promote_lock MUST span the registration wait — serializing whole promotions (validate->launch->wait->swap) is the TOCTOU fix; only rare control-plane promote calls contend
+            _time.sleep(0.2)
+        stopped = []
+        for w in old_rows:
+            self.services._stop_service(w["service_id"])
+            stopped.append(w["service_id"])
+        self._invalidate_predictor_cache(job)
+        _log.info("promoted trial %s into inference job %s (replaced "
+                  "%s; stopped %d worker(s))", trial_id,
+                  inference_job_id, replace_trial_id, len(stopped))
+        return {"inference_job_id": inference_job_id,
+                "promoted_trial_id": trial_id,
+                "replaced_trial_id": replace_trial_id,
+                "new_service_id": new_svc["id"],
+                "stopped_service_ids": stopped}
+
+    def _invalidate_predictor_cache(self, job: Dict[str, Any]) -> None:
+        """Synchronous edge-cache invalidation on the job's predictor
+        frontend — the promotion-correctness step. Failure raises: the
+        ensemble already changed, and an unreachable frontend means
+        cached pre-promotion answers could outlive the swap (the
+        predictor's serving-vector cross-check would catch it on the
+        next miss, but 'eventually' is not the promotion contract)."""
+        import json as _json
+        from urllib.request import Request, urlopen
+
+        host = job.get("predictor_host")
+        if not host:
+            return  # no frontend deployed yet — nothing caches
+        try:
+            req = Request(f"http://{host}/cache/invalidate",
+                          data=b"{}",
+                          headers={"Content-Type": "application/json"},
+                          method="POST")
+            with urlopen(req, timeout=10) as resp:
+                _json.loads(resp.read())
+        except OSError as e:
+            raise RuntimeError(
+                f"promotion applied but the predictor at {host} did "
+                f"not acknowledge cache invalidation: {e}") from None
 
     def get_inference_job_stats(self, inference_job_id: str,
                                 claims: Optional[Dict[str, Any]] = None,

@@ -19,6 +19,11 @@ route-compatible so reference quickstart scripts port 1:1:
 - ``GET  /inference_jobs/<id>/stats``  predictor serving stats (proxied
                                      server-side for the dashboard)
 - ``POST /inference_jobs/<id>/stop``
+- ``POST /inference_jobs/<id>/promote``  hot-swap a trained trial into
+                                     the serving ensemble (``trial_id``,
+                                     optional ``replace_trial_id``);
+                                     invalidates the predictor edge
+                                     cache before returning
 - ``GET  /trace/<trace_id>``         stitched span timeline of one trace
 - ``GET  /trial_phases``             trial-lifecycle phase breakdown +
                                      residency-cache counters (resident
@@ -69,6 +74,8 @@ class AdminApp:
              self._inference_job_stats),
             ("POST", "/inference_jobs/<job_id>/stop",
              self._stop_inference_job),
+            ("POST", "/inference_jobs/<job_id>/promote",
+             self._promote_trial),
             ("GET", "/trace/<trace_id>", self._get_trace),
             ("GET", "/users", self._list_users),
             ("POST", "/users/<user_id>/ban", self._ban_user),
@@ -195,6 +202,14 @@ class AdminApp:
         claims = self._auth(ctx)
         self.admin.stop_inference_job(params["job_id"], claims=claims)
         return 200, {"stopped": params["job_id"]}
+
+    def _promote_trial(self, params, body, ctx):
+        claims = self._auth(ctx)
+        body = self._need(body, "trial_id")
+        return 200, self.admin.promote_trial(
+            params["job_id"], body["trial_id"],
+            replace_trial_id=body.get("replace_trial_id"),
+            claims=claims)
 
     def _list_inference_jobs(self, params, body, ctx):
         claims = self._auth(ctx)

@@ -645,25 +645,32 @@ class ServicesManager:
             self._pending_respawns.append(svc)
         return new_svc
 
+    def serving_bus(self):
+        """The bus this node's serving plane rides: thread mode reuses
+        the container's shared bus; subprocess / docker modes connect
+        (once, lazily) by URI. Shared by registration reaping and the
+        admin promotion path's wait-for-registration probe."""
+        bus = getattr(getattr(self.container, "ctx", None),
+                      "bus", None)
+        if bus is not None:
+            return bus
+        from ..bus import connect
+
+        if self._reap_bus is None:
+            self._reap_bus = connect(self.bus_uri)
+        return self._reap_bus
+
     def _reap_worker_registration(self, job_id: str,
                                   service_id: str) -> None:
         """Best-effort delete of a dead worker's bus registration.
 
-        Thread mode reuses the container's shared bus; subprocess /
-        docker modes reconnect by URI. A broker outage here is benign —
-        a restarted broker forgot the registration anyway."""
+        A broker outage here is benign — a restarted broker forgot the
+        registration anyway."""
         try:
-            bus = getattr(getattr(self.container, "ctx", None),
-                          "bus", None)
-            if bus is None:
-                from ..bus import connect
-
-                if self._reap_bus is None:
-                    self._reap_bus = connect(self.bus_uri)
-                bus = self._reap_bus
             from ..cache import Cache
 
-            Cache(bus).unregister_worker(job_id, service_id)
+            Cache(self.serving_bus()).unregister_worker(job_id,
+                                                        service_id)
         except (ConnectionError, OSError, RuntimeError):
             _log.warning("could not reap bus registration of dead "
                          "worker %s", service_id[:8], exc_info=True)

@@ -308,13 +308,25 @@ class Cache:
 
     def send_prediction_batch(self, batch_id: str, worker_id: str,
                               predictions: List[Any], weight: int = 1,
-                              shard: Optional[Any] = None) -> None:
+                              shard: Optional[Any] = None,
+                              confidence: Optional[List] = None,
+                              compute_s: Optional[float] = None) -> None:
         """``shard`` echoes the query frame's shard id (when the frame
         carried one) so a sharded gather can match this reply to its
         plan entry; un-sharded frames reply without the key, which is
-        also what pre-shard workers produce."""
+        also what pre-shard workers produce. ``confidence`` (per-query
+        softmax margins, None-padded) and ``compute_s`` (the worker's
+        device seconds for this slice) feed the Predictor's tiered
+        escalation and chip-seconds-avoided estimate; old workers omit
+        both, old predictors ignore both — skew degrades to the
+        pre-tier behavior, never a failed reply."""
         frame = {"worker_id": worker_id, "weight": int(weight),
                  "predictions": [encode_payload(p) for p in predictions]}
         if shard is not None:
             frame["shard"] = shard
+        if confidence is not None and any(c is not None
+                                          for c in confidence):
+            frame["confidence"] = confidence
+        if compute_s is not None:
+            frame["compute_s"] = compute_s
         self.bus.push(f"r:{batch_id}", frame)

@@ -92,6 +92,36 @@ class NodeConfig:
     serving_client_header: str = ""
     serving_client_share: float = 0.25     # fraction of queue_cap
 
+    # --- Predictor edge cache + tiered serving (docs/serving.md) ---
+    # Content-addressed response cache at the predictor edge: repeat
+    # queries are answered without touching the ensemble scatter.
+    # Byte budget; 0 (the default) disables the cache entirely — the
+    # serving hot path then pays one attribute check and registers NO
+    # cache metric series.
+    serving_cache_bytes: int = 0
+    # Max age of a cached answer, seconds. Entries are additionally
+    # invalidated wholesale whenever trial promotion changes any served
+    # bin (the admin promotion path bumps the cache epoch), so TTL only
+    # bounds staleness against out-of-band model changes.
+    serving_cache_ttl_s: float = 60.0
+    # Admission control: a key is cached only on its Nth miss (2 =
+    # second-touch, the default), so one-off keys don't churn the LRU.
+    # 1 admits on first touch.
+    serving_cache_admit_after: int = 2
+    # Confidence-tiered ensemble serving: scatter to the BEST bin (by
+    # tracked eval score) first and escalate to the full ensemble vote
+    # only for queries whose confidence (softmax margin) falls below
+    # this threshold. 0 (the default) disables tiering — every query
+    # fans out to the full ensemble, and no tier series is registered.
+    serving_tier_threshold: float = 0.0
+
+    # InferenceWorker bus-registration lease cadence, seconds: the
+    # registration is re-asserted at this period so a restarted broker
+    # re-learns live workers (docs/robustness.md). Promoted from an
+    # env-only expert knob (r12): per-deployment now that promotion /
+    # cache invalidation correctness leans on registration freshness.
+    worker_reregister: float = 5.0
+
     # --- Trial lifecycle / dataset residency (docs/training.md) ---
     # Host dataset cache: parsed datasets stay resident across trials,
     # keyed by (path, mtime, size), byte-budget LRU. 0 disables.
@@ -253,6 +283,19 @@ class NodeConfig:
         if not (0.0 <= self.serving_client_share <= 1.0):
             raise ValueError("serving_client_share must be within "
                              "[0, 1]")
+        if self.serving_cache_bytes < 0:
+            raise ValueError("serving_cache_bytes must be >= 0 "
+                             "(0 disables the edge cache)")
+        if self.serving_cache_ttl_s <= 0:
+            raise ValueError("serving_cache_ttl_s must be positive")
+        if self.serving_cache_admit_after < 1:
+            raise ValueError("serving_cache_admit_after must be >= 1 "
+                             "(1 = admit on first touch)")
+        if self.serving_tier_threshold < 0:
+            raise ValueError("serving_tier_threshold must be >= 0 "
+                             "(0 disables tiered serving)")
+        if self.worker_reregister <= 0:
+            raise ValueError("worker_reregister must be positive")
         if self.dataset_cache_bytes < 0 or self.stage_cache_bytes < 0:
             raise ValueError("dataset_cache_bytes and stage_cache_bytes "
                              "must be >= 0 (0 disables the cache)")
@@ -312,8 +355,17 @@ class NodeConfig:
             "1" if self.serving_shard_replicas else "0"
         for f in ("serving_fill_window", "serving_fill_window_min",
                   "serving_max_batch", "serving_max_inflight",
-                  "serving_queue_cap", "serving_client_share"):
+                  "serving_queue_cap", "serving_client_share",
+                  "serving_cache_bytes", "serving_cache_ttl_s",
+                  "serving_cache_admit_after"):
             os.environ[self.env_name(f)] = str(getattr(self, f))
+        # Read at construction by Predictor / InferenceWorker directly
+        # (not through the app-layer _env_knob helper), so RTA505
+        # tracks these two by name.
+        os.environ[self.env_name("serving_tier_threshold")] = \
+            str(self.serving_tier_threshold)
+        os.environ[self.env_name("worker_reregister")] = \
+            str(self.worker_reregister)
         # The adaptive ceiling defaults to the legacy fixed knob; only
         # an explicit override is exported (consumers fall back to
         # SERVING_FILL_WINDOW themselves).

@@ -4,7 +4,8 @@ Parity: SURVEY.md §2 "Predictor" + §3.3.
 """
 
 from .batcher import Backpressure, MicroBatcher
+from .edge_cache import EdgeCache, query_key
 from .predictor import Predictor, ensemble_predictions
 
 __all__ = ["Predictor", "ensemble_predictions", "MicroBatcher",
-           "Backpressure"]
+           "Backpressure", "EdgeCache", "query_key"]

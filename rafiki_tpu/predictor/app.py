@@ -34,10 +34,11 @@ from ..bus import BaseBus
 from ..cache import decode_payload
 from ..config import NodeConfig, _parse_bool
 from ..constants import ServiceStatus
-from ..observe import ServingStats
+from ..observe import ServingStats, trace
 from ..store import MetaStore
 from ..utils.service import JsonHttpServer
 from .batcher import Backpressure, MicroBatcher
+from .edge_cache import EdgeCache, query_key
 from .predictor import Predictor
 
 
@@ -57,7 +58,11 @@ class PredictorService:
                  queue_cap: Optional[int] = None,
                  shard_replicas: Optional[bool] = None,
                  client_header: Optional[str] = None,
-                 client_share: Optional[float] = None):
+                 client_share: Optional[float] = None,
+                 cache_bytes: Optional[int] = None,
+                 cache_ttl_s: Optional[float] = None,
+                 cache_admit_after: Optional[int] = None,
+                 tier_threshold: Optional[float] = None):
         import uuid
 
         self.service_id = service_id
@@ -75,9 +80,27 @@ class PredictorService:
         if shard_replicas is None:
             shard_replicas = _parse_bool(
                 _env_knob("serving_shard_replicas", "1"))
-        self.predictor = Predictor(inference_job_id, bus,
-                                   shard_replicas=shard_replicas,
-                                   service=self.stats.service)
+        self.predictor = Predictor(
+            inference_job_id, bus, shard_replicas=shard_replicas,
+            service=self.stats.service,
+            tier_threshold=(
+                tier_threshold if tier_threshold is not None else
+                float(_env_knob("serving_tier_threshold", "0") or 0)))
+        # Content-addressed edge cache in front of the batcher/scatter
+        # (docs/serving.md). None when disabled: the hot path then pays
+        # ONE attribute check and no cache series is ever registered.
+        _cache_bytes = int(cache_bytes if cache_bytes is not None else
+                           _env_knob("serving_cache_bytes", "0") or 0)
+        self.edge_cache: Optional[EdgeCache] = None
+        if _cache_bytes > 0:
+            self.edge_cache = EdgeCache(
+                _cache_bytes,
+                ttl_s=float(cache_ttl_s if cache_ttl_s is not None else
+                            _env_knob("serving_cache_ttl_s", "60")),
+                admit_after=int(
+                    cache_admit_after if cache_admit_after is not None
+                    else _env_knob("serving_cache_admit_after", "2")),
+                service=self.stats.service)
         if microbatch is None:
             microbatch = _parse_bool(_env_knob("serving_microbatch", "1"))
         self.microbatch = microbatch
@@ -131,6 +154,7 @@ class PredictorService:
             ("GET", "/", self._health),
             ("GET", "/stats", self._stats),
             ("POST", "/predict", self._predict),
+            ("POST", "/cache/invalidate", self._cache_invalidate),
         ], host=host, port=port,
             # Same per-INSTANCE uniqueness rule as the stats label (and
             # sharing its suffix): a reused service id would merge two
@@ -158,11 +182,14 @@ class PredictorService:
         if self.batcher is not None:
             self.batcher.stop()
         # Release this frontend's registry series (serving counters,
-        # the predictor's shard/replica series AND the http layer's
-        # per-service series): the labels are per-deployment, so
-        # leaking them would grow every scrape with deploy/stop churn.
+        # the predictor's shard/replica series, the edge cache's AND
+        # the http layer's per-service series): the labels are
+        # per-deployment, so leaking them would grow every scrape with
+        # deploy/stop churn.
         self.stats.close()
         self.predictor.close()
+        if self.edge_cache is not None:
+            self.edge_cache.close()
         from ..observe import metrics as obs_metrics
 
         for name in ("rafiki_tpu_http_request_seconds",
@@ -200,6 +227,9 @@ class PredictorService:
         # bench) can match this frontend's series without guessing.
         snap["http_service"] = self._http.name
         snap["shard_replicas"] = self.predictor.shard_replicas
+        snap["tier_threshold"] = self.predictor.tier_threshold
+        snap["cache"] = (self.edge_cache.info()
+                         if self.edge_cache is not None else None)
         if self.batcher is not None:
             snap["knobs"] = {
                 "fill_window": self.batcher.fill_window,
@@ -213,18 +243,126 @@ class PredictorService:
             }
         return 200, snap
 
+    def _cache_invalidate(self, params, body, ctx):
+        """Drop every cached answer and bump the cache epoch — the
+        admin promotion path calls this synchronously BEFORE answering
+        the promote request, so no request after a promotion can be
+        served a pre-promotion entry. Unauthenticated like every other
+        predictor route (invalidation is a safe, idempotent act);
+        answers ``enabled: false`` with no side effect when the cache
+        is off."""
+        if self.edge_cache is None:
+            return 200, {"enabled": False}
+        return 200, {"enabled": True,
+                     "epoch": self.edge_cache.invalidate()}
+
     def _run_queries(self, encoded_queries,
                      client: Optional[str] = None) -> list:
-        """One request's queries → ensembled predictions, through the
-        shared micro-batcher when enabled (frames stay wire-encoded all
-        the way to the bus — no decode/re-encode on the hot path)."""
+        """One request's queries → ensembled predictions. With the edge
+        cache enabled, each query is first resolved against it: hits
+        are answered without touching the batcher/bus, concurrent
+        identical queries coalesce onto one in-flight scatter, and only
+        genuine misses dispatch. Disabled cache = one attribute check,
+        straight to dispatch."""
+        if self.edge_cache is None:
+            return self._dispatch_queries(encoded_queries, client)
+        return self._run_cached(encoded_queries, client)
+
+    def _handler_timeout(self) -> float:
+        """Bound a handler's wait by the worst honest path: worker
+        warm-up wait + gather timeout + batching slack. A wedged
+        batcher (or a stranded coalesced flight) then surfaces as a
+        500, not a hung socket."""
+        return (self.predictor.worker_wait_timeout
+                + self.predictor.gather_timeout + 60.0)
+
+    def _run_cached(self, encoded_queries,
+                    client: Optional[str] = None) -> list:
+        import time
+
+        cache = self.edge_cache
+        n = len(encoded_queries)
+        results: list = [None] * n
+        misses: list = []      # (position, key) this request leads
+        lead_pos: dict = {}    # key -> leading position (intra-request)
+        dups: list = []        # (position, leader position)
+        waits: list = []       # (position, in-flight leader's flight)
+        wall, t0 = time.time(), time.monotonic()
+        n_hits = 0
+        for i, q in enumerate(encoded_queries):
+            key = query_key(q)
+            if key in lead_pos:  # same key twice in ONE request
+                dups.append((i, lead_pos[key]))
+                continue
+            kind, payload = cache.begin(key)
+            if kind == "hit":
+                results[i] = payload
+                n_hits += 1
+            elif kind == "wait":
+                waits.append((i, payload))
+            else:
+                lead_pos[key] = i
+                misses.append((i, key, payload))  # payload = our flight
+        # The epoch is read BEFORE dispatch: an invalidation (trial
+        # promotion) landing while the scatter is in flight bumps it,
+        # and resolve() then drops the stale insert.
+        epoch = cache.epoch
+        if misses:
+            try:
+                sub = self._dispatch_queries(
+                    [encoded_queries[i] for i, _, _ in misses], client)
+            except BaseException as e:
+                for _, key, flight in misses:
+                    cache.fail(key, e, flight=flight)
+                raise
+            # Cross-check the serving-bin vector the scatter actually
+            # saw: a changed bin set (promotion observed from the
+            # registry) invalidates even without the admin's POST.
+            vector = self.predictor.serving_vector()
+            if vector is not None:
+                cache.note_vector(vector)
+            for (i, key, flight), value in zip(misses, sub):
+                results[i] = value
+                cache.resolve(key, value, epoch, flight=flight)
+        # Hits and coalesced waiters skip the scatter→gather: credit
+        # the estimated chip-seconds a MISS would have cost (0 until
+        # the per-bin cost EWMA warms; best-bin-only under tiering —
+        # under-report, never fabricate). Waiters are credited only
+        # AFTER their flight succeeds: a failed leader avoided nothing.
+        est = (self.predictor.estimate_hit_cost()
+               if (n_hits or waits) else 0.0)
+        if n_hits:
+            cache.note_avoided(n_hits * est)
+        if waits:
+            timeout = self._handler_timeout()
+            for i, flight in waits:
+                results[i] = flight.wait(timeout)
+            # A leader whose ensemble FAILED resolves its flight with
+            # None (never inserted): those waiters avoided nothing.
+            cache.note_avoided(est * sum(
+                1 for i, _ in waits if results[i] is not None))
+        for i, lead in dups:
+            results[i] = results[lead]
+        if n_hits or waits or dups:
+            ctx = trace.current()
+            if ctx is not None:
+                trace.record_event(
+                    "predictor.cache", self.stats.service, [ctx], wall,
+                    time.monotonic() - t0,
+                    attrs={"hits": n_hits, "coalesced": len(waits),
+                           "misses": len(misses)})
+        return results
+
+    def _dispatch_queries(self, encoded_queries,
+                          client: Optional[str] = None) -> list:
+        """Cache-miss path: through the shared micro-batcher when
+        enabled (frames stay wire-encoded all the way to the bus — no
+        decode/re-encode on the hot path)."""
+        if not encoded_queries:
+            return []
         if self.batcher is not None:
-            # Bound the handler's wait by the worst honest path: worker
-            # warm-up wait + gather timeout + batching slack. A wedged
-            # batcher then surfaces as a 500, not a hung socket.
-            timeout = (self.predictor.worker_wait_timeout
-                       + self.predictor.gather_timeout + 60.0)
-            return self.batcher.submit(encoded_queries, timeout=timeout,
+            return self.batcher.submit(encoded_queries,
+                                       timeout=self._handler_timeout(),
                                        client=client)
         n = len(encoded_queries)
         if client is not None and self._direct_cap:

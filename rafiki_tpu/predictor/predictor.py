@@ -124,16 +124,37 @@ def ensemble_predictions(worker_predictions: List[Any],
     return next(p for p, _ in pairs if repr(p) == winner)
 
 
+#: Reassembly hole marker: a query position whose bin shard never
+#: replied (shared by the full and tiered reassembly paths).
+_HOLE = object()
+
+#: EWMA smoothing for the per-bin compute-cost estimate (seconds per
+#: query, from worker-reported burst compute time) that prices the
+#: chip-seconds-avoided counters.
+_COST_ALPHA = 0.3
+
+
 class Predictor:
     def __init__(self, inference_job_id: str, bus: BaseBus,
                  gather_timeout: float = 30.0,
                  worker_wait_timeout: float = 120.0,
                  shard_replicas: Optional[bool] = None,
-                 service: Optional[str] = None):
+                 service: Optional[str] = None,
+                 tier_threshold: Optional[float] = None):
         self.inference_job_id = inference_job_id
         self.cache = Cache(bus)
         self.gather_timeout = gather_timeout
         self.worker_wait_timeout = worker_wait_timeout
+        # Confidence-tiered serving: scatter to the best bin (by
+        # tracked eval score) first, escalate to the full ensemble
+        # vote only for queries whose confidence falls below the
+        # threshold. None/0 = off — predict_submit pays one attribute
+        # check and no tier series is ever registered.
+        if tier_threshold is None:
+            tier_threshold = float(os.environ.get(
+                "RAFIKI_TPU_SERVING_TIER_THRESHOLD", "0") or 0)
+        self.tier_threshold = tier_threshold if tier_threshold > 0 \
+            else None
         # Data-parallel replica sharding: each trial bin's slice of a
         # super-batch is spread across ALL live same-bin replicas
         # (latency-weighted) instead of all landing on one rotating
@@ -151,6 +172,19 @@ class Predictor:
         # immutable per worker id, and per-request bus.get fan-out
         # would put O(workers) round-trips on the serving hot path.
         self._bins: Dict[str, str] = {}
+        # bin -> tracked eval score (from worker registration info; the
+        # tiered path's "best bin"). Keyed by bin, bounded by the
+        # number of served trials — no per-worker churn to prune.
+        self._bin_score: Dict[str, float] = {}
+        # bin -> EWMA of worker-reported compute seconds PER QUERY —
+        # prices the chip-seconds-avoided counters (cache hits and
+        # tier short-circuits). Bins with no estimate yet price as 0:
+        # the counter under-reports rather than fabricates.
+        self._bin_cost: Dict[str, float] = {}
+        # The bin set of the most recent shard plan (sorted tuple) —
+        # the serving "model-version vector" the edge cache
+        # cross-checks for promotion-driven invalidation.
+        self._last_bins: Optional[tuple] = None
         # worker_id -> EWMA of scatter->reply latency (seconds). Drives
         # the latency-weighted shard split; a timed-out shard penalizes
         # its replica so the next plan leans on its siblings.
@@ -170,7 +204,7 @@ class Predictor:
         self._strikes: Dict[str, int] = {}
         # ThreadingHTTPServer handler threads (batcher-off mode) and
         # the micro-batcher's scatter thread all route through
-        # _choose_workers/_plan_shards; the rr cursor, bin memo, and
+        # _choose_workers/_plan_for; the rr cursor, bin memo, and
         # latency map are guarded so concurrent requests can't lose
         # rotations or corrupt them.
         self._state_lock = threading.Lock()
@@ -180,7 +214,21 @@ class Predictor:
         # can join the serving and shard families.
         self.service = service or f"pred-{uuid.uuid4().hex[:8]}"
         self._m_shards = self._m_resubmits = self._m_replica = None
-        self._m_quarantines = None
+        self._m_quarantines = self._m_tier = self._m_avoided = None
+        if self.tier_threshold is not None and \
+                _metrics.metrics_enabled():
+            # Registered only when tiering is ON (the r11 discipline:
+            # disabled => attribute check only, zero new series).
+            reg = _metrics.registry()
+            self._m_tier = reg.counter(
+                "rafiki_tpu_serving_tier_total",
+                "Per-query tiered-serving outcomes (outcome="
+                "short_circuit|escalate|full)")
+            self._m_avoided = reg.counter(
+                "rafiki_tpu_serving_chip_seconds_avoided_total",
+                "Estimated chip-seconds NOT spent thanks to a serving "
+                "cut-through (source=cache|tier), from the per-bin "
+                "compute-cost EWMA")
         if _metrics.metrics_enabled():
             reg = _metrics.registry()
             self._m_shards = reg.counter(
@@ -205,7 +253,7 @@ class Predictor:
         label; a resident runner deploying/stopping frontends would
         otherwise grow the registry forever)."""
         for m in (self._m_shards, self._m_resubmits, self._m_replica,
-                  self._m_quarantines):
+                  self._m_quarantines, self._m_tier, self._m_avoided):
             if m is not None:
                 m.remove(service=self.service)
 
@@ -228,13 +276,18 @@ class Predictor:
     def _bin_of(self, worker_id: str) -> str:
         """Caller holds ``_state_lock``. The memoized bus.get is a
         round-trip, but only the FIRST request after a worker appears
-        pays it; steady-state requests never leave the memo."""
+        pays it; steady-state requests never leave the memo. The
+        registration's tracked eval score (absent on pre-r12 workers)
+        is captured per bin for the tiered path's best-bin pick."""
         bin_id = self._bins.get(worker_id)
         if bin_id is None:
             info = self.cache.bus.get(
                 f"w:{self.inference_job_id}:{worker_id}") or {}
             bin_id = str(info.get("trial_id") or worker_id)
             self._bins[worker_id] = bin_id
+            score = info.get("score")
+            if isinstance(score, (int, float)):
+                self._bin_score[bin_id] = float(score)
         return bin_id
 
     def _group_replicas(self) -> Tuple[Dict[str, List[str]], int,
@@ -278,7 +331,20 @@ class Predictor:
             groups: Dict[str, List[str]] = {}
             for w in workers:
                 groups.setdefault(self._bin_of(w), []).append(w)
+            # Promotion churn retires bins: prune their score/cost rows
+            # once they clearly outnumber the live set (same hysteresis
+            # as the worker memo prune above).
+            if len(self._bin_score) + len(self._bin_cost) > \
+                    4 * len(groups) + 16:
+                live = set(groups)
+                self._bin_score = {b: v for b, v
+                                   in self._bin_score.items()
+                                   if b in live}
+                self._bin_cost = {b: v for b, v
+                                  in self._bin_cost.items()
+                                  if b in live}
             self._rr += 1
+            self._last_bins = tuple(sorted(groups))
             return groups, self._rr, dict(self._lat)
 
     @staticmethod
@@ -300,6 +366,50 @@ class Predictor:
                 for _, members in sorted(groups.items())]
 
     # --- Shard planning (data-parallel replica serving) ---
+
+    def serving_vector(self) -> Optional[tuple]:
+        """The bin set of the most recent shard plan (sorted tuple) —
+        the serving ensemble's model-version vector. The edge cache
+        compares it across scatters: a change means trial promotion
+        swapped a served bin, so cached answers are stale."""
+        with self._state_lock:
+            return self._last_bins
+
+    def estimate_query_cost(self,
+                            exclude_bin: Optional[str] = None) -> float:
+        """Estimated chip-seconds ONE full-ensemble query costs across
+        the LIVE serving bins (sum of per-bin compute EWMAs over the
+        current serving vector; bins with no estimate yet contribute 0,
+        retired bins never count — a promotion must not leave a dead
+        bin's cost inflating the avoided counters). Prices the tier
+        short-circuit credit: all live bins but the best
+        (``exclude_bin``)."""
+        with self._state_lock:
+            live = self._last_bins
+            return sum(v for b, v in self._bin_cost.items()
+                       if b != exclude_bin
+                       and (live is None or b in live))
+
+    def estimate_hit_cost(self) -> float:
+        """Chip-seconds ONE cache hit (or coalesced wait) avoided. With
+        tiering OFF that is the full-ensemble cost; with tiering ON the
+        avoided miss would most likely have been a best-bin-only
+        short-circuit, so only the best bin's cost is claimed — the
+        cheapest honest estimate (escalations avoided more; the counter
+        under-reports, never fabricates). Falls back to the full sum
+        when the best bin is unknowable (a scoreless bin ⇒ misses fan
+        out in full anyway)."""
+        with self._state_lock:
+            live = self._last_bins
+            costs = {b: v for b, v in self._bin_cost.items()
+                     if live is None or b in live}
+            if self.tier_threshold is not None and live and \
+                    len(live) > 1:
+                scores = {b: self._bin_score.get(b) for b in live}
+                if all(v is not None for v in scores.values()):
+                    best = max(sorted(scores), key=lambda b: scores[b])
+                    return costs.get(best, 0.0)
+            return sum(costs.values())
 
     def _quarantine_s(self, worker_id: str) -> float:
         """Caller holds ``_state_lock``. Seconds a penalized replica
@@ -352,18 +462,16 @@ class Predictor:
         if self._m_quarantines is not None:
             self._m_quarantines.inc(service=self.service)
 
-    def _plan_shards(self, n: int) -> Tuple[List[_Shard],
-                                            Dict[str, List[str]]]:
-        """Split ``n`` queries into per-replica shards, one group of
-        shards per trial bin. With sharding OFF (or a single replica in
-        a bin) the bin's whole batch goes to one rotating pick — the
-        pre-shard behavior. With sharding ON, the bin's batch is sliced
-        across ALL its live replicas, sized inversely to each replica's
-        gather-latency EWMA (even slices until latencies are known); a
-        replica whose weighted slice rounds to zero is skipped. Returns
-        ``(plan, groups)`` — groups (bin -> members) feed the
-        resubmit-to-siblings path."""
-        groups, rr, lat = self._group_replicas()
+    def _plan_for(self, n: int, groups: Dict[str, List[str]], rr: int,
+                  lat: Dict[str, float]) -> List[_Shard]:
+        """Shard plan over the given bin groups (a subset for the
+        tiered path; everything for the full plan). With sharding OFF
+        (or a single replica in a bin) the bin's whole batch goes to
+        one rotating pick — the pre-shard behavior. With sharding ON,
+        the bin's batch is sliced across ALL its live replicas, sized
+        inversely to each replica's gather-latency EWMA (even slices
+        until latencies are known); a replica whose weighted slice
+        rounds to zero is skipped."""
         plan: List[_Shard] = []
         for bin_id, members in sorted(groups.items()):
             if not self.shard_replicas or len(members) == 1 or n == 1:
@@ -391,7 +499,7 @@ class Predictor:
                 if size > 0:
                     plan.append(_Shard(w, bin_id, start, size))
                     start += size
-        return plan, groups
+        return plan
 
     def _partial_wait(self, plan: List[_Shard]) -> float:
         """Seconds to wait for primary shards before resubmitting
@@ -433,6 +541,20 @@ class Predictor:
             shard.reply = reply
             if shard.pair is not None:
                 shard.pair.superseded = True
+            # Worker-reported compute seconds for this shard's slice
+            # (absent on pre-r12 workers) feed the per-bin per-query
+            # cost EWMA that prices chip-seconds-avoided.
+            compute_s = reply.get("compute_s")
+            n_preds = len(reply.get("predictions") or ())
+            if isinstance(compute_s, (int, float)) and compute_s >= 0 \
+                    and n_preds:
+                per_q = float(compute_s) / n_preds
+                with self._state_lock:
+                    prev = self._bin_cost.get(shard.bin)
+                    self._bin_cost[shard.bin] = (
+                        per_q if prev is None else
+                        _COST_ALPHA * per_q +
+                        (1.0 - _COST_ALPHA) * prev)
 
     def predict_submit(self, queries: List[Any], *,
                        pre_encoded: bool = False,
@@ -456,6 +578,15 @@ class Predictor:
         no live sibling degrades to a partial-bin result (the other
         bins still vote) instead of stalling the batch.
 
+        With confidence tiering ON (``tier_threshold``) and several
+        bins serving, the plan is CHEAP-FIRST: phase 1 scatters only to
+        the best bin (by tracked eval score); at gather time, queries
+        whose best-bin confidence clears the threshold short-circuit
+        with that single vote, and only the rest escalate to a second
+        partial plan over the remaining bins (same shard/resubmit
+        machinery) whose votes are merged with the best bin's — the
+        escalated queries still get one vote per bin.
+
         ``pre_encoded=True`` means the queries are already bus-safe
         frames (e.g. straight off the HTTP body) — no decode/re-encode
         round-trip on the hot path. ``trace_ctxs`` carries the coalesced
@@ -463,13 +594,11 @@ class Predictor:
         micro-batcher's scatter thread has no ambient context; the
         direct path falls back to the calling thread's).
         """
-        import time
-
         n = len(queries)
         if not n:
             return lambda: []
-        plan, groups = self._plan_shards(n)
-        if not plan:
+        groups, rr, lat = self._group_replicas()
+        if not groups:
             raise RuntimeError(
                 f"no running inference workers for job "
                 f"{self.inference_job_id}")
@@ -479,6 +608,30 @@ class Predictor:
             from ..cache import encode_payload
 
             encoded = [encode_payload(q) for q in queries]  # once total
+        if self.tier_threshold is not None and len(groups) > 1:
+            best = self._best_bin(groups)
+            if best is not None:
+                return self._submit_tiered(n, encoded, groups, rr, lat,
+                                           best, trace_ctxs)
+            # No best-bin basis (a serving worker predates score
+            # registration): the whole batch fans out in full.
+            self._count_tier("full", n)
+        plan = self._plan_for(n, groups, rr, lat)
+        batch_id = self._scatter(plan, encoded, trace_ctxs)
+
+        def finish() -> List[Optional[Any]]:
+            self._gather_shards(batch_id, plan, groups, encoded,
+                                trace_ctxs)
+            return self._reassemble(n, plan)
+
+        return finish
+
+    def _scatter(self, plan: List[_Shard], encoded: List[Any],
+                 trace_ctxs: Optional[List[Any]]) -> str:
+        """Stamp + send one shard plan (one ``push_many`` round-trip);
+        shared by the full and tiered submit paths."""
+        import time
+
         now = time.monotonic()
         for s in plan:
             s.t_sent = now
@@ -486,11 +639,104 @@ class Predictor:
             [s.wire() for s in plan], encoded, trace_ctxs=trace_ctxs)
         if self._m_shards is not None:
             self._m_shards.inc(len(plan), service=self.service)
+        return batch_id
+
+    # --- Confidence-tiered serving (cheap-first, escalate on doubt) ---
+
+    def _best_bin(self, groups: Dict[str, List[str]]) -> Optional[str]:
+        """The tiered path's phase-1 target: the served bin with the
+        highest tracked eval score. None (fall back to a full scatter)
+        unless EVERY bin has a score — a scoreless bin could be the
+        best one, and silently demoting it would bias the ensemble."""
+        with self._state_lock:
+            scores = {b: self._bin_score.get(b) for b in groups}
+        if not scores or any(v is None for v in scores.values()):
+            return None
+        return max(sorted(scores), key=lambda b: scores[b])
+
+    def _count_tier(self, outcome: str, n: int) -> None:
+        if self._m_tier is not None and n:
+            self._m_tier.inc(n, service=self.service, outcome=outcome)
+
+    def _submit_tiered(self, n: int, encoded: List[Any],
+                       groups: Dict[str, List[str]], rr: int,
+                       lat: Dict[str, float], best: str,
+                       trace_ctxs: Optional[List[Any]],
+                       ) -> Callable[[], List[Optional[Any]]]:
+        """Cheap-first scatter: phase 1 covers only the best bin; the
+        finisher escalates sub-threshold queries to the other bins as
+        a second partial plan. Ensemble semantics are preserved: a
+        short-circuit answer is the best bin's single vote, an
+        escalated answer is one vote per bin, exactly like the full
+        path."""
+        import time
+
+        best_groups = {best: groups[best]}
+        plan1 = self._plan_for(n, best_groups, rr, lat)
+        batch1 = self._scatter(plan1, encoded, trace_ctxs)
+        threshold = self.tier_threshold
 
         def finish() -> List[Optional[Any]]:
-            self._gather_shards(batch_id, plan, groups, encoded,
+            wall = time.time()
+            t0 = time.monotonic()
+            self._gather_shards(batch1, plan1, best_groups, encoded,
                                 trace_ctxs)
-            return self._reassemble(n, plan)
+            rows1, weights1, confs1 = self._collect_rows(n, plan1)
+            best_row = rows1.get(best)
+            best_conf = confs1.get(best)
+            best_w = weights1.get(best, 1)
+            results: List[Optional[Any]] = [None] * n
+            esc: List[int] = []
+            for i in range(n):
+                v = best_row[i] if best_row is not None else _HOLE
+                c = best_conf[i] if best_conf is not None else None
+                # Escalate on a missing/error vote OR missing
+                # confidence (sk-style models expose none) OR doubt.
+                if v is _HOLE or c is None or c < threshold:
+                    esc.append(i)
+                else:
+                    results[i] = ensemble_predictions([v],
+                                                      weights=[best_w])
+            short = n - len(esc)
+            self._count_tier("short_circuit", short)
+            self._count_tier("escalate", len(esc))
+            if short and self._m_avoided is not None:
+                avoided = short * self.estimate_query_cost(
+                    exclude_bin=best)
+                if avoided > 0:
+                    self._m_avoided.inc(avoided, service=self.service,
+                                        source="tier")
+            if esc:
+                other = {b: ms for b, ms in groups.items() if b != best}
+                esc_encoded = [encoded[i] for i in esc]
+                plan2 = self._plan_for(len(esc), other, rr, lat)
+                batch2 = self._scatter(plan2, esc_encoded, trace_ctxs)
+                self._gather_shards(batch2, plan2, other, esc_encoded,
+                                    trace_ctxs)
+                rows2, weights2, _ = self._collect_rows(len(esc), plan2)
+                ordered2 = sorted(rows2.items())
+                for j, i in enumerate(esc):
+                    votes: List[Any] = []
+                    wts: List[int] = []
+                    if best_row is not None and \
+                            best_row[i] is not _HOLE:
+                        votes.append(best_row[i])
+                        wts.append(best_w)
+                    for b, row in ordered2:
+                        if row[j] is not _HOLE:
+                            votes.append(row[j])
+                            wts.append(weights2.get(b, 1))
+                    results[i] = ensemble_predictions(votes, weights=wts)
+            if trace_ctxs:
+                from ..observe import trace as _obs_trace
+
+                _obs_trace.record_event(
+                    "predictor.tier", self.service, trace_ctxs, wall,
+                    time.monotonic() - t0,
+                    attrs={"short_circuit": short,
+                           "escalated": len(esc),
+                           "best_bin": str(best)[:12]})
+            return results
 
         return finish
 
@@ -588,15 +834,17 @@ class Predictor:
         self.cache.reap_reply_queue(
             batch_id, defer=bool(unmatched or resubmitted))
 
-    def _reassemble(self, n: int, plan: List[_Shard],
-                    ) -> List[Optional[Any]]:
-        """Stitch matched shard replies back into per-bin prediction
-        rows (request order), then ensemble across bins per query. A
-        query whose bin shard never replied simply loses that bin's
-        vote — the surviving bins still ensemble; a query with no votes
-        at all comes back None (the pre-shard no-reply behavior)."""
-        _HOLE = object()
+    def _collect_rows(self, n: int, plan: List[_Shard],
+                      ) -> Tuple[Dict[str, List[Any]],
+                                 Dict[str, int],
+                                 Dict[str, List[Optional[float]]]]:
+        """Stitch matched shard replies into per-bin prediction rows in
+        request order (``_HOLE`` marks positions whose shard never
+        replied), plus per-bin weights and per-position confidences
+        (None where the reply carried none — pre-r12 workers and
+        models without probabilities)."""
         rows: Dict[str, List[Any]] = {}
+        confs: Dict[str, List[Optional[float]]] = {}
         bin_weight: Dict[str, int] = {}
         for s in plan:
             if s.reply is None:
@@ -604,12 +852,27 @@ class Predictor:
             row = rows.get(s.bin)
             if row is None:
                 row = rows[s.bin] = [_HOLE] * n
+                confs[s.bin] = [None] * n
+            crow = confs[s.bin]
             preds = s.reply.get("predictions") or []
+            rconf = s.reply.get("confidence") or []
             for j in range(min(s.count, len(preds))):
                 if row[s.start + j] is _HOLE:
                     row[s.start + j] = preds[j]
+                    if j < len(rconf) and \
+                            isinstance(rconf[j], (int, float)):
+                        crow[s.start + j] = float(rconf[j])
             bin_weight[s.bin] = max(bin_weight.get(s.bin, 1),
                                     int(s.reply.get("weight", 1)))
+        return rows, bin_weight, confs
+
+    def _reassemble(self, n: int, plan: List[_Shard],
+                    ) -> List[Optional[Any]]:
+        """Ensemble across bins per query. A query whose bin shard
+        never replied simply loses that bin's vote — the surviving bins
+        still ensemble; a query with no votes at all comes back None
+        (the pre-shard no-reply behavior)."""
+        rows, bin_weight, _ = self._collect_rows(n, plan)
         results: List[Optional[Any]] = []
         ordered = sorted(rows.items())
         for i in range(n):

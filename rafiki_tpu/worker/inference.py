@@ -26,6 +26,34 @@ from ..utils.model_loader import load_model_class
 _log = logging.getLogger(__name__)
 
 
+def prediction_confidence(pred: Any) -> Optional[float]:
+    """Per-query confidence for the tiered serving path: the softmax
+    margin (top-1 minus top-2 probability) when the prediction exposes
+    a flat numeric vector, else None — sk-style label outputs, packed
+    ``__members__`` envelopes, and error dicts all degrade gracefully
+    to "no confidence" (the Predictor escalates those)."""
+    import numpy as np
+
+    try:
+        if isinstance(pred, np.ndarray):
+            arr = pred
+        elif isinstance(pred, (list, tuple)) and len(pred) >= 2 and \
+                not isinstance(pred[0], (list, tuple, dict, str)):
+            arr = np.asarray(pred)
+        else:
+            return None
+        if arr.ndim != 1 or arr.size < 2 or \
+                not np.issubdtype(arr.dtype, np.number):
+            return None
+        arr = arr.astype(np.float64, copy=False)
+        if not np.isfinite(arr).all():
+            return None
+        top2 = np.partition(arr, arr.size - 2)[-2:]
+        return float(top2[1] - top2[0])
+    except (TypeError, ValueError):
+        return None
+
+
 def _sync_probe_fn():
     """One process-wide jitted probe (a fresh lambda per call would
     re-compile inside every worker's startup)."""
@@ -168,8 +196,18 @@ class InferenceWorker:
         # in-memory state forgot every registration) re-learns this
         # worker without anyone noticing — the Predictor's next
         # registry scan finds it again within one interval.
+        # NodeConfig.worker_reregister (promoted from env-only in r12);
+        # env stays the transport so spawned children inherit it.
         self.reregister_interval = float(os.environ.get(
             "RAFIKI_TPU_WORKER_REREGISTER", "5.0"))
+        # Per-query confidence only matters to a tiering Predictor:
+        # with RAFIKI_TPU_SERVING_TIER_THRESHOLD unset/0 (the default)
+        # the serving burst path pays one attribute check, not a numpy
+        # margin per prediction (the r11 disabled-means-free
+        # discipline). A tier-on predictor against a tier-off worker
+        # degrades gracefully: no confidence ⇒ every query escalates.
+        self.send_confidence = float(os.environ.get(
+            "RAFIKI_TPU_SERVING_TIER_THRESHOLD", "0") or 0) > 0
         # Broker-REPORTED op failures (BusOpError) this many times in a
         # row — with zero successful iterations in between — mean
         # protocol skew, not an outage: the serve loop escalates to
@@ -180,6 +218,7 @@ class InferenceWorker:
         self.stop_flag = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._model: Optional[Any] = None
+        self._bin_score: Optional[float] = None  # set by _load_model
         # None when the fault plane is disabled (construction-time):
         # the dispatch path then pays one attribute check per burst.
         self._fault = faults.site_hook("worker")
@@ -208,10 +247,13 @@ class InferenceWorker:
         comma-joined list when the scheduler packed an ensemble onto one
         chip group (see ServicesManager.create_inference_services)."""
         models = []
+        scores = []
         for tid in str(self.trial_id).split(","):
             trial = self.meta.get_trial(tid)
             if trial is None:
                 raise ValueError(f"unknown trial {tid}")
+            if isinstance(trial.get("score"), (int, float)):
+                scores.append(float(trial["score"]))
             model_row = self.meta.get_model(trial["model_id"])
             model_class = load_model_class(model_row["model_class"],
                                            model_row.get("model_source"))
@@ -219,6 +261,10 @@ class InferenceWorker:
                 **model_class.validate_knobs(trial["knobs"]))
             model.load_parameters(self.params.load(trial["params_id"]))
             models.append(model)
+        # The bin's tracked eval score (max over packed members) rides
+        # the bus registration so the Predictor's tiered path can rank
+        # bins without a meta-store dependency.
+        self._bin_score = max(scores) if scores else None
         if len(models) == 1:
             return models[0]
         return _PackedEnsemble(models)
@@ -257,7 +303,8 @@ class InferenceWorker:
             # logged but unrecoverable from the bench artifact).
             self._reg_info = {"trial_id": self.trial_id,
                               "pipeline": bool(self.pipeline),
-                              "sync_latency_ms": sync_ms}
+                              "sync_latency_ms": sync_ms,
+                              "score": self._bin_score}
             self.cache.register_worker(self.inference_job_id,
                                        self.service_id,
                                        info=self._reg_info)
@@ -420,15 +467,24 @@ class InferenceWorker:
         except Exception as e:
             _log.exception("predict failed on batch of %d", n)
             predictions = [{"error": f"{type(e).__name__}: {e}"}] * n
+        wall, mono = t0 if t0 else (_time.time(), _time.monotonic())
+        burst_s = _time.monotonic() - mono
         if trace_ctxs:
             # The span covers dispatch -> readback complete (with
             # pipelining on, that includes the deliberate overlap wait).
-            wall, mono = t0 if t0 else (_time.time(), _time.monotonic())
             trace.record_event(
                 "worker.predict", self.service_id, trace_ctxs, wall,
-                _time.monotonic() - mono,
+                burst_s,
                 attrs={"n_queries": n, "trial_id": str(self.trial_id)})
         weight = int(getattr(self._model, "last_weight", 1))
+        # Per-query confidence (softmax margin; None for sk-style
+        # outputs) rides batch replies for the Predictor's tiered
+        # escalation — computed ONLY when tiering is on (see
+        # send_confidence); compute_s is this burst's device time
+        # prorated over the slice, feeding the chip-seconds-avoided
+        # estimate.
+        confidence = ([prediction_confidence(p) for p in predictions]
+                      if self.send_confidence else None)
         for it, start, count, is_batch in spans:
             if is_batch:
                 # Echo the shard id of a sharded super-batch slice so
@@ -440,7 +496,10 @@ class InferenceWorker:
                 self.cache.send_prediction_batch(
                     it["batch_id"], self.service_id,
                     predictions[start:start + count], weight=weight,
-                    shard=it.get("shard"))
+                    shard=it.get("shard"),
+                    confidence=(confidence[start:start + count]
+                                if confidence is not None else None),
+                    compute_s=round(burst_s * count / max(n, 1), 6))
             else:
                 self.cache.send_prediction(it["query_id"], self.service_id,
                                            predictions[start],

@@ -104,6 +104,55 @@ def test_dataset_cache_disabled_and_oversized(tmp_path, monkeypatch):
     assert load_image_dataset(p) is not load_image_dataset(p)
 
 
+def _write_tab(tmp_path, name="t.csv", seed=0, n=16):
+    rng = np.random.default_rng(seed)
+    return mod_dataset.write_tabular_dataset(
+        rng.normal(size=(n, 3)).astype(np.float32),
+        rng.integers(0, 2, n), str(tmp_path / name))
+
+
+def test_tabular_cache_hit_and_counters(tmp_path):
+    """r12 carried item: the tabular loader rides the host dataset
+    cache — a repeat load is a hit (same resident read-only object),
+    counted in the trial dataset-cache family."""
+    from rafiki_tpu.model.dataset import load_tabular_dataset
+    from rafiki_tpu.observe import phases
+
+    p = _write_tab(tmp_path)
+    before = phases.cache_counts("dataset")
+    ds1 = load_tabular_dataset(p)
+    ds2 = load_tabular_dataset(p)
+    assert ds2 is ds1
+    after = phases.cache_counts("dataset")
+    assert after.get("miss", 0) - before.get("miss", 0) == 1
+    assert after.get("hit", 0) - before.get("hit", 0) == 1
+    # Shared object = read-only: in-place mutation must raise at ITS
+    # call site, not poison later trials.
+    with pytest.raises(ValueError):
+        ds1.features[0, 0] = 99.0
+
+
+def test_tabular_cache_keyed_by_label_col_and_rewrite(tmp_path):
+    from rafiki_tpu.model.dataset import load_tabular_dataset
+
+    rng = np.random.default_rng(3)
+    p = mod_dataset.write_tabular_dataset(
+        rng.normal(size=(8, 2)).astype(np.float32),
+        rng.integers(0, 2, 8), str(tmp_path / "t.csv"),
+        feature_names=["f0", "f1"], target_name="y")
+    ds_last = load_tabular_dataset(p)
+    ds_f0 = load_tabular_dataset(p, label_col="f0")
+    # Different target column = a different dataset, never a shared hit.
+    assert ds_f0 is not ds_last
+    assert ds_f0.target_name == "f0"
+    assert load_tabular_dataset(p) is ds_last
+    # A rewritten file invalidates (fingerprint changes).
+    _write_tab(tmp_path, "t.csv", seed=9, n=8)
+    st = os.stat(p)
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    assert load_tabular_dataset(p) is not ds_last
+
+
 def test_corpus_roundtrip(tmp_path):
     sents = [["the", "cat", "sat"], ["dogs", "run"]]
     tags = [["DET", "NOUN", "VERB"], ["NOUN", "VERB"]]

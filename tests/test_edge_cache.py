@@ -1,0 +1,591 @@
+"""Predictor edge cache + confidence-tiered serving (r12).
+
+Real components, no mocks: a MemoryBus, worker threads speaking the
+cache protocol, the actual PredictorService HTTP frontend, and — for
+the promotion contract — a full LocalPlatform. The invariants under
+test are the ones the ISSUE names: second-touch admission, in-flight
+coalescing, promotion invalidation (incl. the promote-mid-flight
+race), tier short-circuit/escalate/fallback semantics, and the
+disabled-mode zero-series discipline.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu.bus import MemoryBus
+from rafiki_tpu.cache import Cache, encode_payload
+from rafiki_tpu.observe import metrics as obs_metrics
+from rafiki_tpu.predictor import EdgeCache, Predictor, query_key
+from rafiki_tpu.predictor.app import PredictorService
+from rafiki_tpu.worker.inference import prediction_confidence
+
+CACHE_FAMILIES = ("rafiki_tpu_serving_cache_total",
+                  "rafiki_tpu_serving_cache_bytes",
+                  "rafiki_tpu_serving_tier_total",
+                  "rafiki_tpu_serving_chip_seconds_avoided_total")
+
+
+class ConfWorker:
+    """Worker stand-in replying a fixed probability vector per query,
+    with a controllable per-query confidence (None = a model that
+    exposes no probabilities) and a registration score (None = a
+    pre-score worker)."""
+
+    def __init__(self, bus, worker_id, job_id="job", trial_id="t1",
+                 vector=(0.8, 0.2), confidence=0.5, score=0.9,
+                 delay=0.0):
+        self.cache = Cache(bus)
+        self.worker_id = worker_id
+        self.vector = list(vector)
+        self.confidence = confidence
+        self.delay = delay
+        self.served_batches = 0
+        self.served_queries = 0
+        self.stop_flag = threading.Event()
+        info = {"trial_id": trial_id}
+        if score is not None:
+            info["score"] = score
+        self.cache.register_worker(job_id, worker_id, info=info)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self.stop_flag.is_set():
+            items = self.cache.pop_queries(self.worker_id, timeout=0.1)
+            for it in items:
+                if self.delay:
+                    time.sleep(self.delay)
+                n = len(it["queries"])
+                self.served_batches += 1
+                self.served_queries += n
+                self.cache.send_prediction_batch(
+                    it["batch_id"], self.worker_id,
+                    [list(self.vector) for _ in range(n)],
+                    shard=it.get("shard"),
+                    confidence=[self.confidence] * n,
+                    compute_s=0.004 * n)
+
+    def stop(self):
+        self.stop_flag.set()
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture()
+def bus():
+    return MemoryBus()
+
+
+def _service(bus, **kw):
+    svc = PredictorService("svc", "job", meta=None, bus=bus,
+                           host="127.0.0.1", **kw)
+    svc.predictor.worker_wait_timeout = 5.0
+    svc.predictor.gather_timeout = 5.0
+    if svc.batcher is not None:
+        svc.batcher.start()
+    svc._http.start()
+    return svc
+
+
+def _teardown(svc):
+    svc._http.stop()
+    if svc.batcher is not None:
+        svc.batcher.stop()
+    svc.stats.close()
+    svc.predictor.close()
+    if svc.edge_cache is not None:
+        svc.edge_cache.close()
+
+
+def _series_for(service):
+    """All cache/tier samples labeled with one frontend's service id."""
+    out = []
+    for name in CACHE_FAMILIES:
+        m = obs_metrics.registry().find(name)
+        if m is None:
+            continue
+        out.extend((name, labels) for labels, _ in m.samples()
+                   if labels.get("service") == service)
+    return out
+
+
+# --- EdgeCache unit semantics ----------------------------------------
+
+def test_query_key_is_content_addressed():
+    import numpy as np
+
+    a = encode_payload(np.arange(12, dtype=np.uint8).reshape(3, 4))
+    b = encode_payload(np.arange(12, dtype=np.uint8).reshape(3, 4))
+    c = encode_payload(np.zeros((3, 4), dtype=np.uint8))
+    assert query_key(a) == query_key(b)
+    assert query_key(a) != query_key(c)
+
+
+def test_second_touch_admission_and_hits():
+    c = EdgeCache(1 << 20, ttl_s=60, admit_after=2, service="u1")
+    try:
+        kind, _ = c.begin("k")
+        assert kind == "lead"
+        c.resolve("k", "v", c.epoch)  # first miss: NOT admitted
+        kind, _ = c.begin("k")
+        assert kind == "lead", "first-touch insert must not be cached"
+        c.resolve("k", "v", c.epoch)  # second miss: admitted
+        kind, value = c.begin("k")
+        assert (kind, value) == ("hit", "v")
+        ev = c.info()["events"]
+        assert ev["miss"] == 2 and ev["hit"] == 1
+    finally:
+        c.close()
+
+
+def test_first_touch_mode_and_ttl_expiry():
+    c = EdgeCache(1 << 20, ttl_s=0.15, admit_after=1, service="u2")
+    try:
+        assert c.begin("k")[0] == "lead"
+        c.resolve("k", "v", c.epoch)
+        assert c.begin("k")[0] == "hit"
+        time.sleep(0.2)
+        kind, _ = c.begin("k")
+        assert kind == "lead", "TTL-expired entry served stale"
+    finally:
+        c.close()
+
+
+def test_byte_budget_lru_eviction():
+    c = EdgeCache(220, ttl_s=60, admit_after=1, service="u3")
+    try:
+        for i in range(4):
+            key = f"k{i}"
+            assert c.begin(key)[0] == "lead"
+            c.resolve(key, "x" * 60, c.epoch)  # ~66 bytes JSON each
+        info = c.info()
+        assert info["bytes"] <= 220
+        assert info["events"]["evict"] >= 1
+        # Newest entries survived; the oldest was evicted.
+        assert c.begin("k3")[0] == "hit"
+        assert c.begin("k0")[0] == "lead"
+    finally:
+        c.close()
+
+
+def test_promote_midflight_race_unit():
+    """The ISSUE's race, at the cache contract level: a promotion
+    landing while a leader's scatter is in flight must (a) hand the
+    already-coalesced waiter the pre-promotion answer, (b) DROP the
+    leader's stale insert, so (c) the next request misses."""
+    c = EdgeCache(1 << 20, ttl_s=60, admit_after=1, service="u4")
+    try:
+        kind, lead = c.begin("k")
+        assert kind == "lead"
+        epoch0 = c.epoch
+        kind, flight = c.begin("k")
+        assert kind == "wait"  # coalesced waiter attached pre-promotion
+        got = {}
+        waiter = threading.Thread(
+            target=lambda: got.setdefault("v", flight.wait(5)))
+        waiter.start()
+        new_epoch = c.invalidate()  # the promotion lands mid-flight
+        assert new_epoch == epoch0 + 1
+        c.resolve("k", "old-ensemble", epoch0, flight=lead)
+        waiter.join(timeout=5)
+        assert got["v"] == "old-ensemble", \
+            "in-flight coalesced waiter must get the pre-promotion " \
+            "answer"
+        assert c.begin("k")[0] == "lead", \
+            "post-promotion request served a pre-promotion entry"
+        assert c.info()["events"]["invalidate"] == 1
+    finally:
+        c.close()
+
+
+def test_post_promotion_request_never_joins_stale_flight():
+    """Review finding (r12): after invalidate() a NEW request must not
+    coalesce onto a pre-promotion leader's still-running flight — it
+    becomes a fresh leader; the stale leader's late resolve completes
+    only ITS OWN waiters and neither inserts nor tears down the fresh
+    leader's slot."""
+    c = EdgeCache(1 << 20, ttl_s=60, admit_after=1, service="u7")
+    try:
+        kind, stale_lead = c.begin("k")
+        assert kind == "lead"
+        epoch0 = c.epoch
+        c.invalidate()  # the promotion completes; old scatter in flight
+        kind, fresh_lead = c.begin("k")
+        assert kind == "lead", \
+            "post-promotion request joined a pre-promotion flight"
+        assert fresh_lead is not stale_lead
+        # Stale leader returns late: must not displace the fresh slot.
+        c.resolve("k", "old-ensemble", epoch0, flight=stale_lead)
+        kind, w = c.begin("k")
+        assert kind == "wait" and w is fresh_lead, \
+            "stale resolve tore down the fresh leader's flight"
+        c.resolve("k", "new-ensemble", c.epoch, flight=fresh_lead)
+        assert c.begin("k") == ("hit", "new-ensemble")
+    finally:
+        c.close()
+
+
+def test_failed_none_answer_is_never_cached():
+    """Review finding (r12): a None ensemble answer (every shard timed
+    out / every vote errored) must not poison the key for the TTL."""
+    c = EdgeCache(1 << 20, ttl_s=60, admit_after=1, service="u8")
+    try:
+        kind, lead = c.begin("k")
+        assert kind == "lead"
+        c.resolve("k", None, c.epoch, flight=lead)  # transient outage
+        kind, lead = c.begin("k")
+        assert kind == "lead", "failure answer was served from cache"
+        c.resolve("k", [0.9, 0.1], c.epoch, flight=lead)
+        assert c.begin("k") == ("hit", [0.9, 0.1])
+    finally:
+        c.close()
+
+
+def test_vector_change_invalidates():
+    c = EdgeCache(1 << 20, ttl_s=60, admit_after=1, service="u5")
+    try:
+        c.note_vector(("t1", "t2"))
+        assert c.begin("k")[0] == "lead"
+        c.resolve("k", "v", c.epoch)
+        c.note_vector(("t1", "t2"))  # unchanged: no-op
+        assert c.begin("k")[0] == "hit"
+        c.note_vector(("t2", "t3"))  # promotion observed via registry
+        assert c.begin("k")[0] == "lead"
+    finally:
+        c.close()
+
+
+def test_leader_failure_propagates_to_waiters():
+    c = EdgeCache(1 << 20, ttl_s=60, admit_after=1, service="u6")
+    try:
+        assert c.begin("k")[0] == "lead"
+        kind, flight = c.begin("k")
+        assert kind == "wait"
+        c.fail("k", RuntimeError("scatter blew up"))
+        with pytest.raises(RuntimeError, match="scatter blew up"):
+            flight.wait(5)
+        # The key is retryable afterwards.
+        assert c.begin("k")[0] == "lead"
+    finally:
+        c.close()
+
+
+# --- Service-level cache behavior ------------------------------------
+
+def test_service_cache_serves_repeats_without_scatter(bus):
+    worker = ConfWorker(bus, "w1")
+    svc = _service(bus, cache_bytes=1 << 20, cache_admit_after=2)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    q = encode_payload([1.0, 2.0])
+    try:
+        for _ in range(2):  # two misses: second-touch admits
+            r = requests.post(url, json={"query": q}, timeout=30)
+            r.raise_for_status()
+        served_before = worker.served_queries
+        r = requests.post(url, json={"query": q}, timeout=30)
+        r.raise_for_status()
+        assert r.json()["prediction"] == [0.8, 0.2]
+        assert worker.served_queries == served_before, \
+            "cache hit still scattered to a worker"
+        ev = svc.edge_cache.info()["events"]
+        assert ev["hit"] == 1 and ev["miss"] == 2
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_service_cache_coalesces_concurrent_identical(bus):
+    worker = ConfWorker(bus, "w1", delay=0.3)
+    svc = _service(bus, cache_bytes=1 << 20, cache_admit_after=1)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    q = encode_payload([3.0, 4.0])
+    results, errors = [], []
+
+    def client():
+        try:
+            r = requests.post(url, json={"query": q}, timeout=30)
+            r.raise_for_status()
+            results.append(r.json()["prediction"])
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    try:
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert not errors, errors
+        assert results == [[0.8, 0.2]] * 6
+        # ONE scatter computed all six: leader missed, the rest
+        # coalesced onto its flight.
+        assert worker.served_queries == 1, \
+            f"coalescing failed: worker saw {worker.served_queries}"
+        ev = svc.edge_cache.info()["events"]
+        assert ev["miss"] == 1 and ev["coalesce"] == 5
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_mixed_request_partial_hits(bus):
+    """One request mixing cached and novel queries dispatches ONLY the
+    novel ones and reassembles results in request order."""
+    worker = ConfWorker(bus, "w1")
+    svc = _service(bus, cache_bytes=1 << 20, cache_admit_after=1)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    qa, qb = encode_payload([1.0]), encode_payload([2.0])
+    try:
+        requests.post(url, json={"query": qa}, timeout=30
+                      ).raise_for_status()
+        served_before = worker.served_queries
+        r = requests.post(url, json={"queries": [qa, qb, qa]},
+                          timeout=30)
+        r.raise_for_status()
+        assert r.json()["predictions"] == [[0.8, 0.2]] * 3
+        assert worker.served_queries == served_before + 1, \
+            "hit/duplicate queries were re-scattered"
+        ev = svc.edge_cache.info()["events"]
+        assert ev["hit"] >= 1
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_cache_invalidate_route_and_stats(bus):
+    worker = ConfWorker(bus, "w1")
+    svc = _service(bus, cache_bytes=1 << 20, cache_admit_after=1)
+    base = f"http://127.0.0.1:{svc.port}"
+    q = encode_payload([5.0])
+    try:
+        requests.post(f"{base}/predict", json={"query": q}, timeout=30
+                      ).raise_for_status()
+        r = requests.post(f"{base}/cache/invalidate", json={},
+                          timeout=30)
+        assert r.json() == {"enabled": True, "epoch": 1}
+        # Post-invalidation: the same query misses again.
+        requests.post(f"{base}/predict", json={"query": q}, timeout=30
+                      ).raise_for_status()
+        ev = svc.edge_cache.info()["events"]
+        assert ev["miss"] == 2 and ev.get("hit", 0) == 0
+        assert ev["invalidate"] == 1
+        stats = requests.get(f"{base}/stats", timeout=30).json()
+        assert stats["cache"]["epoch"] == 1
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_disabled_cache_and_tier_register_zero_series(bus,
+                                                      monkeypatch):
+    """The r11 discipline: with the cache and tier off (the defaults),
+    the serving path must register NO cache/tier series — one attribute
+    check, byte-identical metrics output."""
+    for field in ("SERVING_CACHE_BYTES", "SERVING_CACHE_TTL_S",
+                  "SERVING_CACHE_ADMIT_AFTER",
+                  "SERVING_TIER_THRESHOLD"):
+        monkeypatch.delenv(f"RAFIKI_TPU_{field}", raising=False)
+    worker = ConfWorker(bus, "w1")
+    svc = _service(bus)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    try:
+        assert svc.edge_cache is None
+        assert svc.predictor.tier_threshold is None
+        r = requests.post(url, json={"query": encode_payload([1.0])},
+                          timeout=30)
+        r.raise_for_status()
+        # The invalidate route answers honestly instead of 404ing
+        # (promotion against a cacheless frontend is a no-op).
+        r = requests.post(f"http://127.0.0.1:{svc.port}"
+                          f"/cache/invalidate", json={}, timeout=30)
+        assert r.json() == {"enabled": False}
+        assert _series_for(svc.stats.service) == []
+    finally:
+        _teardown(svc)
+        worker.stop()
+
+
+def test_cache_series_removed_on_stop(bus):
+    worker = ConfWorker(bus, "w1")
+    svc = _service(bus, cache_bytes=1 << 20, cache_admit_after=1,
+                   tier_threshold=0.3)
+    url = f"http://127.0.0.1:{svc.port}/predict"
+    try:
+        requests.post(url, json={"query": encode_payload([2.0])},
+                      timeout=30).raise_for_status()
+        assert _series_for(svc.stats.service)
+    finally:
+        _teardown(svc)
+        worker.stop()
+    assert _series_for(svc.stats.service) == [], \
+        "stop() leaked cache/tier series"
+
+
+# --- Confidence-tiered serving ---------------------------------------
+
+def _tiered_predictor(bus, threshold=0.3):
+    p = Predictor("job", bus, gather_timeout=5.0,
+                  worker_wait_timeout=5.0, tier_threshold=threshold)
+    return p
+
+
+def test_tier_short_circuits_confident_queries(bus):
+    a = ConfWorker(bus, "wa", trial_id="t-best", vector=(0.9, 0.1),
+                   confidence=0.8, score=0.9)
+    b = ConfWorker(bus, "wb", trial_id="t-other", vector=(0.4, 0.6),
+                   confidence=0.8, score=0.5)
+    p = _tiered_predictor(bus)
+    try:
+        out = p.predict([[1.0], [2.0]])
+        # Confident: answered by the best bin ALONE (its single vote).
+        assert out == [[0.9, 0.1], [0.9, 0.1]]
+        assert a.served_queries == 2
+        assert b.served_queries == 0, \
+            "confident queries still fanned out to the full ensemble"
+        mix = {labels["outcome"]: int(v) for labels, v
+               in p._m_tier.samples()
+               if labels.get("service") == p.service}
+        assert mix == {"short_circuit": 2}
+    finally:
+        p.close()
+        a.stop()
+        b.stop()
+
+
+def test_tier_escalates_low_confidence_to_full_vote(bus):
+    a = ConfWorker(bus, "wa", trial_id="t-best", vector=(0.6, 0.4),
+                   confidence=0.05, score=0.9)
+    b = ConfWorker(bus, "wb", trial_id="t-other", vector=(0.2, 0.8),
+                   confidence=0.9, score=0.5)
+    p = _tiered_predictor(bus, threshold=0.3)
+    try:
+        out = p.predict([[1.0]])
+        # Escalated: one vote per bin, mean of both vectors.
+        assert out == [[pytest.approx(0.4), pytest.approx(0.6)]]
+        assert a.served_queries == 1 and b.served_queries == 1
+        mix = {labels["outcome"]: int(v) for labels, v
+               in p._m_tier.samples()
+               if labels.get("service") == p.service}
+        assert mix == {"escalate": 1}
+    finally:
+        p.close()
+        a.stop()
+        b.stop()
+
+
+def test_tier_escalates_when_model_has_no_confidence(bus):
+    """A best-bin model that exposes no probabilities (sk-style) must
+    never short-circuit: None confidence always escalates."""
+    a = ConfWorker(bus, "wa", trial_id="t-best", vector=(0.9, 0.1),
+                   confidence=None, score=0.9)
+    b = ConfWorker(bus, "wb", trial_id="t-other", vector=(0.3, 0.7),
+                   confidence=0.9, score=0.5)
+    p = _tiered_predictor(bus)
+    try:
+        out = p.predict([[1.0]])
+        assert out == [[pytest.approx(0.6), pytest.approx(0.4)]]
+        assert b.served_queries == 1, "no-confidence reply " \
+            "short-circuited instead of escalating"
+    finally:
+        p.close()
+        a.stop()
+        b.stop()
+
+
+def test_tier_falls_back_to_full_scatter_without_scores(bus):
+    """A serving worker that predates score registration makes the
+    best bin unknowable: the batch fans out in full (outcome=full)."""
+    a = ConfWorker(bus, "wa", trial_id="t1", vector=(0.9, 0.1),
+                   confidence=0.8, score=None)
+    b = ConfWorker(bus, "wb", trial_id="t2", vector=(0.5, 0.5),
+                   confidence=0.8, score=0.5)
+    p = _tiered_predictor(bus)
+    try:
+        out = p.predict([[1.0]])
+        assert out == [[pytest.approx(0.7), pytest.approx(0.3)]]
+        assert a.served_queries == 1 and b.served_queries == 1
+        mix = {labels["outcome"]: int(v) for labels, v
+               in p._m_tier.samples()
+               if labels.get("service") == p.service}
+        assert mix == {"full": 1}
+    finally:
+        p.close()
+        a.stop()
+        b.stop()
+
+
+def test_tier_disabled_predictor_has_no_tier_metrics(bus):
+    a = ConfWorker(bus, "wa", trial_id="t1", score=0.9)
+    p = Predictor("job", bus, gather_timeout=5.0,
+                  worker_wait_timeout=5.0)
+    try:
+        assert p.tier_threshold is None
+        assert p._m_tier is None and p._m_avoided is None
+        assert p.predict([[1.0]]) == [[0.8, 0.2]]
+    finally:
+        p.close()
+        a.stop()
+
+
+def test_prediction_confidence_margins():
+    assert prediction_confidence([0.7, 0.2, 0.1]) == pytest.approx(0.5)
+    assert prediction_confidence([0.5, 0.5]) == pytest.approx(0.0)
+    assert prediction_confidence("label") is None
+    assert prediction_confidence({"error": "x"}) is None
+    assert prediction_confidence({"__members__": [1, 2]}) is None
+    assert prediction_confidence([0.9]) is None  # no runner-up
+    assert prediction_confidence([[0.1], [0.9]]) is None  # nested
+    assert prediction_confidence(None) is None
+
+
+def test_chip_seconds_avoided_accrues_from_cost_ewma(bus):
+    """Workers report compute_s; the predictor's per-bin EWMA prices
+    short-circuits (tier) and hits (cache)."""
+    a = ConfWorker(bus, "wa", trial_id="t-best", vector=(0.9, 0.1),
+                   confidence=0.8, score=0.9)
+    b = ConfWorker(bus, "wb", trial_id="t-other", vector=(0.4, 0.6),
+                   confidence=0.8, score=0.5)
+    p = _tiered_predictor(bus, threshold=0.9)  # forces escalation
+    try:
+        p.predict([[1.0]])  # escalates: both bins' cost EWMAs seeded
+        assert p.estimate_query_cost() == pytest.approx(0.008, rel=0.3)
+        p.tier_threshold = 0.3  # now confident queries short-circuit
+        p.predict([[2.0]])
+        avoided = {labels["source"]: v for labels, v
+                   in p._m_avoided.samples()
+                   if labels.get("service") == p.service}
+        # One short-circuit avoided the OTHER bin's ~4ms.
+        assert avoided["tier"] == pytest.approx(0.004, rel=0.3)
+    finally:
+        p.close()
+        a.stop()
+        b.stop()
+
+
+def test_cost_estimates_ignore_retired_bins_and_price_tiered_hits(bus):
+    """Review findings (r12): a promoted-away bin's cost EWMA must not
+    inflate the avoided counters, and with tiering ON a cache hit is
+    priced as the best bin alone (a miss would have short-circuited) —
+    under-report, never fabricate."""
+    a = ConfWorker(bus, "wa", trial_id="t-best", vector=(0.9, 0.1),
+                   confidence=0.8, score=0.9)
+    b = ConfWorker(bus, "wb", trial_id="t-other", vector=(0.4, 0.6),
+                   confidence=0.8, score=0.5)
+    p = _tiered_predictor(bus, threshold=0.9)  # escalates: seeds both
+    try:
+        p.predict([[1.0]])
+        # Full-ensemble cost = both live bins (~4ms each)...
+        assert p.estimate_query_cost() == pytest.approx(0.008, rel=0.3)
+        # ...but a HIT under tiering claims only the best bin's share.
+        assert p.estimate_hit_cost() == pytest.approx(0.004, rel=0.3)
+        # A retired bin (promotion churn) must price as nothing even
+        # before the hysteresis prune fires.
+        with p._state_lock:
+            p._bin_cost["t-retired"] = 5.0
+        assert p.estimate_query_cost() == pytest.approx(0.008, rel=0.3)
+        assert p.estimate_hit_cost() == pytest.approx(0.004, rel=0.3)
+    finally:
+        p.close()
+        a.stop()
+        b.stop()

@@ -1,0 +1,133 @@
+"""Online trial promotion into a serving ensemble (r12).
+
+The promotion contract, end to end on a real LocalPlatform: promote a
+trained trial into a RUNNING inference job's bin and (a) the new bin's
+worker is registered BEFORE the old one is torn down, (b) the
+predictor edge cache is invalidated synchronously — after promote()
+returns, no request may be answered from a pre-promotion cache entry.
+"""
+
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu.cache import Cache, encode_payload
+from rafiki_tpu.constants import (BudgetOption, ServiceType, TaskType,
+                                  UserType)
+from rafiki_tpu.model import load_image_dataset
+from rafiki_tpu.platform import LocalPlatform
+
+FF_CLASS = "rafiki_tpu.models.feedforward:JaxFeedForward"
+
+
+def _trained_job(platform, synth_image_data, n_trials=2,
+                 name="ff-promote"):
+    train_path, val_path = synth_image_data
+    dev = platform.admin.create_user(f"{name}@x.c", "pw",
+                                     UserType.MODEL_DEVELOPER)
+    model = platform.admin.create_model(
+        dev["id"], name, TaskType.IMAGE_CLASSIFICATION, FF_CLASS)
+    job = platform.admin.create_train_job(
+        dev["id"], name, TaskType.IMAGE_CLASSIFICATION, [model["id"]],
+        {BudgetOption.MODEL_TRIAL_COUNT: n_trials},
+        train_path, val_path)
+    assert platform.admin.wait_until_train_job_done(job["id"],
+                                                    timeout=600)
+    return dev, job
+
+
+def test_promote_swaps_bin_and_no_stale_cache_answers(
+        tmp_path, synth_image_data, monkeypatch):
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_CACHE_BYTES", str(8 << 20))
+    monkeypatch.setenv("RAFIKI_TPU_SERVING_CACHE_ADMIT_AFTER", "1")
+    platform = LocalPlatform(workdir=str(tmp_path / "plat"),
+                             supervise_interval=0)
+    try:
+        dev, job = _trained_job(platform, synth_image_data)
+        best = platform.admin.get_best_trials(job["id"], max_count=2)
+        assert len(best) == 2
+        served, other = best[0], best[1]
+        inf = platform.admin.create_inference_job(dev["id"], job["id"],
+                                                  max_models=1)
+        host = platform.admin.get_inference_job(
+            inf["id"])["predictor_host"]
+        pred_row = next(s for s in platform.meta.get_services()
+                        if s["service_type"] == ServiceType.PREDICT)
+        psvc = platform.container.get(pred_row["id"])
+        assert psvc.edge_cache is not None
+        cache = Cache(platform.bus)
+        deadline = time.time() + 120
+        while not cache.running_workers(inf["id"]) and \
+                time.time() < deadline:
+            time.sleep(0.2)
+        info = cache.running_worker_info(inf["id"])
+        assert {w["trial_id"] for w in info.values()} == {served["id"]}
+
+        _, val_path = synth_image_data
+        ds = load_image_dataset(val_path)
+        q = encode_payload(ds.images[0])
+        url = f"http://{host}/predict"
+
+        def predict():
+            r = requests.post(url, json={"query": q}, timeout=180)
+            assert r.status_code == 200, r.text
+            return r.json()["prediction"]
+
+        predict()  # miss: populates the cache (first-touch admission)
+        predict()  # hit: served from the edge cache
+        ev = psvc.edge_cache.info()["events"]
+        assert ev["hit"] == 1 and ev["miss"] == 1
+
+        res = platform.admin.promote_trial(inf["id"], other["id"],
+                                           replace_trial_id=served["id"])
+        assert res["promoted_trial_id"] == other["id"]
+        assert res["stopped_service_ids"], "old bin was not torn down"
+        # The swap happened on the bus too: one bin, the NEW trial.
+        info = cache.running_worker_info(inf["id"])
+        assert {w["trial_id"] for w in info.values()} == {other["id"]}
+        # Synchronous invalidation: the epoch bumped before promote
+        # returned, so the SAME query now misses — it can never be
+        # answered from the pre-promotion entry.
+        assert psvc.edge_cache.info()["epoch"] >= 1
+        predict()
+        ev = psvc.edge_cache.info()["events"]
+        assert ev["miss"] == 2, \
+            "post-promotion request was served a pre-promotion entry"
+        assert ev["hit"] == 1
+        assert ev["invalidate"] >= 1
+
+        # Promotion is validated: a trial can't be promoted twice, and
+        # the replaced trial is no longer a served bin.
+        with pytest.raises(ValueError, match="already served"):
+            platform.admin.promote_trial(inf["id"], other["id"])
+        with pytest.raises(ValueError, match="not a served bin"):
+            platform.admin.promote_trial(inf["id"], served["id"],
+                                         replace_trial_id="nope")
+        platform.admin.stop_inference_job(inf["id"])
+    finally:
+        platform.shutdown()
+
+
+def test_promote_validations_reject_foreign_and_incomplete(
+        tmp_path, synth_image_data):
+    platform = LocalPlatform(workdir=str(tmp_path / "plat"),
+                             supervise_interval=0)
+    try:
+        dev, job = _trained_job(platform, synth_image_data, n_trials=1,
+                                name="ff-promote-val")
+        inf = platform.admin.create_inference_job(dev["id"], job["id"],
+                                                  max_models=1)
+        with pytest.raises(ValueError, match="unknown trial"):
+            platform.admin.promote_trial(inf["id"], "no-such-trial")
+        # A trial from ANOTHER train job must be rejected even if
+        # completed: promotion is within one job's ensemble.
+        dev2, job2 = _trained_job(platform, synth_image_data,
+                                  n_trials=1, name="ff-promote-other")
+        foreign = platform.admin.get_best_trials(job2["id"],
+                                                 max_count=1)[0]
+        with pytest.raises(ValueError, match="does not belong"):
+            platform.admin.promote_trial(inf["id"], foreign["id"])
+        platform.admin.stop_inference_job(inf["id"])
+    finally:
+        platform.shutdown()

@@ -294,6 +294,11 @@ def test_dashboard_upload_and_log_elements(http_platform):
     assert "/stats" in text and "refreshInfStats" in text
     # the phase panel reads the admin's /trial_phases aggregation
     assert "/trial_phases" in text and "refreshTrialPhases" in text
+    # the paste-a-trace-id panel renders GET /trace/<id> (r12: the
+    # carried r7 item; cache/tier spans land in its timeline)
+    for el in ("trace-id", "trace-go", "trace-spans"):
+        assert f'id="{el}"' in text, f"missing dashboard element #{el}"
+    assert "/trace/" in text
 
 
 def test_oversized_upload_rejected_413(http_platform):
