@@ -189,6 +189,46 @@ def _adaptive_windows(window_fn, *, min_windows: int = 2,
     }
 
 
+def _closed_loop_window(url: str, body: dict, n_clients: int,
+                        duration: float, count_by: int = 1) -> float:
+    """One closed-loop measurement window: ``n_clients`` threads POST
+    ``body`` to ``url`` as fast as replies come back for ``duration``
+    seconds; returns the achieved rate (x ``count_by`` per reply).
+    The shared harness for serving A/Bs — per-window client code kept
+    drifting between configs (r13 review)."""
+    import threading
+
+    import requests
+
+    counts = [0] * n_clients
+    errors: list = []
+    stop = threading.Event()
+
+    def client(i: int) -> None:
+        session = requests.Session()
+        try:
+            while not stop.is_set():
+                r = session.post(url, json=body, timeout=300)
+                r.raise_for_status()
+                counts[i] += count_by
+        except Exception as e:  # surfaced to the caller below
+            errors.append(e)
+            stop.set()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(f"bench client failed: {errors[0]}")
+    return sum(counts) / (time.monotonic() - t0)
+
+
 def _host_busy_fraction(dt: float = 0.5) -> float:
     """Whole-host CPU busy fraction over a short sample (/proc/stat)."""
     def snap():
@@ -206,13 +246,24 @@ def _host_busy_fraction(dt: float = 0.5) -> float:
 
 
 def _idle_gate(cooldown: float = 3.0, busy_max: float = 0.5,
-               max_wait: float = 45.0) -> float:
+               max_wait: float = None) -> float:
     """Cooldown + idle gate between sweep configs: let the previous
     config's teardown (worker threads, HTTP servers, tempdir sweeps)
     drain before the next window opens. Returns the busy fraction at
-    release, recorded as ``host_busy_at_start``."""
+    release, recorded as ``host_busy_at_start``.
+
+    ``RAFIKI_TPU_BENCH_IDLE_MAX_WAIT`` caps the busy-wait (bench-only
+    knob, like RAFIKI_TPU_BENCH_CONFIGS): the tier-1 sweep-contract
+    test runs on a deliberately busy box where waiting out the full
+    gate is pure test-budget burn."""
     import gc
 
+    if max_wait is None:
+        try:
+            max_wait = float(os.environ.get(
+                "RAFIKI_TPU_BENCH_IDLE_MAX_WAIT", 45.0))
+        except ValueError:
+            max_wait = 45.0
     gc.collect()
     time.sleep(cooldown)
     t0 = time.time()
@@ -458,7 +509,8 @@ def main_serving() -> dict:
             platform.admin.stop_inference_job(inf["id"])
         finally:
             platform.shutdown()
-    return _emit("ensemble_inference_qps", qps, "queries/s", **fields)
+    return _emit("ensemble_inference_qps", qps, "queries/s",
+                 **_serving_wire_fields(), **fields)
 
 
 def main_serving_openloop() -> dict:
@@ -594,6 +646,7 @@ def main_serving_openloop() -> dict:
     value = best_a  # headline = the auto (production-default) mode
     return _emit(
         "serving_openloop_qps", value, "queries/s",
+        **_serving_wire_fields(),
         # n_windows/spread describe the series behind the headline (the
         # auto job), matching _adaptive_windows' semantics elsewhere;
         # the forced series is fully visible in windows_forced.
@@ -613,6 +666,187 @@ def main_serving_openloop() -> dict:
 #: None = the default uniform-traffic matrix; "zipf[:s[:keys]]" = the
 #: edge-cache + tier A/B under zipf-keyed traffic.
 _WORKLOAD = None
+
+#: --quant override for serving-concurrent (set by _main_cli): "int8"
+#: runs the quantized-serving A/B + the accuracy-delta gate instead of
+#: the uniform matrix; _main_cli exits non-zero when the gate fails, so
+#: the invocation doubles as a CI regression gate.
+_QUANT = None
+_QUANT_TOL = 0.02
+
+
+def _serving_wire_fields() -> dict:
+    """``wire_format``/``quant`` on every serving record: which wire
+    and dtype mode the measured stack actually ran (r4 verdict
+    discipline — a mode must be recoverable from the artifact)."""
+    from rafiki_tpu.observe import wire as _ow
+
+    return {"wire_format": _ow.packed_wire_mode(),
+            "quant": _ow.quant_mode() or None}
+
+
+def _serving_quant_ab(mode: str) -> dict:
+    """``--quant int8`` — the quantized-ensemble serving A/B plus the
+    ACCURACY-DELTA GATE (ISSUE r13).
+
+    Gate first, stack second: one JaxFeedForward is trained directly
+    and its predict-path accuracy on the SAME eval split is measured
+    f32 vs int8 — ``|Δaccuracy| <= tolerance`` or the record says
+    ``accuracy_gate: "fail"`` and ``_main_cli`` exits non-zero (a
+    quantized mode that silently degrades accuracy must fail the
+    bench, not ship a throughput number). Then one platform trains a
+    1-trial job and serves it twice — job G with
+    ``RAFIKI_TPU_SERVING_QUANT=int8``, job H without — interleaved
+    closed-loop windows per round; the
+    ``rafiki_tpu_serving_quant_total`` delta proves the quantized path
+    actually served the measured queries (counter evidence per r9
+    discipline; the throughput ratio on this box is noise-dominated
+    and recorded with windows+spread)."""
+    import tempfile
+
+    import requests
+
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.config import NodeConfig
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.models.feedforward import JaxFeedForward
+    from rafiki_tpu.observe.metrics import parse_exposition
+    from rafiki_tpu.platform import LocalPlatform
+
+    n_clients, window_s = 8, 8.0
+    quant_env = NodeConfig.env_name("serving_quant")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256)
+
+        # --- Accuracy-delta gate (model-level; the serving stack adds
+        # nothing to judging the quantizer itself) ---
+        model = JaxFeedForward(hidden_layer_count=2,
+                               hidden_layer_units=64,
+                               learning_rate=3e-3, batch_size=64,
+                               max_epochs=3)
+        model.train(train_path)
+        val = load_image_dataset(val_path)
+
+        def accuracy() -> float:
+            probs = model.predict_proba(val.images)
+            return float((probs.argmax(-1) == val.labels).mean())
+
+        acc_f32 = accuracy()
+        report = model.enable_serving_quant(mode)
+        acc_q = accuracy()
+        model.enable_serving_quant("")
+        delta = abs(acc_f32 - acc_q)
+        gate = "pass" if delta <= _QUANT_TOL else "fail"
+
+        # --- Serving A/B: same stack, quant on (G) vs off (H) ---
+        os.environ.pop(quant_env, None)
+        share_env = "RAFIKI_TPU_MAX_CHIP_SHARE"
+        prior_share = os.environ.get(share_env)
+        os.environ.setdefault(share_env, "8")
+        platform = LocalPlatform(workdir=f"{tmp}/plat")
+        try:
+            admin = platform.admin
+            cache = Cache(platform.bus)
+            user = admin.create_user("cc@x.c", "pw",
+                                     UserType.MODEL_DEVELOPER)
+            mrow = admin.create_model(
+                user["id"], "ff-cc", TaskType.IMAGE_CLASSIFICATION,
+                "rafiki_tpu.models.feedforward:JaxFeedForward")
+            job = admin.create_train_job(
+                user["id"], "cc", TaskType.IMAGE_CLASSIFICATION,
+                [mrow["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 1},
+                train_path, val_path)
+            assert admin.wait_until_train_job_done(job["id"],
+                                                   timeout=1200)
+            val_ds = load_image_dataset(val_path)
+            batch = [encode_payload(val_ds.images[i % val_ds.size])
+                     for i in range(4)]
+
+            def start_job(want_quant):
+                inf = admin.create_inference_job(user["id"], job["id"],
+                                                 max_models=1)
+                deadline = time.time() + 600
+                while not cache.running_workers(inf["id"]) \
+                        and time.time() < deadline:
+                    time.sleep(0.5)
+                info = cache.running_worker_info(inf["id"])
+                assert info, "no workers registered"
+                served_quant = {i.get("quant") for i in info.values()}
+                assert served_quant == ({mode} if want_quant
+                                        else {None}), served_quant
+                host = admin.get_inference_job(inf["id"])[
+                    "predictor_host"]
+                r = requests.post(f"http://{host}/predict",
+                                  json={"queries": batch}, timeout=300)
+                r.raise_for_status()
+                return inf["id"], host
+
+            os.environ[quant_env] = mode
+            try:
+                inf_g, host_g = start_job(True)
+            finally:
+                os.environ.pop(quant_env, None)
+            inf_h, host_h = start_job(False)
+
+            def one_window(url):
+                return _closed_loop_window(
+                    url, {"queries": batch}, n_clients, window_s,
+                    count_by=len(batch))
+
+            def quant_served(host):
+                m = parse_exposition(requests.get(
+                    f"http://{host}/metrics", timeout=30).text)
+                return sum(v for labels, v in m.get(
+                    "rafiki_tpu_serving_quant_total", [])
+                    if labels.get("mode") == mode)
+
+            url_g = f"http://{host_g}/predict"
+            url_h = f"http://{host_h}/predict"
+            one_window(url_g)  # warm (untimed): XLA quant variants
+            one_window(url_h)
+            served0 = quant_served(host_g)
+            vals_g: list = []
+            vals_h: list = []
+            for _ in range(3):
+                vals_g.append(one_window(url_g))
+                vals_h.append(one_window(url_h))
+                if _settled(vals_g) and _settled(vals_h):
+                    break
+            served = quant_served(host_g) - served0
+            assert served > 0, "quant counter did not move"
+            for inf in (inf_g, inf_h):
+                admin.stop_inference_job(inf)
+        finally:
+            platform.shutdown()
+            if prior_share is None:
+                os.environ.pop(share_env, None)
+            else:
+                os.environ[share_env] = prior_share
+
+    best_g, best_h = max(vals_g), max(vals_h)
+    return _emit(
+        "serving_concurrent_qps", best_g, "queries/s",
+        **{**_serving_wire_fields(), "quant": mode},
+        n_clients=n_clients,
+        n_windows=len(vals_g),
+        spread=round((best_g - min(vals_g)) / best_g, 3),
+        spread_off=round((best_h - min(vals_h)) / best_h, 3),
+        windows_quant_on=[round(v, 2) for v in vals_g],
+        windows_quant_off=[round(v, 2) for v in vals_h],
+        qps_quant_on=round(best_g, 2),
+        qps_quant_off=round(best_h, 2),
+        quant_speedup=round(best_g / best_h, 3),
+        quant_queries_served=int(served),
+        quant_layers_int8=report.get("n_int8"),
+        quant_layers_f32=report.get("n_f32"),
+        accuracy_f32=round(acc_f32, 4),
+        accuracy_int8=round(acc_q, 4),
+        accuracy_delta=round(delta, 4),
+        accuracy_tolerance=_QUANT_TOL,
+        accuracy_gate=gate)
 
 
 def _serving_zipf_ab(workload: str) -> dict:
@@ -857,6 +1091,7 @@ def _serving_zipf_ab(workload: str) -> dict:
     best_e, best_f = max(vals_e), max(vals_f)
     return _emit(
         "serving_concurrent_qps", best_e, "queries/s",
+        **_serving_wire_fields(),
         workload=f"zipf:{zipf_s}:{n_keys}",
         n_clients=n_clients,
         n_windows=len(vals_e),
@@ -931,6 +1166,8 @@ def main_serving_concurrent() -> dict:
                                             parse_exposition)
     from rafiki_tpu.platform import LocalPlatform
 
+    if _QUANT:
+        return _serving_quant_ab(_QUANT)
     if _WORKLOAD and _WORKLOAD.startswith("zipf"):
         return _serving_zipf_ab(_WORKLOAD)
 
@@ -1198,6 +1435,136 @@ def main_serving_concurrent() -> dict:
             stages_a = stage_latency(host_a, stats_a)
             for inf in (inf_a, inf_b, inf_c, inf_d):
                 admin.stop_inference_job(inf)
+
+            # --- Packed-wire A/B (r13): fresh single-replica jobs
+            # AFTER the matrix released its chips. Side P = the packed
+            # default; side Q deployed under "compat" (legacy per-query
+            # frames, wire accounting kept) for BOTH its predictor and
+            # worker — the measured legacy side. The judged evidence on
+            # this box is the COUNTER deltas (wire bytes + host
+            # copies), attributed per serial window; the qps ratio is
+            # noise-dominated here and rides along with windows+spread.
+            from rafiki_tpu.cache import WIRE_NDBATCH
+
+            packed_env = NodeConfig.env_name("serving_packed_wire")
+            prior_packed = os.environ.get(packed_env)
+            inf_p, host_p = start_job(admin, cache, user["id"],
+                                      job["id"], batch)
+            os.environ[packed_env] = "compat"
+            try:
+                inf_q, host_q = start_job(admin, cache, user["id"],
+                                          job["id"], batch)
+            finally:
+                if prior_packed is None:
+                    os.environ.pop(packed_env, None)
+                else:
+                    os.environ[packed_env] = prior_packed
+            # The negotiation must have taken, or the A/B is fiction.
+            info_p = cache.running_worker_info(inf_p)
+            info_q = cache.running_worker_info(inf_q)
+            assert all(WIRE_NDBATCH in (i.get("wire") or ())
+                       for i in info_p.values()), info_p
+            assert all(not (i.get("wire") or [])
+                       for i in info_q.values()), info_q
+
+            def wire_counters():
+                m = parse_exposition(requests.get(
+                    f"http://{host_p}/metrics", timeout=30).text)
+                b = {(la.get("format"), la.get("direction")): v
+                     for la, v in m.get(
+                         "rafiki_tpu_serving_wire_bytes_total", [])}
+                c = {la.get("site"): v for la, v in m.get(
+                    "rafiki_tpu_serving_host_copies_total", [])}
+                return b, c
+
+            def packed_window(url, host):
+                """One measured window with counter deltas attributed
+                to it (windows are serial, so the global wire counters
+                move only for the side being driven)."""
+                b0, c0 = wire_counters()
+                q0 = requests.get(f"http://{host}/stats",
+                                  timeout=30).json()["queries"]
+                qps = one_window(url, batch)
+                b1, c1 = wire_counters()
+                q1 = requests.get(f"http://{host}/stats",
+                                  timeout=30).json()["queries"]
+                db = {k: b1.get(k, 0) - b0.get(k, 0) for k in b1}
+                dc = {k: c1.get(k, 0) - c0.get(k, 0) for k in c1}
+                return qps, db, dc, q1 - q0
+
+            url_p = f"http://{host_p}/predict"
+            url_q = f"http://{host_q}/predict"
+            one_window(url_p, batch, duration=4.0)  # warm (untimed)
+            one_window(url_q, batch, duration=4.0)
+            vals_p: list = []
+            vals_q: list = []
+            agg = {"p": [{}, {}, 0], "q": [{}, {}, 0]}
+
+            def fold(side, db, dc, nq):
+                for k, v in db.items():
+                    agg[side][0][k] = agg[side][0].get(k, 0) + v
+                for k, v in dc.items():
+                    agg[side][1][k] = agg[side][1].get(k, 0) + v
+                agg[side][2] += nq
+
+            for _ in range(3):
+                qps, db, dc, nq = packed_window(url_p, host_p)
+                vals_p.append(qps)
+                fold("p", db, dc, nq)
+                qps, db, dc, nq = packed_window(url_q, host_q)
+                vals_q.append(qps)
+                fold("q", db, dc, nq)
+                if _settled(vals_p) and _settled(vals_q):
+                    break
+
+            def side_fields(side):
+                db, dc, nq = agg[side]
+                scatter = {f: v for (f, d), v in db.items()
+                           if d == "scatter"}
+                return {
+                    "queries": int(nq),
+                    "wire_bytes_scatter": {f: int(v) for f, v
+                                           in scatter.items() if v},
+                    "wire_bytes_per_query": round(
+                        sum(scatter.values()) / nq, 1) if nq else None,
+                    "host_copies": {k: int(v) for k, v in dc.items()
+                                    if v},
+                }
+
+            side_p, side_q = side_fields("p"), side_fields("q")
+            # The acceptance contract, asserted so the config doubles
+            # as a regression gate: the packed side does NO stack/pad
+            # copies and ships strictly fewer scatter bytes/query. The
+            # byte margin scales with 1/tensor-size — ~3-4% on these
+            # 784-byte images (framing overhead amortized), 25%+ on
+            # small feature vectors (pinned by the codec unit gate in
+            # tests/test_wire_codec.py) — so the bench gate is
+            # monotone and the measured ratio rides the record.
+            assert side_p["host_copies"].get("stack", 0) == 0, side_p
+            assert side_p["host_copies"].get("pad", 0) == 0, side_p
+            assert side_q["host_copies"].get("stack", 0) > 0, side_q
+            assert side_p["wire_bytes_scatter"].get("packed", 0) > 0, \
+                side_p
+            assert side_p["wire_bytes_per_query"] < \
+                side_q["wire_bytes_per_query"], (side_p, side_q)
+            packed_ab = {
+                "wire_bytes_ratio": round(
+                    side_p["wire_bytes_per_query"]
+                    / side_q["wire_bytes_per_query"], 3),
+                "packed": {**side_p, "windows": [round(v, 2)
+                                                 for v in vals_p],
+                           "qps_best": round(max(vals_p), 2),
+                           "spread": round((max(vals_p) - min(vals_p))
+                                           / max(vals_p), 3)},
+                "perquery": {**side_q, "windows": [round(v, 2)
+                                                   for v in vals_q],
+                             "qps_best": round(max(vals_q), 2),
+                             "spread": round((max(vals_q) - min(vals_q))
+                                             / max(vals_q), 3)},
+                "qps_ratio": round(max(vals_p) / max(vals_q), 3),
+            }
+            for inf in (inf_p, inf_q):
+                admin.stop_inference_job(inf)
         finally:
             platform.shutdown()
             if prior_share is None:
@@ -1209,6 +1576,8 @@ def main_serving_concurrent() -> dict:
     best_a_big, best_c_big = max(vals_a_big), max(vals_c_big)
     return _emit(
         "serving_concurrent_qps", best_a, "queries/s",
+        **_serving_wire_fields(),
+        packed_ab=packed_ab,
         n_windows=len(vals_a),
         spread=round((best_a - min(vals_a)) / best_a, 3),
         windows_microbatch=[round(v, 2) for v in vals_a],
@@ -1799,6 +2168,8 @@ def _main_cli() -> None:
     import argparse
     import os
 
+    global _QUANT, _QUANT_TOL, _WORKLOAD
+
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", default=None, choices=sorted(_CONFIGS) + ["sweep"],
@@ -1810,7 +2181,26 @@ def _main_cli() -> None:
              "matrix; 'zipf[:<s>[:<keys>]]' (e.g. zipf:1.1:64) = the "
              "edge-cache + tiered-serving A/B under zipf-keyed "
              "single-query traffic.")
+    parser.add_argument(
+        "--quant", default=None, choices=["int8"],
+        help="serving-concurrent quantized-ensemble A/B + accuracy-"
+             "delta gate (f32 vs int8 on the same eval split). The "
+             "process exits NON-ZERO when the gate fails, so this "
+             "invocation doubles as a CI regression gate.")
+    parser.add_argument(
+        "--quant-tol", type=float, default=_QUANT_TOL,
+        help="accuracy-delta tolerance for --quant (|acc_f32 - "
+             "acc_int8| must not exceed it; default %(default)s).")
     args = parser.parse_args()
+    if args.quant is not None:
+        if args.config != "serving-concurrent":
+            parser.error("--quant only applies to "
+                         "--config serving-concurrent")
+        if args.workload is not None:
+            parser.error("--quant and --workload are separate "
+                         "experiments; pick one")
+        _QUANT = args.quant
+        _QUANT_TOL = args.quant_tol
     if args.workload is not None:
         if not args.workload.startswith("zipf"):
             parser.error(f"unknown --workload {args.workload!r} "
@@ -1822,7 +2212,6 @@ def _main_cli() -> None:
             # sweep's serving baseline with a different experiment.
             parser.error("--workload only applies to "
                          "--config serving-concurrent")
-        global _WORKLOAD
         _WORKLOAD = args.workload
 
     # Resolve the platform BEFORE any backend touch. The site hook
@@ -1863,7 +2252,15 @@ def _main_cli() -> None:
         config = "sweep" if platform in BASELINE_PLATFORMS else "trials"
 
     if config != "sweep":
-        print(json.dumps(_run_config(config, platform)))
+        rec = _run_config(config, platform)
+        print(json.dumps(rec))
+        if _QUANT and rec.get("accuracy_gate") != "pass":
+            # The one JSON line is printed either way; the exit code is
+            # the gate (a --quant run that errored never proved the
+            # accuracy contract, so it fails too).
+            import sys
+
+            sys.exit(1)
         return
 
     # Full sweep: ONE line, headline = config 1 (trials/hour), every

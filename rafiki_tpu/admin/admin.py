@@ -13,8 +13,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..constants import (BudgetOption, InferenceJobStatus, ModelAccessRight,
-                         ServiceStatus, TrainJobStatus, TrialStatus,
-                         UserType)
+                         TrainJobStatus, TrialStatus, UserType)
 from ..model.knobs import knob_config_to_json
 from ..store import MetaStore, ParamStore
 from ..utils import auth
@@ -474,10 +473,6 @@ class Admin:
                               register_timeout: float,
                               claims: Optional[Dict[str, Any]],
                               ) -> Dict[str, Any]:
-        import time as _time
-
-        from ..cache import Cache as _BusCache
-
         job = self._owned_inference_job(inference_job_id, claims)
         if job["status"] != InferenceJobStatus.RUNNING:
             raise ValueError(
@@ -494,18 +489,7 @@ class Admin:
             raise ValueError(
                 f"trial {trial_id} does not belong to train job "
                 f"{job['train_job_id']}")
-        from .services_manager import _ACTIVE, PREDICTOR_TRIAL
-
-        # Mapping rows outlive their services (a replaced bin's row
-        # stays for history): only ACTIVE services define what is
-        # currently served.
-        rows = []
-        for w in self.meta.get_inference_job_workers(inference_job_id):
-            if w["trial_id"] == PREDICTOR_TRIAL:
-                continue
-            svc = self.meta.get_service(w["service_id"])
-            if svc is not None and svc["status"] in _ACTIVE:
-                rows.append(w)
+        rows = self.services.active_inference_workers(inference_job_id)
         served_bins = {w["trial_id"] for w in rows}
         if any(trial_id in str(b).split(",") for b in served_bins):
             raise ValueError(
@@ -526,48 +510,26 @@ class Admin:
                 raise ValueError(
                     f"trial {replace_trial_id} is not a served bin of "
                     f"this job")
-        new_svc = self.services.add_inference_worker(inference_job_id,
-                                                     trial_id)
-        if new_svc is None:
-            raise RuntimeError(
-                "no chips available for the promoted trial's worker")
-        # The new bin must be LIVE (registered on the bus — workers
-        # register only after their model load + warm-up) before the
-        # old one is torn down, or the swap would drop the bin's vote.
-        bus_cache = _BusCache(self.services.serving_bus())
-        deadline = _time.monotonic() + register_timeout
-        while new_svc["id"] not in \
-                bus_cache.running_workers(inference_job_id):
-            if _time.monotonic() >= deadline:
-                self.services._stop_service(new_svc["id"])
-                raise RuntimeError(
-                    f"promoted worker {new_svc['id'][:8]} did not "
-                    f"register within {register_timeout}s; promotion "
-                    f"rolled back")
-            svc_row = self.meta.get_service(new_svc["id"])
-            if svc_row and svc_row["status"] == ServiceStatus.ERRORED:
-                # A self-errored worker never reaches the supervise
-                # sweep (it scans RUNNING rows only): release its chips
-                # here or the allocation leaks until the job stops.
-                self.services._stop_service(new_svc["id"])
-                raise RuntimeError(
-                    f"promoted worker {new_svc['id'][:8]} errored "
-                    f"during startup")
-            # rta: disable=RTA102 deliberate: _promote_lock MUST span the registration wait — serializing whole promotions (validate->launch->wait->swap) is the TOCTOU fix; only rare control-plane promote calls contend
-            _time.sleep(0.2)
-        stopped = []
-        for w in old_rows:
-            self.services._stop_service(w["service_id"])
-            stopped.append(w["service_id"])
+        # Launch + wait-for-registration + teardown live in the
+        # ServicesManager now (swap_inference_worker, the public
+        # hot-swap seam): the new bin must be LIVE on the bus before
+        # the old one stops, or the swap would drop the bin's vote —
+        # and the incoming worker re-reads the serving env at load, so
+        # e.g. int8 quant scales are recomputed for the promoted bin.
+        swap = self.services.swap_inference_worker(
+            inference_job_id, trial_id,
+            replace_service_ids=[w["service_id"] for w in old_rows],
+            register_timeout=register_timeout)
         self._invalidate_predictor_cache(job)
         _log.info("promoted trial %s into inference job %s (replaced "
                   "%s; stopped %d worker(s))", trial_id,
-                  inference_job_id, replace_trial_id, len(stopped))
+                  inference_job_id, replace_trial_id,
+                  len(swap["stopped_service_ids"]))
         return {"inference_job_id": inference_job_id,
                 "promoted_trial_id": trial_id,
                 "replaced_trial_id": replace_trial_id,
-                "new_service_id": new_svc["id"],
-                "stopped_service_ids": stopped}
+                "new_service_id": swap["new_service"]["id"],
+                "stopped_service_ids": swap["stopped_service_ids"]}
 
     def _invalidate_predictor_cache(self, job: Dict[str, Any]) -> None:
         """Synchronous edge-cache invalidation on the job's predictor

@@ -465,6 +465,79 @@ class ServicesManager:
         return self._launch_inference_worker(svc_row, group,
                                              inference_job_id, trial_id)
 
+    def active_inference_workers(self, inference_job_id: str,
+                                 ) -> List[Dict[str, Any]]:
+        """The job's ACTIVE (non-predictor) worker mapping rows — what
+        is currently served. Mapping rows outlive their services (a
+        replaced bin's row stays for history), so liveness is judged by
+        each row's SERVICE status; a stale mapping must never read as
+        "this trial is served"."""
+        rows = []
+        for w in self.meta.get_inference_job_workers(inference_job_id):
+            if w["trial_id"] == PREDICTOR_TRIAL:
+                continue
+            svc = self.meta.get_service(w["service_id"])
+            if svc is not None and svc["status"] in _ACTIVE:
+                rows.append(w)
+        return rows
+
+    def swap_inference_worker(self, inference_job_id: str,
+                              trial_id: str,
+                              replace_service_ids: List[str] = (),
+                              register_timeout: float = 180.0,
+                              ) -> Dict[str, Any]:
+        """Hot-swap primitive behind trial promotion: launch a worker
+        for ``trial_id``, WAIT for its bus registration (workers
+        register only after model load + warm-up — the moment the
+        Predictor can plan shards onto the new bin), and only then stop
+        the ``replace_service_ids`` workers, so the swap never drops a
+        bin's vote. Public on purpose (carried r12 item): admin.py used
+        to hand-roll this against ``_stop_service``/``_ACTIVE``, which
+        meant every service-teardown change had to be mirrored there.
+
+        Rollback: a registration timeout or a self-ERRORED launch stops
+        the NEW service (releasing its chips — an errored worker never
+        reaches the supervise sweep, which scans RUNNING rows only) and
+        raises; the replaced workers are untouched. The incoming worker
+        re-reads the serving env at model load, so per-bin derived
+        state — ``RAFIKI_TPU_SERVING_QUANT`` int8 scales in particular
+        — is recomputed for the promoted trial by construction.
+
+        Callers serialize concurrent swaps themselves (the admin's
+        ``_promote_lock``): this method deliberately spans a
+        registration wait and holds no lock of its own.
+        """
+        import time as _time
+
+        from ..cache import Cache as _BusCache
+
+        new_svc = self.add_inference_worker(inference_job_id, trial_id)
+        if new_svc is None:
+            raise RuntimeError(
+                "no chips available for the incoming trial's worker")
+        bus_cache = _BusCache(self.serving_bus())
+        deadline = _time.monotonic() + register_timeout
+        while new_svc["id"] not in \
+                bus_cache.running_workers(inference_job_id):
+            if _time.monotonic() >= deadline:
+                self._stop_service(new_svc["id"])
+                raise RuntimeError(
+                    f"incoming worker {new_svc['id'][:8]} did not "
+                    f"register within {register_timeout}s; swap rolled "
+                    f"back")
+            svc_row = self.meta.get_service(new_svc["id"])
+            if svc_row and svc_row["status"] == ServiceStatus.ERRORED:
+                self._stop_service(new_svc["id"])
+                raise RuntimeError(
+                    f"incoming worker {new_svc['id'][:8]} errored "
+                    f"during startup")
+            _time.sleep(0.2)
+        stopped = []
+        for sid in replace_service_ids:
+            self._stop_service(sid)
+            stopped.append(sid)
+        return {"new_service": new_svc, "stopped_service_ids": stopped}
+
     def stop_inference_services(self, inference_job_id: str) -> None:
         for w in self.meta.get_inference_job_workers(inference_job_id):
             self._stop_service(w["service_id"])

@@ -21,6 +21,11 @@ Mechanics (module-scope, two-level dataflow — no execution):
 - **Cache-tainted values**: names assigned (possibly through tuple
   unpacking) from a call whose name mentions ``stage``/``cache``, or
   from a subscript/attribute of a ``*_CACHE`` global.
+- **Taint through helper returns** (r13): a module function whose
+  RETURN expression is cache-tainted taints its call sites by name —
+  a neutral-named wrapper (``def fetch_resident(): return
+  _STAGE_CACHE[k]``) poisons exactly like the direct read. Fixpoint
+  over the module's defs, so helper-calls-helper chains resolve.
 
 RTA401: a cache-tainted value is passed at a donated position.
 RTA402: a name passed at a donated position is read again later in
@@ -71,11 +76,16 @@ def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
 
 class _Scope:
     """One function body (or the module body): tainted names, donating
-    call sites, assignments — enough for the RTA401/402 judgments."""
+    call sites, assignments — enough for the RTA401/402 judgments.
+    ``tainted_fns`` are module helpers whose returns are cache-tainted
+    (see ``_return_tainted_fns``) — calls to them taint like direct
+    cache reads."""
 
-    def __init__(self, node, name: str):
+    def __init__(self, node, name: str,
+                 tainted_fns: frozenset = frozenset()):
         self.node = node
         self.name = name
+        self.tainted_fns = tainted_fns
         self.tainted: Set[str] = set()
         # name -> lines where the name is (re)bound
         self.binds: Dict[str, List[int]] = {}
@@ -128,28 +138,80 @@ class _Scope:
         if not isinstance(tgt, ast.Name):
             return
         self.binds.setdefault(tgt.id, []).append(tgt.lineno)
-        if _expr_tainted(value):
+        if _expr_tainted(value, self.tainted_fns):
             self.tainted.add(tgt.id)
         elif isinstance(value, ast.Name):
             self.aliases.setdefault(tgt.id, set()).add(value.id)
 
 
-def _expr_tainted(value: ast.AST) -> bool:
-    """Does this RHS pull from a staging/residency cache?"""
+def _expr_tainted(value: ast.AST,
+                  tainted_fns: frozenset = frozenset()) -> bool:
+    """Does this RHS pull from a staging/residency cache — directly,
+    or through a helper whose return is tainted (``tainted_fns``)?"""
     if isinstance(value, ast.Call):
         name = _last_name(value.func)
-        if _CACHE_CALL_RE.search(name):
+        if _CACHE_CALL_RE.search(name) or name in tainted_fns:
             return True
         # one level deep: _STAGE_CACHE.get(...)
         if isinstance(value.func, ast.Attribute):
-            return _expr_tainted(value.func.value)
+            return _expr_tainted(value.func.value, tainted_fns)
         return False
     if isinstance(value, ast.Subscript) or isinstance(value,
                                                       ast.Attribute):
-        return _expr_tainted(value.value)
+        return _expr_tainted(value.value, tainted_fns)
     if isinstance(value, ast.Name):
         return bool(_CACHE_GLOBAL_RE.search(value.id))
     return False
+
+
+def _own_returns(fn) -> List[ast.AST]:
+    """``return`` expressions of ``fn``'s OWN body (nested defs are
+    their own scopes and must not leak their returns up)."""
+    out: List[ast.AST] = []
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Return) and node.value is not None:
+            out.append(node.value)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _return_tainted_fns(tree: ast.AST) -> frozenset:
+    """Names of functions whose return value is cache-tainted — the
+    r13 taint-through-helper-returns pass. Iterates to a TRUE fixpoint
+    (a fixed round count would silently miss depth-3+ helper chains in
+    adversarial definition order); each round can only grow the set,
+    so it terminates within len(fns) rounds. Matching at call sites is
+    by LAST name (methods included), same as the donating-function
+    lookup."""
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    tainted: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            if fn.name in tainted:
+                continue
+            scope = _Scope(fn, fn.name, tainted_fns=frozenset(tainted))
+            scope.analyze()
+
+            def ret_tainted(expr: ast.AST) -> bool:
+                if isinstance(expr, ast.Tuple):
+                    return any(ret_tainted(el) for el in expr.elts)
+                if isinstance(expr, ast.Name):
+                    return expr.id in scope.tainted or \
+                        _expr_tainted(expr, frozenset(tainted))
+                return _expr_tainted(expr, frozenset(tainted))
+
+            if any(ret_tainted(r) for r in _own_returns(fn)):
+                tainted.add(fn.name)
+                changed = True
+    return frozenset(tainted)
 
 
 @register
@@ -197,7 +259,8 @@ class DonationChecker(Checker):
 
         # Pass B: plain-name aliases (exe = train_chunk) and forwarders
         # (dispatch passes its param at a donated position), 2 rounds.
-        scopes = self._scopes(tree)
+        ret_tainted = _return_tainted_fns(tree)
+        scopes = self._scopes(tree, ret_tainted)
         for _ in range(2):
             for scope in scopes:
                 for stmt in ast.walk(scope.node):
@@ -249,12 +312,13 @@ class DonationChecker(Checker):
                         out[params.index(arg)] = arg
         return out
 
-    def _scopes(self, tree: ast.AST) -> List[_Scope]:
-        scopes = [_Scope(tree, "<module>")] if hasattr(tree, "body") \
-            else []
+    def _scopes(self, tree: ast.AST,
+                tainted_fns: frozenset = frozenset()) -> List[_Scope]:
+        scopes = [_Scope(tree, "<module>", tainted_fns)] \
+            if hasattr(tree, "body") else []
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                scopes.append(_Scope(node, node.name))
+                scopes.append(_Scope(node, node.name, tainted_fns))
         return scopes
 
     def _judge_scope(self, rel: str, scope: _Scope,
@@ -270,7 +334,7 @@ class DonationChecker(Checker):
                 if pos >= len(call.args):
                     continue
                 arg = call.args[pos]
-                if _expr_tainted(arg) or (
+                if _expr_tainted(arg, scope.tainted_fns) or (
                         isinstance(arg, ast.Name) and
                         arg.id in scope.tainted):
                     label = arg.id if isinstance(arg, ast.Name) \

@@ -12,6 +12,18 @@ Numpy query payloads (images) are framed as base64 so the bus stays
 JSON-only; tensors at scale never ride the bus — InferenceWorkers decode
 once and batch onto the chip themselves.
 
+**Packed batch frames** (``__ndbatch__``, r13): when every query in a
+shard is a same-shape/same-dtype tensor, the shard rides ONE contiguous
+buffer + a shape/dtype/offsets header instead of N per-query ``__nd__``
+frames — the predictor pays one base64 encode per shard, the worker one
+decode per shard (a single ``np.frombuffer`` view), and the per-query
+framing overhead disappears from the wire. Emission is NEGOTIATED: a
+worker advertises ``"wire": ["ndbatch1"]`` in its bus registration and
+only advertised workers receive packed frames (old workers keep the
+per-query format; new workers accept both), so mixed fleets and rolling
+promotes stay safe. ``rafiki_tpu_serving_wire_bytes_total`` and
+``.._host_copies_total`` (``observe.wire``) account both formats.
+
 Query frames additionally carry the requests' trace contexts under a
 ``"_trace"`` envelope key (``observe.trace``): senders inject the
 explicit contexts a micro-batcher collected, or the calling thread's
@@ -23,14 +35,28 @@ old consumers ignore it — version skew in either direction degrades to
 from __future__ import annotations
 
 import base64
+import binascii
+import math
 import threading
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Collection, Dict, List, Optional
 
 import numpy as np
 
 from .bus import BaseBus
 from .observe import trace as _trace
+from .observe import wire as _wire
+
+#: Negotiation token for the packed batch-tensor wire format. A worker
+#: listing it under ``"wire"`` in its registration accepts ``"batch"``
+#: frames; the version suffix means a future layout ships as ndbatch2
+#: alongside, never as a silent change of this one.
+WIRE_NDBATCH = "ndbatch1"
+
+#: Upper bound on the per-query error replies a CORRUPT packed frame's
+#: (untrusted) header can demand — far above any real shard, far below
+#: an allocation attack.
+_CORRUPT_REPLY_CAP = 4096
 
 
 def encode_payload(value: Any) -> Any:
@@ -46,6 +72,184 @@ def encode_payload(value: Any) -> Any:
     if isinstance(value, (np.integer, np.floating)):
         return value.item()
     return value
+
+
+class PackedBatch:
+    """A super-batch of same-shape tensors as ONE contiguous buffer.
+
+    Built once at the predictor edge (the micro-batcher's coalesced
+    super-batch assembles straight into it); ``slice`` cuts per-shard
+    wire frames out of it with one base64 encode each — no per-query
+    frames, no per-worker re-encode. Rows are C-contiguous, so a
+    leading-dim slice is itself contiguous and ``tobytes`` is a single
+    memcpy.
+    """
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: np.ndarray):
+        self.data = data  # (n, *query_shape), C-contiguous
+
+    @property
+    def n(self) -> int:
+        return int(self.data.shape[0])
+
+    @classmethod
+    def from_arrays(cls, arrays: List[Any]) -> Optional["PackedBatch"]:
+        """Pack a list of ndarrays, or None when they are not packable
+        (mixed shapes/dtypes, non-numeric, empty). Non-contiguous
+        inputs are fine — the row assignment linearizes them. The
+        per-row fills are counted as ``assemble`` copies so the packed
+        side's evidence is symmetric with the legacy ``stack`` count
+        (a gate passing by instrumentation gap would be no gate)."""
+        if not arrays:
+            return None
+        first = arrays[0]
+        if not isinstance(first, np.ndarray) or first.dtype.hasobject \
+                or first.dtype.itemsize == 0:
+            return None
+        shape, dtype = first.shape, first.dtype
+        for a in arrays[1:]:
+            if not isinstance(a, np.ndarray) or a.shape != shape \
+                    or a.dtype != dtype:
+                return None
+        buf = np.empty((len(arrays), *shape), dtype)
+        for i, a in enumerate(arrays):
+            buf[i] = a
+        _wire.count_copies("assemble", len(arrays))
+        return cls(buf)
+
+    @classmethod
+    def from_encoded(cls, encoded: List[Any]) -> Optional["PackedBatch"]:
+        """Pack a list of per-query ``__nd__`` wire frames (the HTTP
+        hot path: clients ship frames, the predictor re-packs them once
+        per super-batch), or None when they are not all same-shape
+        tensor frames. Pays one base64 decode per query HERE so every
+        downstream worker pays one per SHARD instead of one per query
+        (counted as ``site="decode"`` host copies)."""
+        if not encoded:
+            return None
+        first = encoded[0]
+        if not isinstance(first, dict) or "__nd__" not in first:
+            return None
+        try:
+            dtype = np.dtype(first["dtype"])
+            shape = tuple(int(x) for x in first["shape"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if dtype.hasobject or dtype.itemsize == 0 \
+                or any(s < 0 for s in shape):
+            return None
+        per = dtype.itemsize * int(math.prod(shape))
+        # The shape header is UNTRUSTED client input: the batch buffer
+        # is allocated only after the first payload's decoded length
+        # vouches for it (a frame claiming shape [1e12] over a 1-byte
+        # payload must be refused, not allocated).
+        buf = None
+        for i, q in enumerate(encoded):
+            if not isinstance(q, dict) or "__nd__" not in q:
+                return None
+            if q is not first and (
+                    q.get("dtype") != first["dtype"]
+                    or list(q.get("shape") or ()) != list(first["shape"])):
+                return None
+            try:
+                raw = base64.b64decode(q["__nd__"])
+            except (TypeError, binascii.Error):
+                return None
+            if len(raw) != per:
+                return None
+            if buf is None:
+                buf = np.empty((len(encoded), *shape), dtype)
+            buf[i] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        _wire.count_copies("decode", len(encoded))
+        return cls(buf)
+
+    def slice(self, start: int, count: int) -> Dict[str, Any]:
+        """One shard's wire frame: header + a single base64 encode of
+        the contiguous row range (counted as one ``encode`` copy — vs
+        ``count`` of them on the per-query format)."""
+        rows = self.data[start:start + count]
+        per = int(self.data.dtype.itemsize
+                  * math.prod(self.data.shape[1:]))
+        _wire.count_copies("encode", 1)
+        return {"__ndbatch__": base64.b64encode(rows.tobytes()).decode(),
+                "v": 1,
+                "dtype": str(self.data.dtype),
+                "shape": list(self.data.shape[1:]),
+                "n": count,
+                "offsets": [i * per for i in range(count)]}
+
+    def take(self, indices: List[int]) -> "PackedBatch":
+        """Row-gathered sub-batch (the tiered path's escalation subset
+        re-packs without touching per-query frames)."""
+        return PackedBatch(np.ascontiguousarray(self.data[indices]))
+
+
+def decode_batch(value: Dict[str, Any]) -> np.ndarray:
+    """Strict decode of one ``__ndbatch__`` frame into an ``(n,
+    *shape)`` array — ONE base64 decode + ONE ``np.frombuffer`` view
+    (read-only; the worker copies rows into its reusable staging
+    buffer). Raises ``ValueError`` on any header/payload disagreement:
+    a truncated or corrupt frame must be rejected loudly, never served
+    as silently wrong tensors."""
+    if not isinstance(value, dict) or "__ndbatch__" not in value:
+        raise ValueError("not a packed batch frame")
+    if value.get("v") != 1:
+        raise ValueError(f"unsupported packed-frame version "
+                         f"{value.get('v')!r}")
+    try:
+        dtype = np.dtype(value["dtype"])
+        shape = tuple(int(x) for x in value["shape"])
+        n = int(value["n"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed packed-frame header: {e}") from None
+    if n < 0 or any(s < 0 for s in shape) or dtype.hasobject:
+        raise ValueError("malformed packed-frame header")
+    per = dtype.itemsize * int(math.prod(shape))
+    offsets = value.get("offsets")
+    if offsets is not None:
+        # KeyError/IndexError included: a dict or short sequence here
+        # (corrupt producer) must land in the ValueError contract, not
+        # escape through the worker's serve loop.
+        try:
+            bad = len(offsets) != n or any(
+                int(offsets[i]) != i * per for i in range(n))
+        except (TypeError, ValueError, KeyError, IndexError):
+            bad = True
+        if bad:
+            raise ValueError("packed-frame offsets disagree with the "
+                             "shape/dtype header")
+    try:
+        raw = base64.b64decode(value["__ndbatch__"], validate=True)
+    except (TypeError, binascii.Error) as e:
+        raise ValueError(f"corrupt packed payload: {e}") from None
+    if len(raw) != n * per:
+        raise ValueError(
+            f"packed payload is {len(raw)} bytes; header claims "
+            f"{n} x {per}")
+    return np.frombuffer(raw, dtype=dtype).reshape((n, *shape))
+
+
+def _payload_nbytes(value: Any) -> int:
+    """Cheap serialized-size ESTIMATE of a wire payload (b64 length +
+    nominal per-frame framing overhead) for the wire-bytes counter —
+    computed without re-serializing the frame, and only when the
+    counter family is live."""
+    if isinstance(value, dict):
+        s = value.get("__nd__")
+        if isinstance(s, str):
+            return len(s) + 48  # dtype/shape keys + quoting
+        s = value.get("__ndbatch__")
+        if isinstance(s, str):
+            return (len(s) + 64
+                    + 12 * int(value.get("n", 0) or 0))  # offsets
+        return 32 + sum(_payload_nbytes(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return 2 + sum(_payload_nbytes(v) for v in value)
+    if isinstance(value, str):
+        return len(value) + 2
+    return 8
 
 
 def _trace_envelope(trace_ctxs: Optional[List] = None) -> Optional[Dict]:
@@ -200,9 +404,12 @@ class Cache:
         return batch_id
 
     def send_query_batch_fanout(self, worker_ids: List[str],
-                                encoded_queries: List[Any],
+                                encoded_queries: Optional[List[Any]],
                                 batch_id: Optional[str] = None,
-                                trace_ctxs: Optional[List] = None) -> str:
+                                trace_ctxs: Optional[List] = None,
+                                packed: Optional[PackedBatch] = None,
+                                packed_ok: Collection[str] = (),
+                                ) -> str:
         """Scatter ONE pre-encoded batch to every worker in one bus
         call (``push_many``). The encoded payload list is SHARED across
         the per-worker frames — encode once, serialize per queue, no
@@ -210,13 +417,34 @@ class Cache:
         worker (consumers decode by *replacing* the ``queries`` key, so
         the shared list itself is never mutated). ``trace_ctxs`` are
         the coalesced requests' trace contexts (the shared ``_trace``
-        envelope rides every per-worker frame)."""
+        envelope rides every per-worker frame).
+
+        ``packed`` + ``packed_ok``: workers in ``packed_ok`` (their
+        registration advertises :data:`WIRE_NDBATCH`) receive the whole
+        batch as ONE shared packed ``"batch"`` frame — encoded once for
+        the entire fanout; the rest keep the per-query list.
+        ``encoded_queries`` may be None only when every worker is in
+        ``packed_ok``."""
         batch_id = batch_id or uuid.uuid4().hex
         env = _trace_envelope(trace_ctxs)
+        counting = _wire.counting()
+        packed_frame = None
+        if packed is not None and any(w in packed_ok
+                                      for w in worker_ids):
+            packed_frame = packed.slice(0, packed.n)
         frames = []
         for w in worker_ids:
-            frame: Dict[str, Any] = {"batch_id": batch_id,
-                                     "queries": encoded_queries}
+            frame: Dict[str, Any] = {"batch_id": batch_id}
+            if packed_frame is not None and w in packed_ok:
+                frame["batch"] = packed_frame
+                if counting:
+                    _wire.count_bytes("packed", "scatter",
+                                      _payload_nbytes(packed_frame))
+            else:
+                frame["queries"] = encoded_queries
+                if counting:
+                    _wire.count_bytes("perquery", "scatter",
+                                      _payload_nbytes(encoded_queries))
             if env is not None:
                 frame[_trace.ENVELOPE_KEY] = env
             frames.append((f"q:{w}", frame))
@@ -224,9 +452,11 @@ class Cache:
         return batch_id
 
     def send_query_shards(self, shards: List[tuple],
-                          encoded_queries: List[Any],
+                          encoded_queries: Optional[List[Any]],
                           batch_id: Optional[str] = None,
-                          trace_ctxs: Optional[List] = None) -> str:
+                          trace_ctxs: Optional[List] = None,
+                          packed: Optional[PackedBatch] = None,
+                          packed_ok: Collection[str] = ()) -> str:
         """Scatter per-SHARD slices of one pre-encoded batch — the
         data-parallel fanout behind ``Predictor``'s replica sharding.
 
@@ -239,16 +469,36 @@ class Cache:
         (old workers simply don't echo; the gatherer falls back to
         matching by worker id). A full-batch shard reuses the shared
         list itself. One ``push_many`` round-trip for the whole plan,
-        exactly like the unsharded fanout."""
+        exactly like the unsharded fanout.
+
+        With ``packed`` given, shards bound for a worker in
+        ``packed_ok`` carry their slice as one contiguous ``"batch"``
+        frame instead (one base64 encode per shard); other shards keep
+        the per-query list — the same plan may mix both formats, which
+        is exactly the rolling-promote / mixed-fleet case.
+        ``encoded_queries`` may be None only when every planned worker
+        is packed-capable (the caller materializes per-query frames
+        lazily otherwise)."""
         batch_id = batch_id or uuid.uuid4().hex
         env = _trace_envelope(trace_ctxs)
-        n = len(encoded_queries)
+        n = packed.n if packed is not None else len(encoded_queries)
+        counting = _wire.counting()
         frames = []
         for worker_id, start, count, shard_id in shards:
-            qs = (encoded_queries if start == 0 and count == n
-                  else encoded_queries[start:start + count])
-            frame: Dict[str, Any] = {"batch_id": batch_id, "queries": qs,
+            frame: Dict[str, Any] = {"batch_id": batch_id,
                                      "shard": shard_id}
+            if packed is not None and worker_id in packed_ok:
+                frame["batch"] = packed.slice(start, count)
+                if counting:
+                    _wire.count_bytes("packed", "scatter",
+                                      _payload_nbytes(frame["batch"]))
+            else:
+                qs = (encoded_queries if start == 0 and count == n
+                      else encoded_queries[start:start + count])
+                frame["queries"] = qs
+                if counting:
+                    _wire.count_bytes("perquery", "scatter",
+                                      _payload_nbytes(qs))
             if env is not None:
                 frame[_trace.ENVELOPE_KEY] = env
             frames.append((f"q:{worker_id}", frame))
@@ -287,12 +537,41 @@ class Cache:
                     timeout: float = 1.0) -> List[Dict[str, Any]]:
         """Blocking batched pop: waits for the first item, drains the
         burst (the batched-TPU-inference pattern). Items are single
-        queries (``query``) or batches (``queries``)."""
+        queries (``query``), batches (``queries``), or packed batches
+        (``batch`` → decoded to an ``(n, *shape)`` array view here, one
+        base64 decode per shard). A corrupt packed frame is converted
+        in place (``batch=None`` + ``batch_error`` + the header's ``n``
+        best-effort) instead of raising — the worker answers it with
+        per-query error dicts rather than dying on a bad producer."""
         items = self.bus.pop_all(f"q:{worker_id}", max_items=max_items,
                                  timeout=timeout)
+        counting = _wire.counting()
         for it in items:
-            if "queries" in it:
+            if "batch" in it:
+                raw = it["batch"]
+                try:
+                    it["batch"] = decode_batch(raw)
+                    _wire.count_copies("decode", 1)
+                except ValueError as e:
+                    it["batch"] = None
+                    it["batch_error"] = str(e)
+                    # The header's n sizes the per-query error reply —
+                    # CAPPED, because this header is by definition
+                    # untrusted (a frame claiming n=1e9 must not make
+                    # the error path allocate a billion error dicts;
+                    # the gatherer only reads up to its shard's count
+                    # anyway).
+                    try:
+                        it["n"] = max(0, min(int(raw.get("n", 0)),
+                                             _CORRUPT_REPLY_CAP))
+                    except (AttributeError, TypeError, ValueError):
+                        it["n"] = 0
+            elif "queries" in it:
                 it["queries"] = [decode_payload(q) for q in it["queries"]]
+                if counting:
+                    _wire.count_copies("decode", sum(
+                        1 for q in it["queries"]
+                        if isinstance(q, np.ndarray)))
             else:
                 it["query"] = decode_payload(it["query"])
         return items
@@ -329,4 +608,7 @@ class Cache:
             frame["confidence"] = confidence
         if compute_s is not None:
             frame["compute_s"] = compute_s
+        if _wire.counting():
+            _wire.count_bytes("perquery", "reply",
+                              _payload_nbytes(frame["predictions"]))
         self.bus.push(f"r:{batch_id}", frame)

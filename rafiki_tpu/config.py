@@ -115,6 +115,24 @@ class NodeConfig:
     # fans out to the full ensemble, and no tier series is registered.
     serving_tier_threshold: float = 0.0
 
+    # Packed batch-tensor wire format (docs/serving.md "Wire format"):
+    # "on" (default) packs same-shape tensor super-batches into one
+    # contiguous __ndbatch__ buffer per shard toward workers that
+    # advertise it (negotiated — old workers keep per-query frames);
+    # "compat" emits/advertises nothing packed but KEEPS the wire-bytes
+    # / host-copies accounting (kill switch with observability, and the
+    # bench A/B's measured legacy side); "off" = legacy frames and
+    # ZERO wire metric series.
+    serving_packed_wire: str = "on"
+    # Serving quantization mode: "int8" quantizes each InferenceWorker's
+    # model post-load (per-channel symmetric weight scales, dequant-free
+    # int8 matmuls where the module supports it, f32 fallback per
+    # layer); "" (default) serves the trained dtype. Promotion-spawned
+    # workers recompute scales for their bin at load. Accuracy contract:
+    # bench.py --config serving-concurrent --quant int8 gates on the
+    # f32-vs-int8 accuracy delta.
+    serving_quant: str = ""
+
     # InferenceWorker bus-registration lease cadence, seconds: the
     # registration is re-asserted at this period so a restarted broker
     # re-learns live workers (docs/robustness.md). Promoted from an
@@ -294,6 +312,20 @@ class NodeConfig:
         if self.serving_tier_threshold < 0:
             raise ValueError("serving_tier_threshold must be >= 0 "
                              "(0 disables tiered serving)")
+        # The accepted-spelling vocabularies live in observe.wire (the
+        # env readers fail SAFE on anything outside them; config
+        # rejects typos LOUDLY here — one list, two postures).
+        from .observe.wire import (known_packed_wire_spelling,
+                                   known_quant_spelling)
+
+        if not known_packed_wire_spelling(self.serving_packed_wire):
+            raise ValueError(
+                f"serving_packed_wire {self.serving_packed_wire!r} is "
+                f"not one of on/off/compat")
+        if not known_quant_spelling(self.serving_quant):
+            raise ValueError(
+                f"serving_quant {self.serving_quant!r} is not one of "
+                f"''/int8")
         if self.worker_reregister <= 0:
             raise ValueError("worker_reregister must be positive")
         if self.dataset_cache_bytes < 0 or self.stage_cache_bytes < 0:
@@ -366,6 +398,19 @@ class NodeConfig:
             str(self.serving_tier_threshold)
         os.environ[self.env_name("worker_reregister")] = \
             str(self.worker_reregister)
+        # Packed wire + quantization: Cache/Predictor/InferenceWorker
+        # snapshot these at construction (observe.wire normalizes the
+        # spellings); the quant knob pops when empty so a worker's
+        # getenv default ("" = serve trained dtype) stays the contract.
+        from .observe.wire import packed_wire_mode
+
+        os.environ[self.env_name("serving_packed_wire")] = \
+            packed_wire_mode(self.serving_packed_wire)
+        if self.serving_quant.strip():
+            os.environ[self.env_name("serving_quant")] = \
+                self.serving_quant
+        else:
+            os.environ.pop(self.env_name("serving_quant"), None)
         # The adaptive ceiling defaults to the legacy fixed knob; only
         # an explicit override is exported (consumers fall back to
         # SERVING_FILL_WINDOW themselves).

@@ -43,6 +43,7 @@ from flax.training import train_state
 from ..observe import MfuMeter, flops_of_compiled, flops_of_lowered
 from ..observe import metrics as _obs_metrics
 from ..observe import phases as _phases
+from ..observe import wire as _wire
 from ..parallel import (batch_sharding, build_mesh, device_get_tree,
                         replicated,
                         shard_variables)
@@ -215,6 +216,25 @@ def pad_crop_flip_graph(x: Any, rng: Any, pad: int = 4,
     return jnp.where(flip[:, None, None, None], out[:, :, ::-1, :], out)
 
 
+def dynamic_int8_matmul(x: Any, wq: Any, scale: Any) -> Any:
+    """Dequant-free int8 x int8 matmul with dynamic per-row activation
+    quantization: the activation scale is computed in-graph (symmetric
+    max-abs per row — no calibration pass needed), both operands enter
+    the MXU as int8, the accumulator is int32, and the result is
+    rescaled to f32 once. ``wq`` is an ``(in, out)`` int8 kernel with
+    per-output-channel ``scale`` from
+    :meth:`JaxModel.enable_serving_quant`. Module-specific
+    ``quantized_apply`` overrides build their forward pass from this
+    (see ``models/feedforward.py``)."""
+    s_x = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    s_x = jnp.maximum(s_x, 1e-8)
+    xq = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * s_x * scale[None, :]
+
+
 def _canonicalize_state(state: Any, mesh) -> Any:
     """Pin every train-state leaf to a mesh NamedSharding and a strong
     dtype. ``TrainState.create`` leaves the step counter as a weak Python
@@ -265,9 +285,22 @@ class JaxModel(BaseModel):
         self._module = None
         self._meta: Dict[str, Any] = {}
         self._mesh = None
-        self._predict_cache: Dict[int, Any] = {}
+        # (bucket, is_u8, quant_mode) -> zero-copy runner closure over
+        # the AOT-compiled executable + its device-resident weights.
+        self._predict_cache: Dict[Any, Any] = {}
         self._sharded_vars = None
         self._extra_dev = None
+        # Serving quantization: the REQUESTED mode survives parameter
+        # reloads (a promote-spawned worker re-quantizes the incoming
+        # bin's fresh params automatically); the derived device data
+        # does not.
+        self._quant_mode: Optional[str] = None
+        self._quant_dev = None   # (qvars, scales, fvars, layers), device
+        self._quant_host = None  # same tuple on host (one pass per
+        #                          load; DROPPED after the device
+        #                          upload — it is a full second weight
+        #                          copy)
+        self._quant_layers: Optional[Dict[str, str]] = None
 
     # --- Subclass API ---
 
@@ -907,9 +940,12 @@ class JaxModel(BaseModel):
         """Stack queries for the device, keeping all-uint8 batches uint8:
         the serving host link then ships 1/4 the bytes, and the compiled
         predict bucket normalises on chip (see ``_predict_bucket_submit``).
+        One host copy per query (site="stack") — the packed serving path
+        skips this entirely via ``predict_staged_submit``.
         """
         shape = self._meta["image_shape"]
         raws = [self._query_to_raw(q, shape) for q in queries]
+        _wire.count_copies("stack", len(raws))
         if all(r.dtype == np.uint8 for r in raws):
             return np.stack(raws)
         return np.stack([
@@ -966,17 +1002,96 @@ class JaxModel(BaseModel):
             out.append(np.asarray(dev)[:count])
         return np.concatenate(out, axis=0)
 
+    #: Staging-buffer dtypes ``predict_staged_submit`` accepts (the
+    #: InferenceWorker's packed fast path asks via ``predict_bucket``).
+    predict_staged_dtypes = (np.uint8, np.float32)
+
+    def predict_bucket(self, n: int,
+                       dtype: Any = np.float32) -> Optional[int]:
+        """Leading dim a host staging buffer must have for an
+        ``n``-query staged burst (the compiled bucket: dp-aligned power
+        of two), or None when the staged path cannot take it — n over
+        the single-dispatch cap, an unsupported dtype, or an unloaded
+        model — and the caller must fall back to ``predict_submit``."""
+        if self._variables is None or not self._meta.get("n_classes"):
+            return None
+        if n < 1 or n > self.max_predict_batch:
+            return None
+        if np.dtype(dtype) not in [np.dtype(d)
+                                   for d in self.predict_staged_dtypes]:
+            return None
+        bucket = self.mesh.shape["dp"]
+        while bucket < n:
+            bucket *= 2
+        return bucket
+
+    def predict_staged_submit(self, buf: np.ndarray, n: int):
+        """Dispatch one staged burst straight from a reusable host
+        staging buffer: ``buf``'s leading dim is exactly
+        ``predict_bucket(n, buf.dtype)`` and rows ``[n:]`` are padding
+        (stale rows are fine — their outputs are sliced away). The
+        device_put reads the buffer in place — no ``np.stack``, no
+        pad-``concatenate``; this is the ``predict_into`` entry of the
+        packed serving hot path. Returns a zero-arg finisher like
+        ``predict_submit``."""
+        assert self._variables is not None, \
+            "train() or load_parameters() first"
+        shape = tuple(self._meta["image_shape"])
+        if buf.shape[1:] != shape:
+            if int(np.prod(buf.shape[1:])) == int(np.prod(shape)):
+                buf = buf.reshape((buf.shape[0], *shape))  # view
+            else:
+                raise ValueError(
+                    f"staged rows {buf.shape[1:]} != {shape}")
+        expect = self.predict_bucket(n, buf.dtype)
+        if expect is None or buf.shape[0] != expect:
+            raise ValueError(
+                f"staging buffer leading dim {buf.shape[0]} != bucket "
+                f"{expect} for n={n}")
+        dev, count = self._dispatch_bucket(buf, n)
+
+        def finish() -> List[Any]:
+            return [p.tolist() for p in np.asarray(dev)[:count]]
+
+        return finish
+
     def _predict_bucket_submit(self, chunk: np.ndarray):
         n = chunk.shape[0]
-        mesh = self.mesh
-        dp = mesh.shape["dp"]
+        dp = self.mesh.shape["dp"]
         bucket = dp
         while bucket < n:
             bucket *= 2
-        # One sharded device copy of the parameters serves every bucket.
-        if self._sharded_vars is None:
-            self._sharded_vars = shard_variables(self._variables, mesh)
-        variables = self._sharded_vars
+        if n < bucket:
+            _wire.count_copies("pad", 1)
+            chunk = np.concatenate(
+                [chunk, np.zeros((bucket - n, *chunk.shape[1:]), chunk.dtype)])
+        return self._dispatch_bucket(chunk, n)
+
+    def _dispatch_bucket(self, chunk: np.ndarray, n: int):
+        """``chunk``'s leading dim is exactly a bucket; look up (or
+        build) the compiled runner for ``(bucket, dtype, quant)`` and
+        dispatch. Returns ``(device future, n)``."""
+        bucket = chunk.shape[0]
+        is_u8 = chunk.dtype == np.uint8
+        key = (bucket, is_u8, self._quant_mode)
+        runner = self._predict_cache.get(key)
+        if runner is None:
+            runner = self._build_predict_runner(bucket, chunk.shape[1:],
+                                                is_u8)
+            self._predict_cache[key] = runner
+        x = jax.device_put(chunk, batch_sharding(self.mesh))
+        return runner(x), n  # device future + count
+
+    def _build_predict_runner(self, bucket: int, feat_shape, is_u8: bool):
+        """AOT-compile one predict executable and close over its
+        device-resident weights: f32/bf16 apply by default, the
+        ``(bucket, dtype, quant)`` int8 variant when serving
+        quantization is enabled (weights enter the graph as int8 +
+        per-channel scales; the module either runs its own dequant-free
+        ``quantized_apply`` or falls back to in-graph dequantized f32
+        weights per layer)."""
+        mesh = self.mesh
+        module = self._module
         if self._extra_dev is None:
             # Device-put once per compiled lifetime: this is the AOT
             # serving hot path and the extras are per-model constants.
@@ -984,42 +1099,172 @@ class JaxModel(BaseModel):
                 k: jax.device_put(jnp.asarray(v), replicated(mesh))
                 for k, v in self.extra_apply_inputs().items()}
         extra = self._extra_dev
-        # uint8 batches ship raw (4x fewer bytes over the host link) and
-        # normalise on chip — one compiled executable per (bucket, dtype).
-        is_u8 = chunk.dtype == np.uint8
-        compiled = self._predict_cache.get((bucket, is_u8))
-        if compiled is None:
-            module = self._module
+        x_shape = jax.ShapeDtypeStruct(
+            (bucket, *feat_shape), jnp.uint8 if is_u8 else jnp.float32,
+            sharding=batch_sharding(mesh))
+        struct = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, a.dtype, sharding=a.sharding)
 
-            @jax.jit
-            def predict_fn(variables, x, extra):
+        if self._quant_mode is not None:
+            qvars, scales, fvars, _layers = self._quant_device_arrays()
+            quantized_apply = self.quantized_apply
+
+            def predict_fn(qvars, scales, fvars, x, extra):
                 xf = x.astype(jnp.float32)
                 if is_u8:
                     xf = xf / 255.0
-                logits = module.apply(variables, xf, train=False, **extra)
-                return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+                logits = quantized_apply(qvars, scales, fvars, xf, extra)
+                if logits is None:
+                    # Generic weight-only fallback: reconstruct each
+                    # quantized kernel in-graph (one VPU multiply per
+                    # layer) and run the module unchanged — int8
+                    # resident weights, module-dtype matmuls.
+                    flat = dict(fvars)
+                    for k, wq in qvars.items():
+                        flat[k] = wq.astype(jnp.float32) * scales[k]
+                    variables = traverse_util.unflatten_dict(flat,
+                                                             sep="/")
+                    logits = module.apply(variables, xf, train=False,
+                                          **extra)
+                return jax.nn.softmax(
+                    logits.astype(jnp.float32), axis=-1)
 
-            # AOT-compile for this bucket shape so serving never retraces.
-            x_shape = jax.ShapeDtypeStruct(
-                (bucket, *chunk.shape[1:]),
-                jnp.uint8 if is_u8 else jnp.float32,
-                sharding=batch_sharding(mesh))
-            struct = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
-                a.shape, a.dtype, sharding=a.sharding)
-            compiled = predict_fn.lower(
-                jax.tree.map(struct, variables), x_shape,
-                jax.tree.map(struct, extra)).compile()
-            self._predict_cache[(bucket, is_u8)] = compiled
-        if n < bucket:
-            chunk = np.concatenate(
-                [chunk, np.zeros((bucket - n, *chunk.shape[1:]), chunk.dtype)])
-        x = jax.device_put(chunk, batch_sharding(mesh))
-        return compiled(variables, x, extra), n  # device future + count
+            compiled = jax.jit(predict_fn).lower(
+                jax.tree.map(struct, qvars),
+                jax.tree.map(struct, scales),
+                jax.tree.map(struct, fvars),
+                x_shape, jax.tree.map(struct, extra)).compile()
+            return lambda x: compiled(qvars, scales, fvars, x, extra)
+
+        # One sharded device copy of the parameters serves every bucket.
+        if self._sharded_vars is None:
+            self._sharded_vars = shard_variables(self._variables, mesh)
+        variables = self._sharded_vars
+
+        # uint8 batches ship raw (4x fewer bytes over the host link) and
+        # normalise on chip — one compiled executable per (bucket, dtype).
+        def predict_fn(variables, x, extra):
+            xf = x.astype(jnp.float32)
+            if is_u8:
+                xf = xf / 255.0
+            logits = module.apply(variables, xf, train=False, **extra)
+            return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        # AOT-compile for this bucket shape so serving never retraces.
+        compiled = jax.jit(predict_fn).lower(
+            jax.tree.map(struct, variables), x_shape,
+            jax.tree.map(struct, extra)).compile()
+        return lambda x: compiled(variables, x, extra)
+
+    # --- Serving quantization (int8 ensemble mode) ---
+
+    def enable_serving_quant(self, mode: str = "int8") -> Dict[str, Any]:
+        """Post-training serving quantization: per-channel symmetric
+        int8 scales over every 2-D ``kernel`` leaf, computed from the
+        CURRENTLY loaded parameters (the InferenceWorker calls this at
+        load time, so a promotion's fresh worker re-computes scales for
+        the incoming bin by construction). Predict executables compile
+        as additional ``(bucket, dtype, quant)`` variants; training and
+        evaluation are untouched. Returns the per-layer report
+        (``{"mode", "layers": {path: "int8"|"f32"}, ...}``).
+        ``mode=None``/``""`` disables again."""
+        if not mode:
+            if self._quant_mode is not None:
+                self._quant_mode = None
+                self._quant_dev = None
+                self._quant_host = None
+                self._quant_layers = None
+                self._predict_cache.clear()
+            return {"mode": None, "layers": {}}
+        if mode != "int8":
+            raise ValueError(f"unsupported serving quant mode {mode!r}")
+        assert self._variables is not None, \
+            "train() or load_parameters() first"
+        if self._quant_mode != mode:
+            self._quant_mode = mode
+            self._quant_dev = None
+            self._quant_host = None
+            self._quant_layers = None
+            self._predict_cache.clear()
+        return self.quant_report()
+
+    def quant_report(self) -> Dict[str, Any]:
+        if self._quant_mode is None or self._variables is None:
+            return {"mode": None, "layers": {}}
+        layers = self._quant_layers
+        if layers is None:
+            _, _, _, layers = self._quant_host_arrays()
+        n_int8 = sum(1 for v in layers.values() if v == "int8")
+        return {"mode": self._quant_mode, "layers": dict(layers),
+                "n_int8": n_int8, "n_f32": len(layers) - n_int8}
+
+    def _quant_host_arrays(self):
+        """``(qvars, scales, fvars, layers)`` as flat ``path -> array``
+        host dicts, computed ONCE per loaded parameters (the report at
+        load time and the first compile share it). Eligible leaves —
+        2-D floating ``kernel``s — carry int8 weights +
+        per-output-channel symmetric scales (``max|W[:,j]| / 127``);
+        everything else (biases, norms, conv kernels, batch_stats)
+        passes through in f32: the per-layer fallback the wire contract
+        promises."""
+        if self._quant_host is not None:
+            return self._quant_host
+        flat = traverse_util.flatten_dict(self._variables, sep="/")
+        qvars: Dict[str, np.ndarray] = {}
+        scales: Dict[str, np.ndarray] = {}
+        fvars: Dict[str, np.ndarray] = {}
+        layers: Dict[str, str] = {}
+        for k, v in flat.items():
+            arr = np.asarray(v)
+            if k.endswith("kernel") and arr.ndim == 2 and \
+                    np.issubdtype(arr.dtype, np.floating):
+                w = arr.astype(np.float32)
+                s = np.max(np.abs(w), axis=0) / 127.0
+                s = np.where(s <= 0, 1.0, s).astype(np.float32)
+                qvars[k] = np.clip(np.round(w / s), -127, 127) \
+                    .astype(np.int8)
+                scales[k] = s
+                layers[k] = "int8"
+            else:
+                fvars[k] = arr
+                layers[k] = "f32"
+        self._quant_host = (qvars, scales, fvars, layers)
+        self._quant_layers = layers
+        return self._quant_host
+
+    def _quant_device_arrays(self):
+        if self._quant_dev is None:
+            qvars, scales, fvars, layers = self._quant_host_arrays()
+            rep = replicated(self.mesh)
+            put = lambda d: {k: jax.device_put(v, rep)  # noqa: E731
+                             for k, v in d.items()}
+            # Replicated on purpose: int8 serving targets small/medium
+            # ensemble models; tensor-parallel int8 sharding is not
+            # supported (the f32 path keeps shard_variables' rules).
+            self._quant_dev = (put(qvars), put(scales), put(fvars),
+                               layers)
+            # The host tuple is a full second weight copy; once the
+            # device arrays exist only the per-layer labels are needed
+            # (quant_report) — a long-lived worker must not hold 2x.
+            self._quant_host = None
+        return self._quant_dev
+
+    def quantized_apply(self, qvars: Dict[str, Any],
+                        scales: Dict[str, Any], fvars: Dict[str, Any],
+                        x: Any, extra: Dict[str, Any]) -> Optional[Any]:
+        """Module-specific dequant-free int8 forward pass: return the
+        logits built from int8 kernels (see ``dynamic_int8_matmul``),
+        or None (the default) to use the generic dequantized-weights
+        fallback. Called at TRACE time inside the compiled predict
+        variant, so the choice is static per executable."""
+        return None
 
     def warmup(self) -> None:
         """Pre-compile the smallest predict bucket (both the uint8 and
-        float32 input variants) so a serving worker pays the XLA
-        compiles before registering for traffic."""
+        float32 input variants — and, with serving quantization
+        enabled, their ``(bucket, dtype, quant)`` variants, since the
+        quant mode is part of the compile key) so a serving worker pays
+        the XLA compiles before registering for traffic."""
         shape = self._meta.get("image_shape")
         if self._variables is None or not shape:
             return
@@ -1062,6 +1307,11 @@ class JaxModel(BaseModel):
         self._predict_cache.clear()
         self._sharded_vars = None
         self._extra_dev = None
+        # Derived quant data follows the parameters; the requested MODE
+        # survives, so freshly loaded params re-quantize on first use.
+        self._quant_dev = None
+        self._quant_host = None
+        self._quant_layers = None
 
     def destroy(self) -> None:
         self._invalidate_compiled()

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
-from ..model.jax_model import JaxModel
+from ..model.jax_model import JaxModel, dynamic_int8_matmul
 
 MAX_LAYERS = 3
 MAX_UNITS = 128
@@ -99,3 +99,32 @@ class JaxFeedForward(JaxModel):
             "hidden_layer_units":
                 (np.arange(MAX_UNITS) < units).astype(np.float32),
         }
+
+    def quantized_apply(self, qvars, scales, fvars, x, extra):
+        """Dequant-free int8 serving path: every Dense matmul runs
+        int8 x int8 -> int32 on the MXU (``dynamic_int8_matmul``:
+        weights statically quantized per output channel, activations
+        dynamically per row — no calibration pass), mirroring
+        ``_FeedForward.__call__``'s masked-supernet forward exactly. A
+        kernel the quantizer left in f32 (none today, but the contract
+        is per-layer) falls back to a plain matmul on that layer. The
+        accuracy-delta gate in ``bench.py --quant int8`` is the
+        regression net for this hand-mirrored forward."""
+        import jax.numpy as jnp
+
+        def dense(h, i):
+            k = f"params/Dense_{i}/kernel"
+            b = fvars[f"params/Dense_{i}/bias"].astype(jnp.float32)
+            if k in qvars:
+                return dynamic_int8_matmul(h, qvars[k], scales[k]) + b
+            return h @ fvars[k].astype(jnp.float32) + b  # f32 fallback
+
+        count_mask = extra["hidden_layer_count"]
+        units_mask = extra["hidden_layer_units"]
+        h = x.reshape((x.shape[0], -1))
+        for i in range(MAX_LAYERS):
+            y = jnp.maximum(dense(h, i), 0.0)  # relu
+            y = y * units_mask.astype(y.dtype)
+            h = y if i == 0 else jnp.where(
+                count_mask[i].astype(y.dtype) > 0, y, h)
+        return dense(h, MAX_LAYERS)

@@ -18,8 +18,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..bus import BaseBus
-from ..cache import Cache
+from ..cache import WIRE_NDBATCH, Cache, PackedBatch
 from ..observe import metrics as _metrics
+from ..observe import wire as _wire_obs
 
 _log = logging.getLogger(__name__)
 
@@ -128,6 +129,88 @@ def ensemble_predictions(worker_predictions: List[Any],
 #: replied (shared by the full and tiered reassembly paths).
 _HOLE = object()
 
+
+class _WirePayload:
+    """One super-batch's outbound wire representation.
+
+    BOTH forms are lazy: the packed contiguous buffer materializes only
+    when a plan actually targets a packed-capable worker (a tiered
+    phase-1 against a legacy best bin must not pay the assembly for an
+    escalation that usually never happens), and per-query frames only
+    for legacy shards — a plan whose every shard lands on
+    packed-capable workers never builds them, which is where the "one
+    encode per shard instead of one per query" win comes from on the
+    direct (numpy-in) path. The same payload object follows the batch
+    through resubmits and the tiered escalation, so the formats can
+    never diverge mid-flight."""
+
+    __slots__ = ("capable", "_queries", "_pre_encoded", "_encoded",
+                 "_packed", "_packed_done")
+
+    def __init__(self, queries: List[Any], pre_encoded: bool,
+                 capable: frozenset):
+        self.capable = capable
+        self._queries = queries
+        self._pre_encoded = pre_encoded
+        self._encoded: Optional[List[Any]] = None
+        self._packed: Optional[PackedBatch] = None
+        self._packed_done = False
+
+    @property
+    def packed(self) -> Optional[PackedBatch]:
+        """The contiguous batch buffer, assembled on first demand
+        (None when the queries are not packable — mixed shapes,
+        non-tensors — or nobody in the fleet advertises the format)."""
+        if not self._packed_done:
+            self._packed_done = True
+            if self.capable:
+                self._packed = (
+                    PackedBatch.from_encoded(self._queries)
+                    if self._pre_encoded
+                    else PackedBatch.from_arrays(self._queries))
+        return self._packed
+
+    @property
+    def encoded(self) -> List[Any]:
+        """Per-query wire frames, built on first use (legacy shards /
+        mixed fleets only)."""
+        if self._encoded is None:
+            if self._pre_encoded:
+                self._encoded = self._queries
+            else:
+                from ..cache import encode_payload
+
+                _wire_obs.count_copies("encode", sum(
+                    1 for q in self._queries
+                    if isinstance(q, np.ndarray)))
+                self._encoded = [encode_payload(q)
+                                 for q in self._queries]  # once total
+        return self._encoded
+
+    def for_plan(self, plan: List["_Shard"],
+                 ) -> Tuple[Optional[List[Any]],
+                            Optional[PackedBatch]]:
+        """``(encoded_queries, packed)`` for ONE plan, materializing
+        only the representation(s) its shards actually need."""
+        any_packed = any(s.worker in self.capable for s in plan)
+        packed = self.packed if any_packed else None
+        enc = (self.encoded if packed is None or
+               any(s.worker not in self.capable for s in plan)
+               else None)
+        return enc, packed
+
+    def take(self, indices: List[int]) -> "_WirePayload":
+        """Row subset (the tiered escalation set), preserving whichever
+        representations already materialized."""
+        sub = _WirePayload([self._queries[i] for i in indices],
+                           self._pre_encoded, self.capable)
+        if self._packed_done and self._packed is not None:
+            sub._packed = self._packed.take(indices)
+            sub._packed_done = True
+        if self._encoded is not None:
+            sub._encoded = [self._encoded[i] for i in indices]
+        return sub
+
 #: EWMA smoothing for the per-bin compute-cost estimate (seconds per
 #: query, from worker-reported burst compute time) that prices the
 #: chip-seconds-avoided counters.
@@ -172,6 +255,15 @@ class Predictor:
         # immutable per worker id, and per-request bus.get fan-out
         # would put O(workers) round-trips on the serving hot path.
         self._bins: Dict[str, str] = {}
+        # worker_id -> advertises the packed batch wire (ndbatch1 in
+        # its registration's "wire" list). Memoized with _bins; old
+        # workers simply lack the key and stay on per-query frames.
+        self._wire_ok: Dict[str, bool] = {}
+        # Packed emission is a construction-time snapshot
+        # (NodeConfig.serving_packed_wire): "on" packs toward
+        # advertising workers; "compat"/"off" keep per-query frames
+        # (compat keeps the wire accounting — the bench's legacy side).
+        self._packed_wire = _wire_obs.packed_wire_mode() == "on"
         # bin -> tracked eval score (from worker registration info; the
         # tiered path's "best bin"). Keyed by bin, bounded by the
         # number of served trials — no per-worker churn to prune.
@@ -285,6 +377,8 @@ class Predictor:
                 f"w:{self.inference_job_id}:{worker_id}") or {}
             bin_id = str(info.get("trial_id") or worker_id)
             self._bins[worker_id] = bin_id
+            self._wire_ok[worker_id] = WIRE_NDBATCH in (
+                info.get("wire") or ())
             score = info.get("score")
             if isinstance(score, (int, float)):
                 self._bin_score[bin_id] = float(score)
@@ -309,6 +403,8 @@ class Predictor:
                 live = set(workers)
                 self._bins = {w: b for w, b in self._bins.items()
                               if w in live}
+                self._wire_ok = {w: v for w, v in self._wire_ok.items()
+                                 if w in live}
                 self._lat = {w: v for w, v in self._lat.items()
                              if w in live}
                 self._penalized = {w: t for w, t
@@ -602,41 +698,58 @@ class Predictor:
             raise RuntimeError(
                 f"no running inference workers for job "
                 f"{self.inference_job_id}")
-        if pre_encoded:
-            encoded = queries
-        else:
-            from ..cache import encode_payload
-
-            encoded = [encode_payload(q) for q in queries]  # once total
+        wire = self._build_wire(queries, pre_encoded, groups)
         if self.tier_threshold is not None and len(groups) > 1:
             best = self._best_bin(groups)
             if best is not None:
-                return self._submit_tiered(n, encoded, groups, rr, lat,
+                return self._submit_tiered(n, wire, groups, rr, lat,
                                            best, trace_ctxs)
             # No best-bin basis (a serving worker predates score
             # registration): the whole batch fans out in full.
             self._count_tier("full", n)
         plan = self._plan_for(n, groups, rr, lat)
-        batch_id = self._scatter(plan, encoded, trace_ctxs)
+        batch_id = self._scatter(plan, wire, trace_ctxs)
 
         def finish() -> List[Optional[Any]]:
-            self._gather_shards(batch_id, plan, groups, encoded,
+            self._gather_shards(batch_id, plan, groups, wire,
                                 trace_ctxs)
             return self._reassemble(n, plan)
 
         return finish
 
-    def _scatter(self, plan: List[_Shard], encoded: List[Any],
-                 trace_ctxs: Optional[List[Any]]) -> str:
+    def _build_wire(self, queries: List[Any], pre_encoded: bool,
+                    groups: Dict[str, List[str]]) -> _WirePayload:
+        """The super-batch's wire payload: the packed-capable worker
+        set is resolved here (memoized registration info); both
+        representations — the packed contiguous buffer and the
+        per-query frames — materialize lazily, at most once, when a
+        plan's shards first need them."""
+        capable: frozenset = frozenset()
+        if self._packed_wire:
+            with self._state_lock:
+                capable = frozenset(
+                    w for members in groups.values() for w in members
+                    if self._wire_ok.get(w))
+        return _WirePayload(queries, pre_encoded, capable)
+
+    def _scatter(self, plan: List[_Shard], wire: _WirePayload,
+                 trace_ctxs: Optional[List[Any]],
+                 batch_id: Optional[str] = None) -> str:
         """Stamp + send one shard plan (one ``push_many`` round-trip);
-        shared by the full and tiered submit paths."""
+        shared by the full and tiered submit paths. Shards bound for
+        packed-capable workers carry the contiguous ``batch`` frame;
+        the rest get per-query slices — one plan may mix both (the
+        mixed-fleet / rolling-promote case)."""
         import time
 
         now = time.monotonic()
         for s in plan:
             s.t_sent = now
+        enc, packed = wire.for_plan(plan)
         batch_id = self.cache.send_query_shards(
-            [s.wire() for s in plan], encoded, trace_ctxs=trace_ctxs)
+            [s.wire() for s in plan], enc,
+            batch_id=batch_id, trace_ctxs=trace_ctxs,
+            packed=packed, packed_ok=wire.capable)
         if self._m_shards is not None:
             self._m_shards.inc(len(plan), service=self.service)
         return batch_id
@@ -658,7 +771,7 @@ class Predictor:
         if self._m_tier is not None and n:
             self._m_tier.inc(n, service=self.service, outcome=outcome)
 
-    def _submit_tiered(self, n: int, encoded: List[Any],
+    def _submit_tiered(self, n: int, wire: _WirePayload,
                        groups: Dict[str, List[str]], rr: int,
                        lat: Dict[str, float], best: str,
                        trace_ctxs: Optional[List[Any]],
@@ -673,13 +786,13 @@ class Predictor:
 
         best_groups = {best: groups[best]}
         plan1 = self._plan_for(n, best_groups, rr, lat)
-        batch1 = self._scatter(plan1, encoded, trace_ctxs)
+        batch1 = self._scatter(plan1, wire, trace_ctxs)
         threshold = self.tier_threshold
 
         def finish() -> List[Optional[Any]]:
             wall = time.time()
             t0 = time.monotonic()
-            self._gather_shards(batch1, plan1, best_groups, encoded,
+            self._gather_shards(batch1, plan1, best_groups, wire,
                                 trace_ctxs)
             rows1, weights1, confs1 = self._collect_rows(n, plan1)
             best_row = rows1.get(best)
@@ -708,10 +821,10 @@ class Predictor:
                                         source="tier")
             if esc:
                 other = {b: ms for b, ms in groups.items() if b != best}
-                esc_encoded = [encoded[i] for i in esc]
+                esc_wire = wire.take(esc)
                 plan2 = self._plan_for(len(esc), other, rr, lat)
-                batch2 = self._scatter(plan2, esc_encoded, trace_ctxs)
-                self._gather_shards(batch2, plan2, other, esc_encoded,
+                batch2 = self._scatter(plan2, esc_wire, trace_ctxs)
+                self._gather_shards(batch2, plan2, other, esc_wire,
                                     trace_ctxs)
                 rows2, weights2, _ = self._collect_rows(len(esc), plan2)
                 ordered2 = sorted(rows2.items())
@@ -741,7 +854,8 @@ class Predictor:
         return finish
 
     def _gather_shards(self, batch_id: str, plan: List[_Shard],
-                       groups: Dict[str, List[str]], encoded: List[Any],
+                       groups: Dict[str, List[str]],
+                       wire: _WirePayload,
                        trace_ctxs: Optional[List[Any]]) -> None:
         """Collect replies until every shard is matched or the gather
         timeout lapses. When shards are still missing at the partial
@@ -808,9 +922,11 @@ class Predictor:
                 retries.append(retry)
             if retries:
                 resubmitted = True
+                enc, packed = wire.for_plan(retries)
                 self.cache.send_query_shards(
-                    [s.wire() for s in retries], encoded,
-                    batch_id=batch_id, trace_ctxs=trace_ctxs)
+                    [s.wire() for s in retries], enc,
+                    batch_id=batch_id, trace_ctxs=trace_ctxs,
+                    packed=packed, packed_ok=wire.capable)
                 plan.extend(retries)
                 if self._m_resubmits is not None:
                     self._m_resubmits.inc(len(retries),

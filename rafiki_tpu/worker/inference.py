@@ -14,16 +14,38 @@ import os
 import threading
 from typing import Any, Optional
 
+# numpy is hoisted to module level on purpose (r13 satellite): the old
+# per-call ``import numpy`` in prediction_confidence paid an
+# import-machinery check per PREDICTION on the burst path. The module
+# already pulls numpy transitively (``..cache`` imports it at top), so
+# this costs nothing at import time.
+import numpy as np
+
 from .. import faults
 from ..bus import BaseBus, BusOpError
-from ..cache import Cache
+from ..cache import WIRE_NDBATCH, Cache
 from ..constants import ServiceStatus
 from ..observe import trace
+from ..observe import wire as _wire
 from ..parallel.chips import ChipGroup
 from ..store import MetaStore, ParamStore
 from ..utils.model_loader import load_model_class
 
 _log = logging.getLogger(__name__)
+
+# jax.numpy, lazily bound once (the _SYNC_PROBE pattern): the worker
+# module must stay importable without dragging the accelerator runtime
+# in, but a resolved global costs the burst path zero import checks.
+_jnp = None
+
+
+def _jnp_mod():
+    global _jnp
+    if _jnp is None:
+        import jax.numpy
+
+        _jnp = jax.numpy
+    return _jnp
 
 
 def prediction_confidence(pred: Any) -> Optional[float]:
@@ -32,8 +54,6 @@ def prediction_confidence(pred: Any) -> Optional[float]:
     a flat numeric vector, else None — sk-style label outputs, packed
     ``__members__`` envelopes, and error dicts all degrade gracefully
     to "no confidence" (the Predictor escalates those)."""
-    import numpy as np
-
     try:
         if isinstance(pred, np.ndarray):
             arr = pred
@@ -73,9 +93,7 @@ def _sync_latency(n: int = 3) -> float:
     the constant the one-burst-in-flight overlap can hide."""
     import time
 
-    import jax.numpy as jnp
-    import numpy as np
-
+    jnp = _jnp_mod()
     f = _sync_probe_fn()
     x = jnp.zeros((8, 8), jnp.float32)
     np.asarray(f(x))  # compile outside the timed window
@@ -107,8 +125,6 @@ class _PackedEnsemble:
         self.last_weight = len(models)
 
     def predict_submit(self, queries: list):
-        import numpy as np
-
         finishers = []
         for m in self.models:
             try:
@@ -116,6 +132,44 @@ class _PackedEnsemble:
             except Exception:
                 _log.exception("packed member dispatch failed; dropping "
                                "its vote")
+        return self._finish_members(finishers, len(queries))
+
+    def predict_bucket(self, n: int, dtype: Any = None) -> Optional[int]:
+        """Staged-path negotiation for the whole pack: every member
+        must take the burst at the SAME bucket (they share one chip
+        group, so same dp — differing buckets would mean mismatched
+        staging shapes); any member without a staged entry, or any
+        disagreement, falls the burst back to the per-query path."""
+        buckets = set()
+        for m in self.models:
+            fn = getattr(m, "predict_bucket", None)
+            if fn is None:
+                return None
+            b = fn(n, dtype)
+            if b is None:
+                return None
+            buckets.add(b)
+        return buckets.pop() if len(buckets) == 1 else None
+
+    def predict_staged_submit(self, buf, n: int):
+        """Staged dispatch for the pack: every member device_puts from
+        the SAME shared staging buffer (one host buffer per burst for
+        the whole ensemble — the per-member ``np.stack`` of the legacy
+        path is gone entirely), overlapping on the device exactly like
+        ``predict_submit``."""
+        finishers = []
+        for m in self.models:
+            try:
+                finishers.append(m.predict_staged_submit(buf, n))
+            except Exception:
+                _log.exception("packed member staged dispatch failed; "
+                               "dropping its vote")
+        return self._finish_members(finishers, n)
+
+    def _finish_members(self, finishers: list, n: int):
+        """The shared gather half of both dispatch paths: per-member
+        fault isolation, numeric pre-averaging, ``__members__``
+        envelopes for non-numeric votes."""
 
         def finish() -> list:
             member_preds = []
@@ -129,7 +183,7 @@ class _PackedEnsemble:
                 raise RuntimeError("every packed ensemble member failed")
             self.last_weight = len(member_preds)
             out = []
-            for i in range(len(queries)):
+            for i in range(n):
                 votes = [p[i] for p in member_preds]
                 try:
                     arr = np.asarray(votes, dtype=np.float64)
@@ -155,6 +209,36 @@ class _PackedEnsemble:
     def destroy(self) -> None:
         for m in self.models:
             m.destroy()
+
+
+class _HostStager:
+    """Reusable host staging buffers, TWO per ``(bucket, shape,
+    dtype)`` — allocated on first use, reused across bursts forever
+    (bounded: buckets are the model's power-of-two ladder, dtypes the
+    staged vocabulary, shapes the served models' input shapes). Rows
+    past a burst's count keep stale bytes on purpose; the compiled
+    predict slices their outputs away, and re-zeroing would be exactly
+    the per-burst copy this buffer exists to avoid.
+
+    Double-buffered because of the one-burst-in-flight overlap:
+    ``jax.device_put`` may still be reading burst N's buffer when
+    burst N+1 is staged (the transfer is async), so successive bursts
+    alternate buffers. Two is exactly enough — ``_complete_batch(N)``
+    (a full result sync, which fences N's input transfer) always runs
+    before burst N+2 is staged."""
+
+    def __init__(self):
+        self._bufs: dict = {}
+
+    def buffer(self, bucket: int, shape: tuple, dtype) -> Any:
+        key = (bucket, tuple(shape), np.dtype(dtype).str)
+        entry = self._bufs.get(key)
+        if entry is None:
+            entry = [np.empty((bucket, *shape), dtype),
+                     np.empty((bucket, *shape), dtype), 0]
+            self._bufs[key] = entry
+        entry[2] ^= 1
+        return entry[entry[2]]
 
 
 class InferenceWorker:
@@ -208,6 +292,20 @@ class InferenceWorker:
         # degrades gracefully: no confidence ⇒ every query escalates.
         self.send_confidence = float(os.environ.get(
             "RAFIKI_TPU_SERVING_TIER_THRESHOLD", "0") or 0) > 0
+        # Packed-wire capability, snapshotted at construction
+        # (NodeConfig.serving_packed_wire; "on" advertises ndbatch1 in
+        # the bus registration — "compat"/"off" keep this worker on the
+        # per-query format, the mixed-fleet/rollback story).
+        self._wire_formats = ([WIRE_NDBATCH]
+                              if _wire.packed_wire_mode() == "on" else [])
+        # Serving quantization request (NodeConfig.serving_quant).
+        # Applied at model-load time — so the worker a promotion spawns
+        # recomputes the incoming bin's scales by construction — and
+        # only where the model supports it; _quant_active reflects what
+        # actually happened and rides the registration.
+        self._quant_req = _wire.quant_mode()
+        self._quant_active = False
+        self._stager = _HostStager()
         # Broker-REPORTED op failures (BusOpError) this many times in a
         # row — with zero successful iterations in between — mean
         # protocol skew, not an outage: the serve loop escalates to
@@ -260,6 +358,19 @@ class InferenceWorker:
             model = model_class(
                 **model_class.validate_knobs(trial["knobs"]))
             model.load_parameters(self.params.load(trial["params_id"]))
+            if self._quant_req:
+                enable = getattr(model, "enable_serving_quant", None)
+                if enable is None:
+                    _log.warning(
+                        "trial %s: %s has no serving quantization; "
+                        "serving f32", tid, type(model).__name__)
+                else:
+                    report = enable(self._quant_req)
+                    self._quant_active = True
+                    _log.info(
+                        "trial %s quantized for serving: mode=%s "
+                        "int8=%d f32-fallback=%d", tid, report["mode"],
+                        report.get("n_int8", 0), report.get("n_f32", 0))
             models.append(model)
         # The bin's tracked eval score (max over packed members) rides
         # the bus registration so the Predictor's tiered path can rank
@@ -301,10 +412,19 @@ class InferenceWorker:
             # bench record in particular — can tell which serving mode
             # was actually measured (r4 verdict: the auto decision was
             # logged but unrecoverable from the bench artifact).
+            # "wire" is the packed-format negotiation: only workers
+            # that LIST ndbatch1 ever receive packed frames, so an old
+            # worker (no key) and a compat-mode one are
+            # indistinguishable to the predictor — both keep the
+            # per-query format. "quant" records what this worker
+            # actually serves (bench/debug evidence, not negotiation).
             self._reg_info = {"trial_id": self.trial_id,
                               "pipeline": bool(self.pipeline),
                               "sync_latency_ms": sync_ms,
-                              "score": self._bin_score}
+                              "score": self._bin_score,
+                              "wire": self._wire_formats,
+                              "quant": (self._quant_req
+                                        if self._quant_active else None)}
             self.cache.register_worker(self.inference_job_id,
                                        self.service_id,
                                        info=self._reg_info)
@@ -426,10 +546,20 @@ class InferenceWorker:
     def _dispatch_batch(self, items: list):
         """Flatten a burst into ONE chip-side predict dispatch; returns
         (finisher, spans, n, trace_ctxs, t0) for ``_complete_batch``. A
-        burst may mix batch frames and single-query frames; their trace
-        envelopes (absent on old frames) are popped here so the span
-        covering this burst's device time lands in the span log under
-        every trace id the burst carried."""
+        burst may mix packed batch frames, per-query batch frames, and
+        single-query frames; their trace envelopes (absent on old
+        frames) are popped here so the span covering this burst's
+        device time lands in the span log under every trace id the
+        burst carried.
+
+        An all-packed burst of one shape/dtype takes the STAGED fast
+        path: frames are copied (one memcpy each) into the reusable
+        host staging buffer and dispatched via the model's
+        ``predict_staged_submit`` — no per-query objects, no
+        ``np.stack``, no pad-``concatenate``. Anything else (mixed
+        formats, differing shapes, models without a staged entry) falls
+        back to the flat per-query path, with packed frames unrolled
+        into row views."""
         import time as _time
 
         if self._fault is not None:
@@ -439,23 +569,84 @@ class InferenceWorker:
             # calls, so n= targets an exact burst.
             self._fault(op="predict")
         trace_ctxs = trace.extract_frames(items)
-        flat: list = []
-        spans: list = []  # (item, start, count, is_batch)
+        # Corrupt packed frames (pop_queries left batch=None +
+        # batch_error) are answered IMMEDIATELY with per-query error
+        # dicts — a bad producer poisons its own frame, never the
+        # burst's co-batched queries, and never the worker.
+        good = []
         for it in items:
-            if "queries" in it:
-                spans.append((it, len(flat), len(it["queries"]), True))
-                flat.extend(it["queries"])
+            if "batch" in it and it["batch"] is None:
+                err = {"error": f"ValueError: "
+                                f"{it.get('batch_error', 'corrupt packed frame')}"}
+                self.cache.send_prediction_batch(
+                    it["batch_id"], self.service_id,
+                    [err] * max(1, int(it.get("n", 1) or 1)),
+                    shard=it.get("shard"))
             else:
-                spans.append((it, len(flat), 1, False))
-                flat.append(it["query"])
-        try:
-            finisher = self._model.predict_submit(flat)
-        except Exception as e:
-            _log.exception("predict dispatch failed on batch of %d",
-                           len(flat))
-            err = {"error": f"{type(e).__name__}: {e}"}
-            finisher = lambda n=len(flat): [err] * n  # noqa: E731
-        return (finisher, spans, len(flat), trace_ctxs,
+                good.append(it)
+        finisher = None
+        spans: list = []  # (item, start, count, is_batch)
+        n = 0
+        arrays = [it["batch"] for it in good
+                  if isinstance(it.get("batch"), np.ndarray)]
+        if arrays and len(arrays) == len(good):
+            first = arrays[0]
+            total = sum(a.shape[0] for a in arrays)
+            bucket = None
+            if all(a.shape[1:] == first.shape[1:]
+                   and a.dtype == first.dtype for a in arrays[1:]):
+                bucket_fn = getattr(self._model, "predict_bucket", None)
+                if bucket_fn is not None:
+                    bucket = bucket_fn(total, first.dtype)
+            if bucket is not None:
+                buf = self._stager.buffer(bucket, first.shape[1:],
+                                          first.dtype)
+                start = 0
+                for it, a in zip(good, arrays):
+                    spans.append((it, start, a.shape[0], True))
+                    buf[start:start + a.shape[0]] = a
+                    start += a.shape[0]
+                # The staging fill is ONE bulk memcpy per frame —
+                # counted per row ("assemble") so the packed side's
+                # copy evidence stays symmetric with the legacy
+                # per-query stack count.
+                _wire.count_copies("assemble", total)
+                n = total
+                try:
+                    finisher = self._model.predict_staged_submit(buf,
+                                                                 total)
+                except Exception as e:
+                    _log.exception("staged predict dispatch failed on "
+                                   "batch of %d", total)
+                    err = {"error": f"{type(e).__name__}: {e}"}
+                    finisher = lambda k=total: [err] * k  # noqa: E731
+        if finisher is None:
+            flat: list = []
+            spans = []
+            for it in good:
+                if isinstance(it.get("batch"), np.ndarray):
+                    a = it["batch"]
+                    spans.append((it, len(flat), a.shape[0], True))
+                    flat.extend(a[i] for i in range(a.shape[0]))
+                elif "queries" in it:
+                    spans.append((it, len(flat), len(it["queries"]),
+                                  True))
+                    flat.extend(it["queries"])
+                else:
+                    spans.append((it, len(flat), 1, False))
+                    flat.append(it["query"])
+            n = len(flat)
+            if not flat:
+                finisher = lambda: []  # noqa: E731 - all-corrupt burst
+            else:
+                try:
+                    finisher = self._model.predict_submit(flat)
+                except Exception as e:
+                    _log.exception("predict dispatch failed on batch "
+                                   "of %d", n)
+                    err = {"error": f"{type(e).__name__}: {e}"}
+                    finisher = lambda k=n: [err] * k  # noqa: E731
+        return (finisher, spans, n, trace_ctxs,
                 (_time.time(), _time.monotonic()))
 
     def _complete_batch(self, finisher, spans: list, n: int,
@@ -477,6 +668,8 @@ class InferenceWorker:
                 burst_s,
                 attrs={"n_queries": n, "trial_id": str(self.trial_id)})
         weight = int(getattr(self._model, "last_weight", 1))
+        if self._quant_active:
+            _wire.count_quant(n, self._quant_req)
         # Per-query confidence (softmax margin; None for sk-style
         # outputs) rides batch replies for the Predictor's tiered
         # escalation — computed ONLY when tiering is on (see

@@ -32,3 +32,28 @@ def train(key, steps):
         # same statement that donates it.
         state, loss = dispatch(state, data_dev, labels_dev)
     return state, loss
+
+
+_BUFS = {}
+
+
+def staging_buffer(bucket, shape):
+    """The r13 predict_into shape: the helper's NAME matches the taint
+    regex ("staging"), so its callers are tainted — but the buffer only
+    ever rides NON-donated predict calls, which must not fire."""
+    buf = _BUFS.get((bucket, shape))
+    if buf is None:
+        buf = _BUFS[(bucket, shape)] = bytearray(bucket)
+    return buf
+
+
+def fresh_rows(bucket, shape):
+    """Neutral name + return-taint via the staging helper: tainted by
+    the r13 pass, also only ever at non-donated positions."""
+    return staging_buffer(bucket, shape)
+
+
+def predict_staged(state, bucket):
+    buf = fresh_rows(bucket, (8, 8))
+    out = train_chunk(state, buf, [0])  # buf at NON-donated position 1
+    return out

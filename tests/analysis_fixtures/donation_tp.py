@@ -34,3 +34,26 @@ def use_after_donate():
     state = object()
     out = train_chunk(state, [1], [0])          # donates state...
     return state, out                           # <- RTA402 (state read)
+
+
+def grab(key):
+    """Defined BEFORE its callee on purpose: a depth-3 chain in
+    worst-case source order only resolves under a true fixpoint."""
+    return fetch_resident(key)  # helper-calls-helper chain
+
+
+def fetch_resident(key):
+    """Neutral name: no stage/cache in it — taint must flow through
+    the RETURN (r13)."""
+    return hold(key)
+
+
+def hold(key):
+    return _STAGE_CACHE[key]
+
+
+def train_via_helper(key):
+    resident = grab(key)
+    state = object()
+    state = train_chunk(state, resident, [0])   # <- RTA401 (pos 1)
+    return state
