@@ -424,6 +424,46 @@ def _emit(metric: str, value: float, unit: str, **extra) -> dict:
     return rec
 
 
+def _http_predict_buckets(host: str, http_service: str) -> dict:
+    """Cumulative /predict latency buckets {le: count} from one
+    predictor frontend's own exposition — snapshot-diffable. The ONE
+    copy every A/B config (zipf, serving-concurrent, autoscale)
+    scrapes with, so label/+Inf handling cannot drift between them."""
+    import requests
+
+    from rafiki_tpu.observe.metrics import parse_exposition
+
+    metrics = parse_exposition(
+        requests.get(f"http://{host}/metrics", timeout=30).text)
+    out = {}
+    for labels, v in metrics.get(
+            "rafiki_tpu_http_request_seconds_bucket", []):
+        if labels.get("service") != http_service or \
+                labels.get("route") != "/predict":
+            continue
+        le = labels.get("le")
+        bound = float("inf") if le == "+Inf" else float(le)
+        out[bound] = out.get(bound, 0) + int(v)
+    return out
+
+
+def _bucket_delta_percentiles_ms(before: dict, after: dict,
+                                 qs=(0.5, 0.95, 0.99)):
+    """Percentiles (ms) of only the observations BETWEEN two bucket
+    snapshots (cumulative-bucket deltas stay cumulative)."""
+    from rafiki_tpu.observe.metrics import bucket_percentile
+
+    deltas = sorted((le, after.get(le, 0) - before.get(le, 0))
+                    for le in after)
+    if not deltas or deltas[-1][1] <= 0:
+        return None
+    out = []
+    for q in qs:
+        v = bucket_percentile(deltas, q)
+        out.append(round(v * 1e3, 3) if v is not None else None)
+    return out
+
+
 def main_serving() -> dict:
     """Config[3]: ensemble QPS through Predictor HTTP + workers."""
     import tempfile
@@ -877,8 +917,7 @@ def _serving_zipf_ab(workload: str) -> dict:
     from rafiki_tpu.config import NodeConfig
     from rafiki_tpu.constants import BudgetOption, TaskType, UserType
     from rafiki_tpu.model import load_image_dataset
-    from rafiki_tpu.observe.metrics import (bucket_percentile,
-                                            parse_exposition)
+    from rafiki_tpu.observe.metrics import parse_exposition
     from rafiki_tpu.platform import LocalPlatform
 
     parts = workload.split(":")
@@ -903,30 +942,8 @@ def _serving_zipf_ab(workload: str) -> dict:
         r.raise_for_status()
         return inf["id"], host
 
-    def http_buckets(host, http_service):
-        metrics = parse_exposition(
-            requests.get(f"http://{host}/metrics", timeout=30).text)
-        out = {}
-        for labels, v in metrics.get(
-                "rafiki_tpu_http_request_seconds_bucket", []):
-            if labels.get("service") != http_service or \
-                    labels.get("route") != "/predict":
-                continue
-            le = labels.get("le")
-            bound = float("inf") if le == "+Inf" else float(le)
-            out[bound] = out.get(bound, 0) + int(v)
-        return out
-
-    def delta_percentiles_ms(before, after, qs=(0.5, 0.95, 0.99)):
-        deltas = sorted((le, after.get(le, 0) - before.get(le, 0))
-                        for le in after)
-        if not deltas or deltas[-1][1] <= 0:
-            return None
-        out = []
-        for q in qs:
-            v = bucket_percentile(deltas, q)
-            out.append(round(v * 1e3, 3) if v is not None else None)
-        return out
+    http_buckets = _http_predict_buckets
+    delta_percentiles_ms = _bucket_delta_percentiles_ms
 
     def zipf_window(url, frames, probs, seed, duration=None):
         counts = [0] * n_clients
@@ -1161,8 +1178,7 @@ def main_serving_concurrent() -> dict:
     from rafiki_tpu.config import NodeConfig
     from rafiki_tpu.constants import BudgetOption, TaskType, UserType
     from rafiki_tpu.model import load_image_dataset
-    from rafiki_tpu.observe.metrics import (bucket_percentile,
-                                            histogram_percentiles_ms,
+    from rafiki_tpu.observe.metrics import (histogram_percentiles_ms,
                                             parse_exposition)
     from rafiki_tpu.platform import LocalPlatform
 
@@ -1204,33 +1220,9 @@ def main_serving_concurrent() -> dict:
         return inf["id"], host
 
     def http_buckets(host, stats):
-        """Cumulative /predict latency buckets {le: count} from the
-        predictor's own exposition — snapshot-diffable."""
-        metrics = parse_exposition(
-            requests.get(f"http://{host}/metrics", timeout=30).text)
-        out = {}
-        for labels, v in metrics.get(
-                "rafiki_tpu_http_request_seconds_bucket", []):
-            if labels.get("service") != stats.get("http_service") or \
-                    labels.get("route") != "/predict":
-                continue
-            le = labels.get("le")
-            bound = float("inf") if le == "+Inf" else float(le)
-            out[bound] = out.get(bound, 0) + int(v)
-        return out
+        return _http_predict_buckets(host, stats.get("http_service"))
 
-    def delta_percentiles_ms(before, after, qs=(0.5, 0.95, 0.99)):
-        """Percentiles of only the observations BETWEEN two bucket
-        snapshots (cumulative-bucket deltas stay cumulative)."""
-        deltas = sorted((le, after.get(le, 0) - before.get(le, 0))
-                        for le in after)
-        if not deltas or deltas[-1][1] <= 0:
-            return None
-        out = []
-        for q in qs:
-            v = bucket_percentile(deltas, q)
-            out.append(round(v * 1e3, 3) if v is not None else None)
-        return out
+    delta_percentiles_ms = _bucket_delta_percentiles_ms
 
     def trickle_round(url, queries, k):
         """Low offered load: sequential single-REAL-query requests
@@ -2089,6 +2081,277 @@ def main_chaos() -> dict:
         if ops_off else None)
 
 
+def main_autoscale() -> dict:
+    """Config[autoscale]: the closed serving control loop, A/B'd
+    (docs/autoscaling.md). Not a sweep member — like chaos it builds,
+    ramps, and rescales its own stack.
+
+    One scenario, run twice at EQUAL initial capacity: a trained 2-bin
+    ensemble (1 chip per bin), an idle-ish "donor" train job burning 2
+    chips on a 4-chip node with time-sliced sharing OFF — zero free
+    exclusive chips, so the FIRST starved scale-up must preempt the
+    donor — and a ramped closed-loop load (2 -> 6 -> 16 clients)
+    against a small admission queue. The OFF side runs
+    FIRST and its registry is asserted to expose ZERO autoscale series;
+    the ON side runs the autoscaler on a 0.5 s supervise cadence.
+    Judged on counter deltas (the r9 discipline): scale-up actions
+    taken, chips reclaimed from the idle donor, and backpressure 429s
+    — the ON side must reject STRICTLY fewer under the same ramp
+    (replicas + the reclaimed chip drain the queue the OFF side can
+    only bounce). Per-phase p50/p99 from the predictor's own http
+    histogram is the latency story; on this 1-core box the honest
+    throughput ratio needs the multi-chip channel, but preemption is
+    real compute here — time-sliced silicon means a reclaimed chip IS
+    reclaimed CPU. A flapping-guard (oscillation inside the hysteresis
+    band produces zero actions) is pinned as a unit test in
+    tests/test_autoscaler.py.
+    """
+    import tempfile
+    import threading
+
+    import requests
+
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.config import NodeConfig
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.observe.metrics import registry
+    from rafiki_tpu.platform import LocalPlatform
+
+    phases = [(2, 5.0), (6, 8.0), (16, 14.0)]  # (clients, seconds)
+    batch_n = 4
+
+    # A deliberately tight admission bound: the ramp must OVERFLOW it
+    # (the 429s are the judged signal), and the queue must drain batch
+    # by batch so the drain rate — what the autoscaler improves — is
+    # what decides how often it overflows.
+    knob_env = {
+        "RAFIKI_TPU_CHIP_SHARE": "0",
+        NodeConfig.env_name("serving_queue_cap"): "12",
+        NodeConfig.env_name("serving_max_batch"): "8",
+        NodeConfig.env_name("serving_max_inflight"): "1",
+        NodeConfig.env_name("autoscale_up_cooldown_s"): "1.0",
+        NodeConfig.env_name("autoscale_down_cooldown_s"): "120.0",
+        NodeConfig.env_name("autoscale_max_replicas"): "3",
+        NodeConfig.env_name("autoscale_idle_sweeps"): "2",
+        # The donor's tiny trials measure ~0.001-0.1 MFU against the
+        # calibrated-CPU peak; 0.3 classifies that low-utilization
+        # training as preemptible with margin while a genuinely busy
+        # job (the contract the unit tests pin) would not be.
+        NodeConfig.env_name("autoscale_mfu_floor"): "0.3",
+    }
+    auto_env = NodeConfig.env_name("autoscale")
+
+    http_buckets = _http_predict_buckets
+
+    def delta_p(before, after):
+        return _bucket_delta_percentiles_ms(before, after,
+                                            qs=(0.5, 0.99))
+
+    def donor_train_workers(plat, job_id):
+        from rafiki_tpu.constants import ServiceType
+
+        n = 0
+        for sub in plat.meta.get_sub_train_jobs(job_id):
+            for w in plat.meta.get_train_job_workers(sub["id"]):
+                svc = plat.meta.get_service(w["service_id"])
+                if svc["service_type"] == ServiceType.TRAIN and \
+                        svc["status"] in ("STARTED", "DEPLOYING",
+                                          "RUNNING"):
+                    n += 1
+        return n
+
+    def ramp(url, batch, counts):
+        """The shared load shape: closed-loop clients per phase, each
+        posting 4-query requests; a 429 backs off 50 ms and counts.
+        Per-client count SLOTS, folded after join (the zipf config's
+        pattern): `counts[k] += 1` from 16 threads is a lost-update
+        race on the judged A/B metric."""
+        for n_clients, dur in phases:
+            stop = threading.Event()
+            errors: list = []
+            rejected = [0] * n_clients
+            served = [0] * n_clients
+
+            def client(i: int) -> None:
+                session = requests.Session()
+                try:
+                    while not stop.is_set():
+                        r = session.post(url, json={"queries": batch},
+                                         timeout=300)
+                        if r.status_code == 429:
+                            rejected[i] += 1
+                            time.sleep(0.05)
+                        else:
+                            r.raise_for_status()
+                            served[i] += batch_n
+                except Exception as e:  # surfaced by the caller
+                    errors.append(e)
+                    stop.set()
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            time.sleep(dur)
+            stop.set()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(f"ramp client failed: {errors[0]}")
+            counts["429"] += sum(rejected)
+            counts["served"] += sum(served)
+
+    def run_side(autoscale_on: bool) -> dict:
+        prior = {k: os.environ.get(k) for k in
+                 list(knob_env) + [auto_env]}
+        os.environ.update(knob_env)
+        if autoscale_on:
+            os.environ[auto_env] = "1"
+        else:
+            os.environ.pop(auto_env, None)
+        side: dict = {"429": 0, "served": 0}
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                train_path, val_path = \
+                    make_synthetic_image_dataset_compat(
+                        tmp, n_train=2048, n_val=256)
+                plat = LocalPlatform(
+                    workdir=f"{tmp}/plat", http=True,
+                    supervise_interval=0.5 if autoscale_on else 0)
+                try:
+                    admin = plat.admin
+                    u = admin.create_user("as@x.c", "pw",
+                                          UserType.MODEL_DEVELOPER)
+                    mdl = admin.create_model(
+                        u["id"], "ff-as", TaskType.IMAGE_CLASSIFICATION,
+                        "rafiki_tpu.models.feedforward:JaxFeedForward")
+                    job = admin.create_train_job(
+                        u["id"], "as", TaskType.IMAGE_CLASSIFICATION,
+                        [mdl["id"]],
+                        {BudgetOption.MODEL_TRIAL_COUNT: 2},
+                        train_path, val_path)
+                    assert admin.wait_until_train_job_done(job["id"],
+                                                           timeout=1200)
+                    donor = admin.create_train_job(
+                        u["id"], "as-donor",
+                        TaskType.IMAGE_CLASSIFICATION, [mdl["id"]],
+                        {BudgetOption.MODEL_TRIAL_COUNT: 100000,
+                         BudgetOption.CHIP_COUNT: 2},
+                        train_path, val_path)
+                    inf = admin.create_inference_job(u["id"], job["id"],
+                                                     max_models=2)
+                    cache = Cache(plat.bus)
+                    deadline = time.time() + 600
+                    while len(cache.running_workers(inf["id"])) < 2 \
+                            and time.time() < deadline:
+                        time.sleep(0.5)
+                    assert len(cache.running_workers(inf["id"])) >= 2
+                    host = admin.get_inference_job(
+                        inf["id"])["predictor_host"]
+                    url = f"http://{host}/predict"
+                    val = load_image_dataset(val_path)
+                    batch = [encode_payload(val.images[i])
+                             for i in range(batch_n)]
+                    requests.post(url, json={"queries": batch},
+                                  timeout=300).raise_for_status()
+                    stats = requests.get(f"http://{host}/stats",
+                                         timeout=30).json()
+                    before = http_buckets(host, stats["http_service"])
+                    side["replicas_before"] = len(
+                        plat.services.active_inference_workers(
+                            inf["id"]))
+                    side["donor_workers_before"] = \
+                        donor_train_workers(plat, donor["id"])
+                    ramp(url, batch, side)
+                    time.sleep(2.0)  # quiet tail (decisions settle)
+                    side["latency_ms_p50_p99"] = delta_p(
+                        before, http_buckets(host,
+                                             stats["http_service"]))
+                    side["replicas_after"] = len(
+                        plat.services.active_inference_workers(
+                            inf["id"]))
+                    side["donor_workers_after"] = \
+                        donor_train_workers(plat, donor["id"])
+                    if autoscale_on:
+                        snap = admin.get_autoscale()
+                        side["decisions"] = [
+                            {k: d.get(k) for k in
+                             ("epoch", "action", "reason", "bin",
+                              "target")}
+                            for d in snap["decisions"]][:32]
+                        c = registry().find(
+                            "rafiki_tpu_autoscale_actions_total")
+                        side["actions"] = {
+                            f"{lab['action']}:{lab['reason']}": int(v)
+                            for lab, v in (c.samples() if c else [])}
+                        r = registry().find(
+                            "rafiki_tpu_autoscale_reclaimed_chips_total")
+                        side["chips_reclaimed"] = \
+                            int(r.value()) if r else 0
+                    else:
+                        # The disabled side must have registered ZERO
+                        # autoscale series (it runs FIRST, so the
+                        # process registry cannot have been fed by the
+                        # ON side).
+                        side["autoscale_series"] = sum(
+                            len(m.samples()) for m in
+                            (registry().find(n) for n in (
+                                "rafiki_tpu_autoscale_actions_total",
+                                "rafiki_tpu_autoscale_target_replicas",
+                                "rafiki_tpu_autoscale_actual_replicas",
+                                "rafiki_tpu_autoscale_reclaimed_"
+                                "chips_total"))
+                            if m is not None)
+                        assert side["autoscale_series"] == 0, side
+                    admin.stop_train_job(donor["id"])
+                    admin.stop_inference_job(inf["id"])
+                finally:
+                    plat.shutdown()
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return side
+
+    off = run_side(False)
+    on = run_side(True)
+
+    # The acceptance gates: the control loop must have acted, reclaimed
+    # idle training compute, and strictly reduced backpressure.
+    scale_ups = sum(v for k, v in on.get("actions", {}).items()
+                    if k.startswith("scale_up:"))
+    assert scale_ups >= 1, on.get("actions")
+    assert on.get("chips_reclaimed", 0) >= 1, on.get("actions")
+    assert on["donor_workers_after"] < on["donor_workers_before"], on
+    assert on["429"] < off["429"], (on["429"], off["429"])
+    assert off["autoscale_series"] == 0
+
+    avoided = off["429"] - on["429"]
+    return _emit(
+        "autoscale_backpressure_avoided", avoided, "rejections",
+        ramp_phases=[{"clients": c, "seconds": s} for c, s in phases],
+        queries_per_request=batch_n,
+        backpressure_429_on=on["429"],
+        backpressure_429_off=off["429"],
+        served_on=on["served"], served_off=off["served"],
+        latency_ms_p50_p99_on=on["latency_ms_p50_p99"],
+        latency_ms_p50_p99_off=off["latency_ms_p50_p99"],
+        replicas_on=[on["replicas_before"], on["replicas_after"]],
+        replicas_off=[off["replicas_before"], off["replicas_after"]],
+        donor_workers_on=[on["donor_workers_before"],
+                          on["donor_workers_after"]],
+        donor_workers_off=[off["donor_workers_before"],
+                           off["donor_workers_after"]],
+        scale_up_actions=scale_ups,
+        actions=on.get("actions", {}),
+        chips_reclaimed=on.get("chips_reclaimed", 0),
+        decisions=on.get("decisions", []),
+        off_new_series=off["autoscale_series"])
+
+
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
                                         image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
@@ -2122,6 +2385,11 @@ _CONFIGS = {
     # serving stack (seeded fault plan -> recovery loop); its value is
     # availability + time-to-full-recovery, not throughput.
     "chaos": (main_chaos, "chaos_availability", "fraction"),
+    # Not in _SWEEP_ORDER: an A/B experiment that rescales its own
+    # stack under a ramp (autoscaler on/off at equal initial
+    # capacity); judged on counter deltas, not a throughput figure.
+    "autoscale": (main_autoscale, "autoscale_backpressure_avoided",
+                  "rejections"),
 }
 
 
@@ -2237,10 +2505,15 @@ def _main_cli() -> None:
         # respawn while the just-finished train worker may still hold
         # its chip — on a 1-device box the second bin would never
         # launch and the recovery loop would have nothing to restore.
+        # autoscale gets exactly 4: 2 serving bins + 2 donor train
+        # workers at exclusive placement = ZERO free chips, so the
+        # FIRST starved scale-up preempts the idle donor (the judged
+        # causal chain, with minimal mid-ramp compile churn).
         ensure_platform(n_virtual_devices=(
             (4 if _WORKLOAD else 2)
             if args.config == "serving-concurrent"
-            else 3 if args.config == "chaos" else None))
+            else 3 if args.config == "chaos"
+            else 4 if args.config == "autoscale" else None))
         import jax
 
         platform = jax.default_backend()

@@ -622,6 +622,16 @@ class Admin:
                 "resident": resident, "phases": phases,
                 "caches": caches}
 
+    def get_autoscale(self) -> Dict[str, Any]:
+        """The autoscaler's decision ring + per-bin targets (the
+        ``GET /autoscale`` body; docs/autoscaling.md). Disabled nodes
+        answer ``enabled: false`` — the dashboard renders the panel
+        only when the loop is actually closed."""
+        scaler = getattr(self.services, "autoscaler", None)
+        if scaler is None:
+            return {"enabled": False}
+        return scaler.snapshot()
+
     def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
         return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
 

@@ -25,6 +25,10 @@ route-compatible so reference quickstart scripts port 1:1:
                                      invalidates the predictor edge
                                      cache before returning
 - ``GET  /trace/<trace_id>``         stitched span timeline of one trace
+- ``GET  /autoscale``                autoscaler decision ring + per-bin
+                                     replica targets (``enabled: false``
+                                     on nodes without the control loop;
+                                     see docs/autoscaling.md)
 - ``GET  /trial_phases``             trial-lifecycle phase breakdown +
                                      residency-cache counters (resident
                                      workers only; see docs/training.md)
@@ -81,6 +85,7 @@ class AdminApp:
             ("POST", "/users/<user_id>/ban", self._ban_user),
             ("GET", "/status", self._status),
             ("GET", "/trial_phases", self._trial_phases),
+            ("GET", "/autoscale", self._autoscale),
             ("POST", "/datasets", self._create_dataset),
             ("GET", "/datasets", self._list_datasets),
             ("GET", "/services", self._list_services),
@@ -231,6 +236,10 @@ class AdminApp:
     def _trial_phases(self, params, body, ctx):
         self._auth(ctx)
         return 200, self.admin.get_trial_phases()
+
+    def _autoscale(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_autoscale()
 
     def _create_dataset(self, params, body, ctx):
         claims = self._auth(ctx, *_WRITE_TYPES)

@@ -95,6 +95,11 @@ class ServicesManager:
         # never see them again — each sweep retries these until a
         # replica lands or the job stops.
         self._pending_respawns: List[Dict[str, Any]] = []
+        # Metrics-driven autoscaler (admin/autoscaler.py), attached by
+        # the platform ONLY when RAFIKI_TPU_AUTOSCALE is on. None (the
+        # default) keeps supervise byte-identical: one attribute check,
+        # zero new series.
+        self.autoscaler = None
 
     # --- Launch plumbing ---
 
@@ -538,6 +543,56 @@ class ServicesManager:
             stopped.append(sid)
         return {"new_service": new_svc, "stopped_service_ids": stopped}
 
+    def drain_inference_worker(self, service_id: str,
+                               drain_timeout: float = 15.0,
+                               ) -> Dict[str, Any]:
+        """Gracefully retire ONE inference replica (the autoscaler's
+        scale-down primitive): deregister it from the bus so the
+        Predictor's next registry scan stops planning shards onto it,
+        push a ``__drain__`` marker onto its query queue so the worker
+        finishes everything already enqueued and exits its serve loop
+        cleanly (re-asserting its registration lease no longer matters
+        — the final unregister on exit is authoritative), then stop
+        the service and release its chips.
+
+        Shards a still-in-flight plan pushes AFTER the marker go
+        unanswered; the Predictor's straggler resubmit covers them
+        from a sibling — the exact machinery replica death already
+        exercises, minus the death. A worker that does not exit within
+        ``drain_timeout`` (wedged on a long burst) is stopped hard;
+        either way the row ends STOPPED and the chips come back.
+        Returns ``{"drained": bool}`` (False = the hard-stop path).
+        """
+        import time as _time
+
+        from ..cache import Cache as _BusCache
+
+        rows = self.meta._select(
+            "SELECT * FROM inference_job_workers WHERE service_id = ?",
+            (service_id,))
+        drained = False
+        if rows:
+            try:
+                cache = _BusCache(self.serving_bus())
+                cache.unregister_worker(rows[0]["inference_job_id"],
+                                        service_id)
+                cache.send_drain(service_id)
+            except (ConnectionError, OSError, RuntimeError):
+                _log.warning("drain signalling for %s failed; hard "
+                             "stop", service_id[:8], exc_info=True)
+            else:
+                deadline = _time.monotonic() + drain_timeout
+                while _time.monotonic() < deadline:
+                    svc = self.meta.get_service(service_id)
+                    if svc is None or svc["status"] not in _ACTIVE:
+                        drained = True
+                        break
+                    _time.sleep(0.05)
+        # Idempotent finish: destroys the container handle and releases
+        # the chip group whether the worker exited cleanly or not.
+        self._stop_service(service_id)
+        return {"service_id": service_id, "drained": drained}
+
     def stop_inference_services(self, inference_job_id: str) -> None:
         for w in self.meta.get_inference_job_workers(inference_job_id):
             self._stop_service(w["service_id"])
@@ -631,6 +686,14 @@ class ServicesManager:
             # future RUNNING scan, so this queue is their only way
             # back into a bin.
             self._pending_respawns.extend(pending)
+        if self.autoscaler is not None:
+            # The serving control loop rides the supervise cadence
+            # (docs/autoscaling.md). Isolated: a scrape/actuation
+            # failure must not break dead-service recovery.
+            try:
+                self.autoscaler.sweep()
+            except Exception:
+                _log.exception("autoscale sweep failed")
         return restarted
 
     def _note_restart(self, svc: Dict[str, Any],

@@ -43,12 +43,12 @@ from ..core import Checker, Finding, RepoContext, register
 PREFIX = "rafiki_tpu_"
 
 SUBSYSTEMS = {"bus", "serving", "http", "train", "trial", "trace",
-              "node", "fault"}
+              "node", "fault", "autoscale"}
 
 # _total marks counters (Prometheus convention); everything else is the
 # physical unit of a gauge/histogram.
 UNITS = {"total", "seconds", "ratio", "bytes", "queries", "batches",
-         "info"}
+         "info", "replicas"}
 
 NAME_RE = re.compile(r"^rafiki_tpu_[a-z0-9]+(?:_[a-z0-9]+)+$")
 

@@ -58,6 +58,11 @@ WIRE_NDBATCH = "ndbatch1"
 #: an allocation attack.
 _CORRUPT_REPLY_CAP = 4096
 
+#: Graceful-drain marker frame key (ServicesManager.
+#: drain_inference_worker): a worker popping a frame with this key
+#: finishes the burst in hand and exits its serve loop cleanly.
+DRAIN_KEY = "__drain__"
+
 
 def encode_payload(value: Any) -> Any:
     """JSON-safe encoding; numpy arrays → base64 frames."""
@@ -186,6 +191,40 @@ class PackedBatch:
         return PackedBatch(np.ascontiguousarray(self.data[indices]))
 
 
+def pack_prediction_rows(predictions: List[Any],
+                         ) -> Optional[Dict[str, Any]]:
+    """One reply batch's dense prediction vectors as a single
+    ``__ndbatch__`` frame (the reply-direction packed wire, r14), or
+    None when the batch is not packable — mixed shapes, error dicts,
+    ``__members__`` envelopes, non-float outputs. Only 1-D FLOAT
+    vectors (class probabilities, the dominant dense reply) pack:
+    label/score outputs keep the per-query format so the ensemble's
+    majority-vote equality semantics never see a type change."""
+    if len(predictions) < 2:
+        return None
+    rows: List[np.ndarray] = []
+    shape = dtype = None
+    for p in predictions:
+        if isinstance(p, np.ndarray):
+            a = p
+        elif isinstance(p, (list, tuple)) and len(p) >= 2:
+            try:
+                a = np.asarray(p)
+            except (ValueError, TypeError):
+                return None
+        else:
+            return None
+        if a.ndim != 1 or a.shape[0] < 2 or a.dtype.kind != "f":
+            return None
+        if shape is None:
+            shape, dtype = a.shape, a.dtype
+        elif a.shape != shape or a.dtype != dtype:
+            return None
+        rows.append(a)
+    packed = PackedBatch.from_arrays(rows)
+    return packed.slice(0, packed.n) if packed is not None else None
+
+
 def decode_batch(value: Dict[str, Any]) -> np.ndarray:
     """Strict decode of one ``__ndbatch__`` frame into an ``(n,
     *shape)`` array — ONE base64 decode + ONE ``np.frombuffer`` view
@@ -249,6 +288,11 @@ def _payload_nbytes(value: Any) -> int:
         return 2 + sum(_payload_nbytes(v) for v in value)
     if isinstance(value, str):
         return len(value) + 2
+    # A JSON float serializes to ~17-19 chars (repr round-trip); the
+    # old flat 8 under-counted per-query float-list replies so badly
+    # that the packed reply frame "lost" on bytes it actually wins.
+    if isinstance(value, float):
+        return 18
     return 8
 
 
@@ -288,6 +332,14 @@ class Cache:
         # frontend (and by the micro-batcher's scatter/gather threads);
         # the deferred-reap list is the only mutable state.
         self._reap_lock = threading.Lock()
+        # Reply-direction packed wire (r14), construction-time snapshot
+        # like every other packed-mode read. "on" makes batch QUERY
+        # frames advertise `"rw": ["ndbatch1"]` — the worker may then
+        # answer with ONE packed reply frame instead of per-query
+        # payloads — and makes batch REPLIES from this side pack when
+        # the query advertised. Old predictors never set "rw", so a new
+        # worker never packs toward them; old workers ignore the key.
+        self._packed_wire_on = _wire.packed_wire_mode() == "on"
 
     def _reap_stale(self, now: float) -> None:
         with self._reap_lock:
@@ -324,6 +376,8 @@ class Cache:
             if item is None:
                 break
             item = decode(item)
+            if item is None:
+                continue  # decoder rejected it (corrupt packed reply)
             if timestamps:
                 item["_recv_mono"] = time.monotonic()
             out.append(item)
@@ -397,6 +451,8 @@ class Cache:
         if not pre_encoded:
             queries = [encode_payload(q) for q in queries]
         frame = {"batch_id": batch_id, "queries": queries}
+        if self._packed_wire_on:
+            frame["rw"] = [WIRE_NDBATCH]
         env = _trace_envelope(trace_ctxs)
         if env is not None:
             frame[_trace.ENVELOPE_KEY] = env
@@ -435,6 +491,8 @@ class Cache:
         frames = []
         for w in worker_ids:
             frame: Dict[str, Any] = {"batch_id": batch_id}
+            if self._packed_wire_on:
+                frame["rw"] = [WIRE_NDBATCH]
             if packed_frame is not None and w in packed_ok:
                 frame["batch"] = packed_frame
                 if counting:
@@ -487,6 +545,8 @@ class Cache:
         for worker_id, start, count, shard_id in shards:
             frame: Dict[str, Any] = {"batch_id": batch_id,
                                      "shard": shard_id}
+            if self._packed_wire_on:
+                frame["rw"] = [WIRE_NDBATCH]
             if packed is not None and worker_id in packed_ok:
                 frame["batch"] = packed.slice(start, count)
                 if counting:
@@ -509,10 +569,32 @@ class Cache:
                                   timeout: float = 5.0, reap: bool = True,
                                   timestamps: bool = False,
                                   ) -> List[Dict[str, Any]]:
-        """Collect up to ``n_workers`` per-worker batch replies."""
+        """Collect up to ``n_workers`` per-worker batch replies. A
+        packed reply (``"batch"``, negotiated via the query frame's
+        ``rw`` list) decodes with ONE base64+frombuffer into per-row
+        float vectors; a corrupt packed reply is DROPPED outright (the
+        decoder returns None and ``_gather`` skips it) so its shard
+        reads as genuinely unanswered — attaching it with empty
+        predictions would mark the shard answered, suppress the
+        straggler resubmit, and could supersede a healthy in-flight
+        retry."""
         def decode(item):
-            item["predictions"] = [decode_payload(p)
-                                   for p in item["predictions"]]
+            if "batch" in item:
+                try:
+                    arr = decode_batch(item.pop("batch"))
+                except ValueError:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "corrupt packed reply for batch %s dropped",
+                        batch_id, exc_info=True)
+                    return None
+                item["predictions"] = [arr[i]
+                                       for i in range(arr.shape[0])]
+                _wire.count_copies("decode", 1)
+            else:
+                item["predictions"] = [decode_payload(p)
+                                       for p in item["predictions"]]
             return item
 
         return self._gather(f"r:{batch_id}", n_workers, timeout, decode,
@@ -531,6 +613,15 @@ class Cache:
                 self._reap_later.append((time.monotonic(),
                                          f"r:{batch_id}"))
 
+    # --- Graceful drain (ServicesManager.drain_inference_worker) ---
+
+    def send_drain(self, worker_id: str) -> None:
+        """Queue a drain marker: the worker serves everything enqueued
+        BEFORE it, then exits its serve loop cleanly (unregistering on
+        the way out). Ordering is the queue's — no side channel, so
+        'let in-flight shards finish' is by construction."""
+        self.bus.push(f"q:{worker_id}", {DRAIN_KEY: 1})
+
     # --- Queries (InferenceWorker side) ---
 
     def pop_queries(self, worker_id: str, max_items: int = 0,
@@ -547,7 +638,9 @@ class Cache:
                                  timeout=timeout)
         counting = _wire.counting()
         for it in items:
-            if "batch" in it:
+            if DRAIN_KEY in it:
+                pass  # control marker; the worker's loop acts on it
+            elif "batch" in it:
                 raw = it["batch"]
                 try:
                     it["batch"] = decode_batch(raw)
@@ -589,7 +682,8 @@ class Cache:
                               predictions: List[Any], weight: int = 1,
                               shard: Optional[Any] = None,
                               confidence: Optional[List] = None,
-                              compute_s: Optional[float] = None) -> None:
+                              compute_s: Optional[float] = None,
+                              packed_ok: bool = False) -> None:
         """``shard`` echoes the query frame's shard id (when the frame
         carried one) so a sharded gather can match this reply to its
         plan entry; un-sharded frames reply without the key, which is
@@ -598,9 +692,29 @@ class Cache:
         device seconds for this slice) feed the Predictor's tiered
         escalation and chip-seconds-avoided estimate; old workers omit
         both, old predictors ignore both — skew degrades to the
-        pre-tier behavior, never a failed reply."""
-        frame = {"worker_id": worker_id, "weight": int(weight),
-                 "predictions": [encode_payload(p) for p in predictions]}
+        pre-tier behavior, never a failed reply.
+
+        ``packed_ok=True`` (the query frame advertised ``rw``) lets a
+        dense reply ride ONE ``__ndbatch__`` frame — one base64 encode
+        per reply batch instead of per-query payloads — gated on this
+        side's own packed mode being "on" (compat/off keep per-query
+        replies, the kill-switch story in both directions)."""
+        frame: Dict[str, Any] = {"worker_id": worker_id,
+                                 "weight": int(weight)}
+        packed_frame = None
+        if packed_ok and self._packed_wire_on:
+            packed_frame = pack_prediction_rows(predictions)
+        if packed_frame is not None:
+            frame["batch"] = packed_frame
+            if _wire.counting():
+                _wire.count_bytes("packed", "reply",
+                                  _payload_nbytes(packed_frame))
+        else:
+            frame["predictions"] = [encode_payload(p)
+                                    for p in predictions]
+            if _wire.counting():
+                _wire.count_bytes("perquery", "reply",
+                                  _payload_nbytes(frame["predictions"]))
         if shard is not None:
             frame["shard"] = shard
         if confidence is not None and any(c is not None
@@ -608,7 +722,4 @@ class Cache:
             frame["confidence"] = confidence
         if compute_s is not None:
             frame["compute_s"] = compute_s
-        if _wire.counting():
-            _wire.count_bytes("perquery", "reply",
-                              _payload_nbytes(frame["predictions"]))
         self.bus.push(f"r:{batch_id}", frame)

@@ -133,6 +133,44 @@ class NodeConfig:
     # f32-vs-int8 accuracy delta.
     serving_quant: str = ""
 
+    # --- Metrics-driven autoscaler (docs/autoscaling.md) ---
+    # Default OFF: supervise pays one attribute check, zero new metric
+    # series, byte-identical sweep behavior. On, the admin-side control
+    # loop scales inference replicas per bin from the predictors' own
+    # /metrics (backpressure, queue depth, p99) and preempts idle
+    # training for starved hot bins.
+    autoscale: bool = False
+    # Record would-have decisions (ring + counters) without actuating.
+    autoscale_dry_run: bool = False
+    # Per-bin replica ceiling and per-sweep scale-up step bound.
+    autoscale_max_replicas: int = 4
+    autoscale_step: int = 1
+    # Asymmetric cooldowns: scale up within seconds of pressure, scale
+    # down only after a long quiet spell (and never right after an up).
+    autoscale_up_cooldown_s: float = 10.0
+    autoscale_down_cooldown_s: float = 60.0
+    # Hysteresis band over queue_depth/queue_cap: >= high scales up,
+    # <= low (with zero backpressure) scales down, between holds.
+    autoscale_queue_high: float = 0.25
+    autoscale_queue_low: float = 0.02
+    # Optional /predict p99 high-water, milliseconds (0 = p99 not
+    # consulted by the policy; it is still recorded in decisions).
+    autoscale_p99_high_ms: float = 0.0
+    # Idle-train preemption: a sub-job whose MFU gauge sat below this
+    # floor for autoscale_idle_sweeps consecutive sweeps may be shrunk
+    # by one worker to feed a starved serving bin (re-grown when
+    # pressure subsides). 0 disables preemption — set 0 in subprocess
+    # deployments, where worker MFU is invisible to this registry.
+    autoscale_mfu_floor: float = 0.05
+    autoscale_idle_sweeps: int = 3
+
+    # Time-sliced tenancy cap: max co-owners per chip when shared
+    # placement is admitted (parallel/chips.py). Promoted from the
+    # env-only expert baseline (r14): the autoscaler's scale-up leans
+    # on time-sliced placement when the slice is full, which makes the
+    # cap a per-deployment sizing decision, not an incident knob.
+    max_chip_share: int = 4
+
     # InferenceWorker bus-registration lease cadence, seconds: the
     # registration is re-asserted at this period so a restarted broker
     # re-learns live workers (docs/robustness.md). Promoted from an
@@ -328,6 +366,27 @@ class NodeConfig:
                 f"''/int8")
         if self.worker_reregister <= 0:
             raise ValueError("worker_reregister must be positive")
+        if self.autoscale_max_replicas < 1 or self.autoscale_step < 1:
+            raise ValueError("autoscale_max_replicas and autoscale_step "
+                             "must be >= 1")
+        if self.autoscale_up_cooldown_s < 0 \
+                or self.autoscale_down_cooldown_s < 0:
+            raise ValueError("autoscale cooldowns must be >= 0")
+        if not (0.0 <= self.autoscale_queue_low
+                <= self.autoscale_queue_high <= 1.0):
+            raise ValueError("need 0 <= autoscale_queue_low <= "
+                             "autoscale_queue_high <= 1")
+        if self.autoscale_p99_high_ms < 0:
+            raise ValueError("autoscale_p99_high_ms must be >= 0 "
+                             "(0 = p99 not consulted)")
+        if self.autoscale_mfu_floor < 0:
+            raise ValueError("autoscale_mfu_floor must be >= 0 "
+                             "(0 disables preemption)")
+        if self.autoscale_idle_sweeps < 1:
+            raise ValueError("autoscale_idle_sweeps must be >= 1")
+        if self.max_chip_share < 1:
+            raise ValueError("max_chip_share must be >= 1 (1 = no "
+                             "time-sliced co-ownership)")
         if self.dataset_cache_bytes < 0 or self.stage_cache_bytes < 0:
             raise ValueError("dataset_cache_bytes and stage_cache_bytes "
                              "must be >= 0 (0 disables the cache)")
@@ -398,6 +457,26 @@ class NodeConfig:
             str(self.serving_tier_threshold)
         os.environ[self.env_name("worker_reregister")] = \
             str(self.worker_reregister)
+        # Autoscaler: the platform constructs the controller from these
+        # at startup (admin/autoscaler.py Autoscaler.from_env); the
+        # enable flag is popped when off so "absent = disabled" stays
+        # the contract for hand-launched children.
+        if self.autoscale:
+            os.environ[self.env_name("autoscale")] = "1"
+        else:
+            os.environ.pop(self.env_name("autoscale"), None)
+        os.environ[self.env_name("autoscale_dry_run")] = \
+            "1" if self.autoscale_dry_run else "0"
+        for f in ("autoscale_max_replicas", "autoscale_step",
+                  "autoscale_up_cooldown_s", "autoscale_down_cooldown_s",
+                  "autoscale_queue_high", "autoscale_queue_low",
+                  "autoscale_p99_high_ms", "autoscale_mfu_floor",
+                  "autoscale_idle_sweeps"):
+            os.environ[self.env_name(f)] = str(getattr(self, f))
+        # Read per allocate() call by the chip allocator (a layer that
+        # must work without a NodeConfig), so RTA505 tracks it by name.
+        os.environ[self.env_name("max_chip_share")] = \
+            str(self.max_chip_share)
         # Packed wire + quantization: Cache/Predictor/InferenceWorker
         # snapshot these at construction (observe.wire normalizes the
         # spellings); the quant knob pops when empty so a worker's

@@ -174,6 +174,47 @@ def staged_dataset_arrays(dataset_path: str, ds: ImageDataset, mesh):
     return data_dev, labels_dev
 
 
+def staged_token_ids(dataset_path: str, ds, mesh):
+    """Replicated device-resident int32 token stream for one
+    :class:`~rafiki_tpu.model.dataset.TokenDataset` on one mesh, cached
+    across trials in the SAME byte-budget LRU (and under the same
+    ``stage`` hit/miss/evict counters) as the image arrays — the r9
+    carried item, closed for the token/LM path. Keys carry a ``"token"``
+    tag so an image entry and a token entry of one file can never
+    collide. Eval 2..N of a sub-train-job then ships NO token data to
+    the device at all: windows are gathered in-graph from the resident
+    stream by device-computed iota indices (models/lm.py). The TRAIN
+    loop deliberately keeps cutting windows on the host — gathering
+    windows in-graph per step measured ~35x slower than the step
+    itself (see the comment in ``JaxTransformerLM.train``)."""
+    budget = _stage_cache_budget()
+    ids = ds.ids if ds.ids.dtype == np.int32 \
+        else ds.ids.astype(np.int32)
+    nbytes = int(ids.nbytes)
+    key = None
+    if budget > 0 and nbytes <= budget:
+        fp = getattr(ds, "fingerprint", None)
+        if fp is None:
+            try:
+                fp = dataset_fingerprint(dataset_path)
+            except OSError:
+                fp = None  # file vanished after load; stage uncached
+        if fp is not None:
+            key = ("token", fp,
+                   tuple(int(d.id) for d in mesh.devices.flat))
+    if key is not None:
+        entry = _STAGE_CACHE.get(key)
+        if entry is not None and not entry[0].is_deleted():
+            _phases.cache_event("stage", "hit")
+            return entry[0]
+        _phases.cache_event("stage", "miss")
+    ids_dev = jax.device_put(np.ascontiguousarray(ids),
+                             replicated(mesh))
+    if key is not None:
+        _STAGE_CACHE.put(key, (ids_dev,), nbytes, budget)
+    return ids_dev
+
+
 def step_cache_key(model: "BaseModel", kind: str, mesh, *parts: Any,
                    exclude: frozenset = frozenset()) -> Any:
     """The one cache-key convention for compiled steps, shared by every

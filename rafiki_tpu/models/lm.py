@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -53,7 +54,8 @@ from ..model import (CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob,
                      PolicyKnob)
 from ..model.base import BaseModel, Params
 from ..model.dataset import load_token_dataset
-from ..model.jax_model import (_step_cache_get, _step_cache_put,
+from ..model.jax_model import (_stage_cache_budget, _step_cache_get,
+                               _step_cache_put, staged_token_ids,
                                step_cache_key)
 from ..model.logger import logger
 from ..model.loop_ckpt import epoch_rng
@@ -377,16 +379,43 @@ class JaxTransformerLM(BaseModel):
 
     def evaluate(self, dataset_path: str) -> float:
         """Mean next-token accuracy over contiguous validation
-        windows."""
+        windows.
+
+        The token stream rides the cross-trial device staging cache
+        (``staged_token_ids``): eval windows are gathered in-graph from
+        the resident int32 stream by DEVICE-COMPUTED iota indices, so
+        eval 2..N of a sub-train-job ships zero token bytes host->
+        device (the r9 zero-H2D contract, extended to the LM path —
+        shipping an index matrix from the host would be pointless here:
+        int32 indices are exactly as many bytes as the int32 windows
+        themselves). Streams over the staging budget keep the legacy
+        host ``np.stack`` path."""
         ds = load_token_dataset(dataset_path)
         t = self._dims()["t"]
         n_win = max(1, min(16, (ds.size - 1) // t))
-        ids = np.stack([ds.ids[i * t:i * t + t + 1]
-                        for i in range(n_win)])
         fn = self._ensure_predict_fn()
-        logits = np.asarray(fn(self._params_dev,
-                               jnp.asarray(ids[:, :-1], jnp.int32)))
-        return float((logits.argmax(-1) == ids[:, 1:]).mean())
+        stage_bytes = int(os.environ.get("RAFIKI_TPU_STAGE_BYTES",
+                                         2 << 30))
+        # Gated on the stream being CACHEABLE, not just stageable: with
+        # the cross-trial cache disabled (or the stream over its
+        # budget), staging would device_put the WHOLE stream uncached
+        # on every eval — strictly worse than shipping 16 windows.
+        cache_budget = _stage_cache_budget()
+        if 0 < int(ds.ids.nbytes) <= min(stage_bytes, cache_budget) \
+                and ds.size >= n_win * t + 1:
+            ids_dev = staged_token_ids(dataset_path, ds, self.mesh)
+            sel = (jnp.arange(n_win, dtype=jnp.int32)[:, None] * t
+                   + jnp.arange(t + 1, dtype=jnp.int32)[None, :])
+            wins = jnp.take(ids_dev, sel, axis=0)  # (n_win, t+1) on device
+            logits = np.asarray(fn(self._params_dev, wins[:, :-1]))
+            targets = np.asarray(wins[:, 1:])
+        else:
+            ids = np.stack([ds.ids[i * t:i * t + t + 1]
+                            for i in range(n_win)])
+            logits = np.asarray(fn(self._params_dev,
+                                   jnp.asarray(ids[:, :-1], jnp.int32)))
+            targets = ids[:, 1:]
+        return float((logits.argmax(-1) == targets).mean())
 
     def predict(self, queries: List[Any]) -> List[Any]:
         """Scores token-id sequences: mean next-token log-probability

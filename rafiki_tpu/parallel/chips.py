@@ -211,6 +211,11 @@ class ChipAllocator:
         placement up to ``max_share`` owners per chip (default 4;
         ``RAFIKI_TPU_MAX_CHIP_SHARE`` overrides — a dense box serving
         many replica workers per chip may deliberately oversubscribe).
+        The env var is ``NodeConfig.max_chip_share`` (promoted from the
+        env-only expert baseline in r14: the autoscaler's scale-up
+        leans on time-sliced placement, making the cap a sizing
+        decision); the allocator keeps reading env per call so it
+        works without a NodeConfig and honors mid-run overrides.
         """
         if n <= 0:
             raise ValueError("n must be positive")

@@ -115,6 +115,20 @@ class LocalPlatform:
         observe_trace.configure(self.services.log_dir)
         self.admin = Admin(self.meta, self.params, self.services,
                            datasets_dir=os.path.join(workdir, "datasets"))
+        # Metrics-driven autoscaler (docs/autoscaling.md): constructed
+        # ONLY when RAFIKI_TPU_AUTOSCALE is on (NodeConfig.apply_env
+        # exports it; env is the transport so tests/bench flip it the
+        # same way the serve CLI does). Off = services.autoscaler stays
+        # None: supervise pays one attribute check, zero new series.
+        self.autoscaler = None
+        from .config import _parse_bool as _pb
+
+        if _pb(os.environ.get("RAFIKI_TPU_AUTOSCALE", "0")):
+            from .admin.autoscaler import Autoscaler
+
+            self.autoscaler = Autoscaler.from_env(self.services,
+                                                  self.meta)
+            self.services.autoscaler = self.autoscaler
         self.app: Optional[AdminApp] = None
         if http:
             self.app = AdminApp(self.admin, port=admin_port).start()
@@ -170,6 +184,9 @@ class LocalPlatform:
         if self._supervisor is not None:
             self._supervisor.join(timeout=5)
         self._heartbeat.join(timeout=5)
+        if self.autoscaler is not None:
+            self.services.autoscaler = None
+            self.autoscaler.close()  # drop the autoscale series
         if self.app is not None:
             self.app.stop()
         if self.stop_jobs_on_shutdown:

@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import faults
 from ..bus import BaseBus, BusOpError
+from ..cache import DRAIN_KEY as _CACHE_DRAIN_KEY
 from ..cache import WIRE_NDBATCH, Cache
 from ..constants import ServiceStatus
 from ..observe import trace
@@ -475,6 +476,17 @@ class InferenceWorker:
                         self.service_id, max_items=self.max_batch,
                         timeout=0.0 if pending is not None
                         else self.batch_timeout)
+                    # Graceful drain (ServicesManager.
+                    # drain_inference_worker): everything enqueued
+                    # BEFORE the marker is in this burst or an earlier
+                    # one — serve it, then exit the loop cleanly (the
+                    # run() tail completes the pending burst, marks
+                    # STOPPED, and unregisters).
+                    draining = any(_CACHE_DRAIN_KEY in it
+                                   for it in items)
+                    if draining:
+                        items = [it for it in items
+                                 if _CACHE_DRAIN_KEY not in it]
                     handle = (self._dispatch_batch(items) if items
                               else None)
                     if not self.pipeline and handle is not None:
@@ -484,6 +496,11 @@ class InferenceWorker:
                         self._complete_batch(*pending)
                     pending = handle
                     consecutive_op_errors = 0
+                    if draining:
+                        _log.info("inference worker %s draining: "
+                                  "served the queue, exiting",
+                                  self.service_id)
+                        break
                 except (ConnectionError, OSError, RuntimeError) as e:
                     if isinstance(e, BusOpError):
                         consecutive_op_errors += 1
@@ -686,13 +703,17 @@ class InferenceWorker:
                 # worker that already served its own slice of the same
                 # batch, making worker_id alone ambiguous). Un-sharded
                 # frames have no "shard" key and reply without one.
+                # packed_ok: the query frame's "rw" list is the reply-
+                # direction negotiation — only senders that can decode
+                # packed replies ever advertise it.
                 self.cache.send_prediction_batch(
                     it["batch_id"], self.service_id,
                     predictions[start:start + count], weight=weight,
                     shard=it.get("shard"),
                     confidence=(confidence[start:start + count]
                                 if confidence is not None else None),
-                    compute_s=round(burst_s * count / max(n, 1), 6))
+                    compute_s=round(burst_s * count / max(n, 1), 6),
+                    packed_ok=WIRE_NDBATCH in (it.get("rw") or ()))
             else:
                 self.cache.send_prediction(it["query_id"], self.service_id,
                                            predictions[start],

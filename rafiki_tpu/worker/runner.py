@@ -63,6 +63,7 @@ class _PersistStage:
         self._lock = threading.Lock()
         self._pending = 0
         self._failures = 0
+        self._commits = 0
 
     def note_failure(self) -> None:
         """Called by a tail that errored its trial retroactively. The
@@ -77,6 +78,15 @@ class _PersistStage:
     def failure_count(self) -> int:
         with self._lock:
             return self._failures
+
+    def commit_count(self) -> int:
+        """Tails that committed (trial genuinely COMPLETED) — the
+        breaker's RESET signal. Resetting on anything weaker races: a
+        fast-failing tail can land before its own iteration's
+        failure-count read, and the next iteration's "no new failure"
+        must not read as success mid-streak."""
+        with self._lock:
+            return self._commits
 
     def submit(self, fn: Callable[[Callable], None]) -> None:
         """``fn(commit)`` runs on the persist thread; it must call
@@ -94,6 +104,7 @@ class _PersistStage:
                 with self._lock:
                     meta_write()
                     self._pending -= 1
+                    self._commits += 1
                 committed[0] = True
 
             try:
@@ -193,6 +204,7 @@ class TrialRunner:
         done: List[Dict[str, Any]] = []
         consecutive_errors = 0
         tail_failures_seen = 0
+        tail_commits_seen = 0
         finished = False
         try:
             while not finished:
@@ -202,19 +214,36 @@ class TrialRunner:
                         finished = True  # advisor: search is over
                         break
                     done.append(row)
-                    errored = row["status"] == TrialStatus.ERRORED
+                    # Fold failed persist tails into the breaker (they
+                    # error trials RETROactively — after run_one
+                    # snapshotted the row as RUNNING) by DELTA, and
+                    # reset only on an actual COMMIT: a fast-failing
+                    # tail can land before its own iteration's
+                    # failure-count read (this check sees +2, the next
+                    # sees +0), and treating that +0 as success reset
+                    # an unbroken failure streak — a deterministic
+                    # disk-full tail could run a dozen-plus trials
+                    # before tripping instead of max_consecutive.
+                    new_failures = int(row["status"]
+                                       == TrialStatus.ERRORED)
+                    new_commits = 0
                     if self._persist is not None:
-                        # A failed persist tail errored a trial RETRO-
-                        # actively — after run_one snapshotted its row
-                        # as RUNNING. Fold those into the breaker or a
-                        # deterministic tail failure (disk full) loops
-                        # forever against a trial-count budget.
                         f = self._persist.failure_count()
-                        if f > tail_failures_seen:
-                            tail_failures_seen = f
-                            errored = True
-                    if errored:
-                        consecutive_errors += 1
+                        new_failures += f - tail_failures_seen
+                        tail_failures_seen = f
+                        c = self._persist.commit_count()
+                        new_commits = c - tail_commits_seen
+                        tail_commits_seen = c
+                    else:
+                        new_commits = int(not new_failures)
+                    if new_commits:
+                        # Reset BEFORE counting this check's failures:
+                        # ordering across one sweep is unknowable, and
+                        # biasing toward keeping the streak is the
+                        # safe direction for a deterministic failure.
+                        consecutive_errors = 0
+                    if new_failures:
+                        consecutive_errors += new_failures
                         if consecutive_errors >= \
                                 self.max_consecutive_errors:
                             _log.error(
@@ -224,8 +253,6 @@ class TrialRunner:
                                 self.sub_train_job_id)
                             finished = True
                             break
-                    else:
-                        consecutive_errors = 0
                 if finished:
                     break
                 # The budget LOOKED satisfied, but an in-flight persist

@@ -294,6 +294,9 @@ def test_dashboard_upload_and_log_elements(http_platform):
     assert "/stats" in text and "refreshInfStats" in text
     # the phase panel reads the admin's /trial_phases aggregation
     assert "/trial_phases" in text and "refreshTrialPhases" in text
+    # the autoscale panel renders GET /autoscale's decision ring
+    assert "/autoscale" in text and "refreshAutoscale" in text
+    assert 'id="autoscale-card"' in text
     # the paste-a-trace-id panel renders GET /trace/<id> (r12: the
     # carried r7 item; cache/tier spans land in its timeline)
     for el in ("trace-id", "trace-go", "trace-spans"):

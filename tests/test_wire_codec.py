@@ -503,7 +503,10 @@ def test_wire_metrics_account_both_formats(fresh_registry, monkeypatch):
     copies = fresh_registry.find("rafiki_tpu_serving_host_copies_total")
     assert wire is not None and copies is not None
     assert wire.value(format="packed", direction="scatter") > 0
-    assert wire.value(format="perquery", direction="reply") > 0
+    # r14: dense float-vector replies pack too (the query frame's "rw"
+    # negotiation), so the packed side's reply bytes are packed now.
+    assert wire.value(format="packed", direction="reply") > 0
+    assert wire.value(format="perquery", direction="reply") == 0
     # packed path: assembly decode + per-shard encode, no stack/pad
     assert copies.value(site="encode") >= 1
     assert copies.value(site="stack") == 0
@@ -687,3 +690,129 @@ def test_worker_quantizes_at_load(monkeypatch):
     assert calls == ["int8"]
     assert w._quant_active is True
     obs_wire.reset_for_tests()
+
+
+# --- Reply-direction packed frames (r14) ------------------------------
+
+def _reply_roundtrip(preds, packed_ok=True, env="on", monkeypatch=None):
+    from rafiki_tpu.cache import pack_prediction_rows  # noqa: F401
+
+    bus = MemoryBus()
+    cache = Cache(bus)
+    cache.send_prediction_batch("rb", "w1", preds, weight=2,
+                                shard="sh", packed_ok=packed_ok)
+    out = cache.gather_prediction_batches("rb", 1, timeout=2.0)
+    assert len(out) == 1
+    return out[0]
+
+
+def test_reply_pack_roundtrip_and_metadata():
+    preds = [[0.1 * i, 1.0 - 0.1 * i] for i in range(8)]
+    reply = _reply_roundtrip(preds)
+    assert reply["weight"] == 2 and reply["shard"] == "sh"
+    got = reply["predictions"]
+    assert len(got) == 8
+    for g, p in zip(got, preds):
+        np.testing.assert_allclose(np.asarray(g), p)
+
+
+def test_reply_pack_refuses_unpackable():
+    from rafiki_tpu.cache import pack_prediction_rows
+
+    assert pack_prediction_rows([{"error": "x"}, [0.1, 0.9]]) is None
+    assert pack_prediction_rows([[0.1, 0.9]]) is None          # n < 2
+    assert pack_prediction_rows([[1, 2], [3, 4]]) is None      # ints
+    assert pack_prediction_rows([[0.1, 0.9],
+                                 [0.1, 0.9, 0.0]]) is None     # ragged
+    assert pack_prediction_rows(["a", "b"]) is None
+    assert pack_prediction_rows(
+        [{"__members__": [[0.1], [0.9]]}] * 2) is None
+    # ...and an unpackable batch still round-trips per-query.
+    reply = _reply_roundtrip([{"error": "x"}, [0.1, 0.9]])
+    assert reply["predictions"] == [{"error": "x"}, [0.1, 0.9]]
+
+
+def test_reply_pack_negotiation_is_frame_carried(monkeypatch):
+    """Workers pack replies ONLY toward senders whose query frame
+    advertised `rw` (an old predictor never sets it), and only while
+    their own packed mode is "on" (compat keeps per-query replies)."""
+    monkeypatch.setenv(obs_wire.PACKED_WIRE_ENV, "on")
+    obs_wire.reset_for_tests()
+    bus = MemoryBus()
+    on = Cache(bus)
+    on.send_query_shards([("wq", 0, 2, "s1")],
+                         [encode_payload(np.zeros((2,), np.float32))] * 2)
+    frame = bus.pop_all("q:wq", timeout=0.5)[0]
+    assert frame.get("rw") == [WIRE_NDBATCH]
+    monkeypatch.setenv(obs_wire.PACKED_WIRE_ENV, "compat")
+    obs_wire.reset_for_tests()
+    compat = Cache(bus)
+    compat.send_query_shards([("wq", 0, 2, "s2")],
+                             [encode_payload(np.zeros((2,),
+                                             np.float32))] * 2)
+    frame = bus.pop_all("q:wq", timeout=0.5)[0]
+    assert "rw" not in frame
+    # compat sender side: packed_ok granted but own mode says no.
+    compat.send_prediction_batch("rc", "w1", [[0.5, 0.5]] * 4,
+                                 packed_ok=True)
+    raw = bus.pop_all("r:rc", timeout=0.5)[0]
+    assert "batch" not in raw and "predictions" in raw
+    obs_wire.reset_for_tests()
+
+
+def test_reply_packed_bytes_materially_lower(fresh_registry,
+                                             monkeypatch):
+    """The reply-direction unit gate (ISSUE r14): the same dense reply
+    batch costs fewer estimated wire bytes packed than per-query."""
+    monkeypatch.setenv(obs_wire.PACKED_WIRE_ENV, "on")
+    obs_wire.reset_for_tests()
+    cache = Cache(MemoryBus())
+    preds = [list(np.linspace(0.0, 1.0, 10) + i) for i in range(32)]
+    cache.send_prediction_batch("rp", "w1", preds, packed_ok=True)
+    reg = fresh_registry.find("rafiki_tpu_serving_wire_bytes_total")
+    packed = reg.value(format="packed", direction="reply")
+    cache.send_prediction_batch("rq", "w1", preds, packed_ok=False)
+    perquery = reg.value(format="perquery", direction="reply")
+    assert packed > 0 and perquery > 0
+    assert packed < 0.85 * perquery, (packed, perquery)
+
+
+def test_reply_corrupt_packed_frame_is_dropped(monkeypatch):
+    """A corrupt packed reply is DROPPED, never returned: its shard
+    must read as genuinely unanswered so the straggler resubmit /
+    partial-bin machinery covers it — returning it (even with empty
+    predictions) would mark the shard answered and could supersede a
+    healthy in-flight retry. A good reply behind it still gathers."""
+    bus = MemoryBus()
+    cache = Cache(bus)
+    bus.push("r:bad", {"worker_id": "w1", "weight": 1,
+                       "batch": {"__ndbatch__": "!!!", "v": 1,
+                                 "dtype": "float64", "shape": [2],
+                                 "n": 2, "offsets": [0, 16]}})
+    bus.push("r:bad", {"worker_id": "w2", "weight": 1,
+                       "predictions": [[0.5, 0.5]]})
+    out = cache.gather_prediction_batches("bad", 1, timeout=2.0)
+    assert len(out) == 1 and out[0]["worker_id"] == "w2"
+
+
+def test_reply_packed_e2e_through_real_worker(monkeypatch):
+    """Real InferenceWorker + real Predictor over a MemoryBus: the
+    reply rides ONE packed frame and the ensemble output is
+    unchanged."""
+    monkeypatch.setenv(obs_wire.PACKED_WIRE_ENV, "on")
+    obs_wire.reset_for_tests()
+    bus = MemoryBus()
+    w = _worker(bus)
+    try:
+        p = Predictor("job", bus, gather_timeout=5.0,
+                      worker_wait_timeout=5.0)
+        qs = [np.full((2, 2), i, np.uint8) for i in range(4)]
+        res = p.predict(qs)
+        assert [r[0] for r in res] == _expected(qs)
+        # Prove the wire actually packed the reply.
+        reg = obs_metrics.registry().find(
+            "rafiki_tpu_serving_wire_bytes_total")
+        assert reg.value(format="packed", direction="reply") > 0
+    finally:
+        w.stop_flag.set()
+        obs_wire.reset_for_tests()
