@@ -516,6 +516,7 @@ class Admin:
         # the old one stops, or the swap would drop the bin's vote —
         # and the incoming worker re-reads the serving env at load, so
         # e.g. int8 quant scales are recomputed for the promoted bin.
+        # rta: disable=RTA105 deliberate (r12): holding _promote_lock across the registration wait IS the double-allocation fix; see promote_trial's docstring
         swap = self.services.swap_inference_worker(
             inference_job_id, trial_id,
             replace_service_ids=[w["service_id"] for w in old_rows],

@@ -89,6 +89,13 @@ class ServicesManager:
         # registrations (subprocess/docker modes; thread mode borrows
         # the container's shared bus instead).
         self._reap_bus = None
+        # Foreign-node lease window (NodeConfig.node_lease; env is the
+        # transport so spawned children agree). Resolved HERE, per
+        # instance — the old class-attribute read executed at first
+        # import, before NodeConfig.apply_env could export the node's
+        # validated value (the RTA601 import-read class).
+        self.NODE_LEASE = float(os.environ.get(
+            "RAFIKI_TPU_NODE_LEASE", 120.0))
         # Dead inference replicas whose respawn failed for CAPACITY
         # (add_inference_worker -> None while the job was live): the
         # service row is already ERRORED, so the RUNNING scan will
@@ -271,18 +278,18 @@ class ServicesManager:
             if name.startswith(f"{sub_id}-"):
                 shutil.rmtree(os.path.join(root, name), ignore_errors=True)
 
-    # How long a foreign node's RUNNING row stays credible without a
-    # heartbeat. Must comfortably exceed the heartbeat cadence
-    # (NODE_LEASE/4 in LocalPlatform) PLUS worst-case heartbeat delays:
-    # sqlite busy waits (up to 30 s), long GIL-holding XLA traces, and
-    # cross-host clock skew (heartbeat_at is the writer's clock, this
-    # check is the reader's — nodes sharing a meta store are assumed
-    # NTP-synced to within a few seconds). Expiry is detection of a
-    # node presumed DEAD, not fencing of a live one: a worker that was
-    # merely stalled finishes its trial and writes its rows normally
-    # (trial results are idempotent), it just stops counting toward
-    # job liveness. Override via RAFIKI_TPU_NODE_LEASE.
-    NODE_LEASE = float(os.environ.get("RAFIKI_TPU_NODE_LEASE", 120.0))
+    # NODE_LEASE (set per instance in __init__): how long a foreign
+    # node's RUNNING row stays credible without a heartbeat. Must
+    # comfortably exceed the heartbeat cadence (NODE_LEASE/4 in
+    # LocalPlatform) PLUS worst-case heartbeat delays: sqlite busy
+    # waits (up to 30 s), long GIL-holding XLA traces, and cross-host
+    # clock skew (heartbeat_at is the writer's clock, this check is
+    # the reader's — nodes sharing a meta store are assumed NTP-synced
+    # to within a few seconds). Expiry is detection of a node presumed
+    # DEAD, not fencing of a live one: a worker that was merely
+    # stalled finishes its trial and writes its rows normally (trial
+    # results are idempotent), it just stops counting toward job
+    # liveness. NodeConfig.node_lease / RAFIKI_TPU_NODE_LEASE.
 
     def _ownership(self, svc: Dict[str, Any]) -> str:
         """'local' | 'foreign' | 'unowned-skip'.

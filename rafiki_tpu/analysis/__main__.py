@@ -43,7 +43,20 @@ def main(argv=None) -> int:
                         help="run only this checker (repeatable); "
                              "names: " + ", ".join(
                                  c.name for c in core.all_checkers()))
+    parser.add_argument("--explain", metavar="CODE", default=None,
+                        help="print the catalog entry + fix hint for "
+                             "one RTA code and exit (self-serve on a "
+                             "red gate)")
     args = parser.parse_args(argv)
+    if args.explain is not None:
+        from .catalog import CATALOG, explain
+
+        code = args.explain.strip().upper()
+        if code not in CATALOG:
+            parser.error("unknown code %s (known: %s)"
+                         % (code, ", ".join(sorted(CATALOG))))
+        print(explain(code))
+        return 0
     if args.checker:
         known = {c.name for c in core.all_checkers()}
         bad = sorted(set(args.checker) - known)

@@ -1,0 +1,236 @@
+"""The checker catalog, machine-readable: one entry per code with what
+it flags, the historical bug it encodes, and the fix hint.
+
+``python -m rafiki_tpu.analysis --explain RTA104`` prints an entry so
+a builder staring at a red gate can self-serve without opening
+docs/analysis.md (which carries the same catalog as prose — that file
+is the reviewed narrative, this dict is the CLI's source).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+CATALOG: Dict[str, Dict[str, str]] = {
+    "RTA000": {
+        "title": "unparseable file",
+        "flags": "A file under rafiki_tpu/ the suite cannot ast.parse.",
+        "bug": "A syntax error would otherwise silently shrink the "
+               "analyzed surface — every checker would just skip the "
+               "file.",
+        "hint": "Fix the syntax error; the finding carries the parser "
+                "message.",
+    },
+    "RTA001": {
+        "title": "waiver without a reason",
+        "flags": "`# rta: disable=CODE` with no reason text.",
+        "bug": "A waiver is a reviewed decision; a bare disable is an "
+               "escape hatch. Not waivable, by design.",
+        "hint": "Say WHY the invariant doesn't apply, on the same "
+                "comment.",
+    },
+    "RTA002": {
+        "title": "baseline entry without a reviewed reason",
+        "flags": "A baseline.json entry whose reason is empty or still "
+                 "the UNREVIEWED placeholder.",
+        "bug": "`--update-baseline` must never be silently green; the "
+               "placeholder keeps failing until a human writes the "
+               "real reason.",
+        "hint": "Replace the placeholder with why the finding is "
+                "accepted.",
+    },
+    "RTA101": {
+        "title": "guarded attribute accessed without its lock",
+        "flags": "A class attribute accessed under `with self._lock:` "
+                 "somewhere, but read/written lock-free elsewhere "
+                 "(outside __init__).",
+        "bug": "The ParamStore write-behind row-before-file race (r6): "
+               "cross-thread invariants held 'by convention' rot.",
+        "hint": "Wrap the access in the guarding lock, or waive with "
+                "why the race is benign.",
+    },
+    "RTA102": {
+        "title": "blocking call under a lock (direct)",
+        "flags": "sleep/subprocess/socket/open/join/result/queue-op "
+                 "called IN the method while a lock is held.",
+        "bug": "One time.sleep under the batcher's admission lock "
+               "stalls every concurrent client for the duration.",
+        "hint": "Snapshot state under the lock, do the slow work after "
+                "release. The call-chain form is RTA105.",
+    },
+    "RTA103": {
+        "title": "intra-class lock-order cycle",
+        "flags": "Method A takes lock1→lock2, method B takes "
+                 "lock2→lock1, within one class (incl. a self-cycle "
+                 "on a non-reentrant Lock).",
+        "bug": "The two-lock deadlock this class of code grows by "
+               "accretion; the cross-class form is RTA104.",
+        "hint": "Pick ONE acquisition order and restructure the other "
+                "path.",
+    },
+    "RTA104": {
+        "title": "cross-class lock-order cycle (interprocedural)",
+        "flags": "Two classes' locks acquired in opposite orders on "
+                 "two program paths — followed through the repo-wide "
+                 "call graph, across modules, any number of frames "
+                 "deep (bounded).",
+        "bug": "The r14 breaker reset and r12 promote double-alloc "
+               "were cross-OBJECT races invisible to per-class "
+               "analysis; this is the deadlock-shaped sibling.",
+        "hint": "Pick one global order for the two classes (document "
+                "it), or hand off through a queue so one side never "
+                "holds its lock into the other.",
+    },
+    "RTA105": {
+        "title": "blocking reached through the call graph under a lock",
+        "flags": "A method holds a lock while calling a chain that — "
+                 "frames later, possibly in another module — sleeps, "
+                 "does disk/socket I/O, or a bus round-trip.",
+        "bug": "The r12 promote path blocks under the node-wide "
+               "promote lock ACROSS a registration wait (deliberate, "
+               "waived) — review had to find every accidental sibling "
+               "by hand until this code existed.",
+        "hint": "Release the lock before the slow call (snapshot "
+                "under the lock, act after), or waive with why the "
+                "stall is acceptable; the finding prints the frame "
+                "chain.",
+    },
+    "RTA106": {
+        "title": "cross-thread-root unguarded shared state",
+        "flags": "An attribute written from one thread root "
+                 "(Thread target / executor submit / HTTP handler) "
+                 "and accessed from another, with NO lock anywhere on "
+                 "that attribute.",
+        "bug": "The r14 circuit-breaker class: state shared between "
+               "the persist thread and the trial loop with nothing "
+               "enforcing the ordering either side assumed.",
+        "hint": "Guard both sides with one lock or hand over through "
+                "a Queue/Event; waive only with the reason the race "
+                "is benign (monotonic flag, GIL-atomic scalar).",
+    },
+    "RTA201": {
+        "title": "thread neither daemonized nor joined",
+        "flags": "threading.Thread(...) without daemon=True and "
+                 "without a .join() on any stop/close/drain path.",
+        "bug": "The _PersistStage/batcher/write-behind pattern "
+               "(r6-r9): a non-daemon, never-joined thread wedges "
+               "interpreter shutdown.",
+        "hint": "Pass daemon=True, or join from stop()/close()/"
+                "drain().",
+    },
+    "RTA202": {
+        "title": "executor never shut down",
+        "flags": "A concurrent.futures executor bound to self.X with "
+                 "no self.X.shutdown(...) in the class.",
+        "bug": "Same lifecycle class as RTA201, executor flavor.",
+        "hint": "Add shutdown(wait=True) to the close/stop path.",
+    },
+    "RTA301": {
+        "title": "dynamic metric label without .remove()",
+        "flags": "A series sample with a non-literal label value and "
+                 "no matching .remove(...) in the module.",
+        "bug": "The r7 leak: per-trial/per-instance series lived "
+               "forever in the process registry.",
+        "hint": "Call <metric>.remove(label=value) from the owner's "
+                "stop/close/trial-end path, or waive with the bounded "
+                "label vocabulary.",
+    },
+    "RTA401": {
+        "title": "cache-resident value donated",
+        "flags": "A value that came from a staging/residency cache "
+                 "passed at a donate_argnums position (taint flows "
+                 "through helper returns).",
+        "bug": "The r9 staged-arrays hazard: XLA frees the cached "
+               "buffer under every later trial.",
+        "hint": "Donate only per-call state (train/optimizer state), "
+                "never cache-resident arrays.",
+    },
+    "RTA402": {
+        "title": "use after donate",
+        "flags": "A name passed at a donated position read again with "
+                 "no rebind in between.",
+        "bug": "Reading a donated array errors at runtime — on TPU "
+               "only, i.e. never in CPU CI.",
+        "hint": "Rebind the result (x, ... = f(x, ...)) or pass a "
+                "copy.",
+    },
+    "RTA501": {
+        "title": "metric name off-contract",
+        "flags": "A registered name not matching "
+                 "rafiki_tpu_<subsystem>_<name>_<unit>.",
+        "bug": "One typo'd name forks the namespace forever (r7).",
+        "hint": "Fix the name, or extend the vocabulary in "
+                "checkers/drift.py deliberately.",
+    },
+    "RTA502": {
+        "title": "dashboard references unregistered metric",
+        "flags": "A rafiki_tpu_* token in a Grafana JSON no code "
+                 "registers.",
+        "bug": "A renamed series silently blanks a panel (r8).",
+        "hint": "Update the dashboard (or restore the name).",
+    },
+    "RTA503": {
+        "title": "undocumented NodeConfig knob",
+        "flags": "A NodeConfig env var missing from docs/ops.md's "
+                 "knob table.",
+        "bug": "The r9 audit found three generations of undocumented "
+               "knobs.",
+        "hint": "Add the docs/ops.md row.",
+    },
+    "RTA504": {
+        "title": "ad-hoc env knob",
+        "flags": "A RAFIKI_TPU_* literal read anywhere that is not a "
+                 "NodeConfig field or injected identity var.",
+        "bug": "Ad-hoc os.environ knobs bypass validation, precedence "
+               "and the docs gate — how the r9 audit's three "
+               "undocumented generations happened.",
+        "hint": "Promote to a NodeConfig field (validation + "
+                "apply_env + ops.md row), or baseline with why it "
+                "must stay env-only.",
+    },
+    "RTA505": {
+        "title": "knob read by workers but not exported",
+        "flags": "A NodeConfig knob read at worker construction that "
+                 "apply_env() never exports.",
+        "bug": "Spawned children resolve different values than the "
+               "node validated.",
+        "hint": "Export it in apply_env() like the other tunables.",
+    },
+    "RTA601": {
+        "title": "side effect at import time",
+        "flags": "A thread built/started, socket/server bound, "
+                 "process spawned, or environment variable read by "
+                 "module-level (or class-body) code.",
+        "bug": "Every subprocess service runner re-executes module "
+               "import effects in ITS process; the NODE_LEASE "
+               "class-attribute read froze its value at first import, "
+               "BEFORE apply_env could export the validated one "
+               "(fixed r15).",
+        "hint": "Move the effect into the function/constructor that "
+                "needs it; env belongs in NodeConfig or a "
+                "construction-time read.",
+    },
+    "RTA602": {
+        "title": "eager jax import on the bus/broker path",
+        "flags": "A module-level jax/jaxlib/flax/optax import in any "
+                 "module import-time-reachable from rafiki_tpu/bus/.",
+        "bug": "PR 2 made observe/__init__ lazy-load the jax "
+               "profiling symbols precisely so brokers never pay a "
+               "jax import (seconds + a device runtime they must not "
+               "touch); nothing enforced the discipline until now.",
+        "hint": "Import inside the function that needs it (the "
+                "observe/__init__ pattern), or break the module edge "
+                "from the bus path; the finding prints the import "
+                "chain.",
+    },
+}
+
+
+def explain(code: str) -> str:
+    """The --explain rendering for one code (KeyError on unknown —
+    the CLI validates first)."""
+    e = CATALOG[code]
+    return (f"{code} — {e['title']}\n\n"
+            f"  flags : {e['flags']}\n"
+            f"  bug   : {e['bug']}\n"
+            f"  fix   : {e['hint']}\n")

@@ -1,9 +1,11 @@
 """Checker modules; importing this package registers them all."""
 
 from . import (  # noqa: F401
+    concurrency,
     donation,
     drift,
     guarded_state,
+    import_hygiene,
     series_lifecycle,
     thread_lifecycle,
 )

@@ -214,11 +214,22 @@ def check_dashboards(root: str,
 
 # --- RTA503: knob docs ------------------------------------------------
 
+#: (path, mtime_ns) -> NodeConfig class. One run used to exec config.py
+#: three times (knob docs, env drift, apply_env parity); the cache
+#: makes it once — and keeps fixture trees correct via the path key.
+_NODE_CONFIG_CACHE: Dict[Tuple[str, int], type] = {}
+
+
 def load_node_config(root: str):
     """Load NodeConfig from THIS root by file path (never the installed
     package): the check must run without jax, and a tmp-tree run (the
-    fixture tests) must see the tree's own config."""
+    fixture tests) must see the tree's own config. Cached per
+    (path, mtime)."""
     path = os.path.join(root, "rafiki_tpu", "config.py")
+    key = (os.path.abspath(path), os.stat(path).st_mtime_ns)
+    cached = _NODE_CONFIG_CACHE.get(key)
+    if cached is not None:
+        return cached
     spec = importlib.util.spec_from_file_location(
         "_rta_node_config", path)
     mod = importlib.util.module_from_spec(spec)
@@ -227,6 +238,7 @@ def load_node_config(root: str):
     sys.modules[spec.name] = mod
     try:
         spec.loader.exec_module(mod)
+        _NODE_CONFIG_CACHE[key] = mod.NodeConfig
         return mod.NodeConfig
     finally:
         sys.modules.pop(spec.name, None)

@@ -30,262 +30,28 @@ Inference (per class):
    _cond" pattern), to a fixpoint. Closures/nested defs run later and
    inherit nothing.
 
+The per-class walk itself lives in ``analysis.program`` (r15): one
+classify+walk per class per run, shared with the interprocedural
+RTA104-106 checker through ``ctx.program()``.
+
 RTA101: guarded attribute accessed while holding none of its guards
 (outside ``__init__``).
 RTA102: blocking call (sleep, subprocess, socket, ``open``, thread
 ``join``, future ``result``, non-lock ``wait``, queue ``get``/``put``)
-made while holding a lock.
+made while holding a lock — *directly in the method*; the call-chain
+form is RTA105 (checkers/concurrency.py).
 RTA103: lock-order cycle across the class's intra-class call graph
-(including a self-cycle on a non-reentrant ``Lock``).
+(including a self-cycle on a non-reentrant ``Lock``); the cross-class
+form is RTA104.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Set, Tuple
 
 from ..core import Checker, Finding, RepoContext, register
-
-LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
-ATOMIC_FACTORIES = {"Event", "Semaphore", "BoundedSemaphore", "Barrier",
-                    "local", "Queue", "SimpleQueue", "LifoQueue",
-                    "PriorityQueue"}
-MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
-            "pop", "popleft", "popitem", "remove", "discard", "clear",
-            "update", "setdefault", "add"}
-
-#: Module roots whose calls block (network, processes, disk trees).
-BLOCKING_MODULES = {"subprocess", "socket", "requests", "urllib"}
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self":
-        return node.attr
-    return None
-
-
-def _dotted(node: ast.AST) -> List[str]:
-    """``a.b.c(...)`` -> ["a", "b", "c"]; best effort."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-    return list(reversed(parts))
-
-
-class _Access:
-    __slots__ = ("attr", "held", "method", "line", "is_write", "nested")
-
-    def __init__(self, attr, held, method, line, is_write, nested):
-        self.attr = attr
-        self.held = held
-        self.method = method
-        self.line = line
-        self.is_write = is_write
-        self.nested = nested
-
-
-class _MethodWalker(ast.NodeVisitor):
-    """Walks one method body tracking the lexically-held lock set."""
-
-    def __init__(self, cls: "_ClassInfo", method: str):
-        self.cls = cls
-        self.method = method
-        self.held: Tuple[str, ...] = ()
-        self.depth = 0  # nested function depth (closures run later)
-
-    # --- lock context ---
-
-    def visit_With(self, node: ast.With) -> None:
-        entered = []
-        for item in node.items:
-            attr = _self_attr(item.context_expr)
-            if attr in self.cls.lock_attrs:
-                entered.append(attr)
-                self.cls.lock_entries.append(
-                    (frozenset(self.held), attr, item.context_expr.lineno,
-                     self.method, self.depth))
-            else:
-                self.visit(item.context_expr)
-            if item.optional_vars is not None:
-                self.visit(item.optional_vars)
-        prior = self.held
-        self.held = tuple(self.held) + tuple(entered)
-        for stmt in node.body:
-            self.visit(stmt)
-        self.held = prior
-
-    # --- scope boundaries ---
-
-    def _enter_nested(self, node) -> None:
-        prior, self.held = self.held, ()
-        self.depth += 1
-        for child in ast.iter_child_nodes(node):
-            self.visit(child)
-        self.depth -= 1
-        self.held = prior
-
-    def visit_FunctionDef(self, node):
-        self._enter_nested(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-    visit_Lambda = visit_FunctionDef
-
-    # --- accesses ---
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        attr = _self_attr(node)
-        if attr is not None:
-            self.cls.accesses.append(_Access(
-                attr, frozenset(self.held), self.method, node.lineno,
-                isinstance(node.ctx, (ast.Store, ast.Del)),
-                self.depth > 0))
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        self.cls.calls.append(
-            (node, frozenset(self.held), self.method, self.depth))
-        self.generic_visit(node)
-
-
-class _ClassInfo:
-    def __init__(self, node: ast.ClassDef):
-        self.node = node
-        self.name = node.name
-        self.lock_attrs: Set[str] = set()
-        self.lock_kind: Dict[str, str] = {}      # attr -> factory name
-        self.atomic_attrs: Set[str] = set()
-        self.thread_attrs: Set[str] = set()
-        self.state_attrs: Set[str] = set()
-        self.accesses: List[_Access] = []
-        # (node, held, method, nested-depth)
-        self.calls: List[Tuple[ast.Call, frozenset, str, int]] = []
-        # (outer_held, lock, line, method, nested-depth)
-        self.lock_entries: List[Tuple[frozenset, str, int, str, int]] = []
-
-    # -- pass 1: classify attributes --
-
-    def classify(self) -> None:
-        for method in self._methods():
-            in_init = method.name == "__init__"
-            for sub in ast.walk(method):
-                if isinstance(sub, (ast.Assign, ast.AnnAssign,
-                                    ast.AugAssign)):
-                    targets = (sub.targets
-                               if isinstance(sub, ast.Assign)
-                               else [sub.target])
-                    for tgt in targets:
-                        self._classify_target(tgt, sub, in_init)
-                elif isinstance(sub, ast.Call) and \
-                        isinstance(sub.func, ast.Attribute):
-                    owner = _self_attr(sub.func.value)
-                    if owner is not None and sub.func.attr in MUTATORS:
-                        self.state_attrs.add(owner)
-
-    def _classify_target(self, tgt: ast.AST, stmt, in_init: bool) -> None:
-        if isinstance(tgt, (ast.Tuple, ast.List)):
-            for el in tgt.elts:
-                self._classify_target(el, stmt, in_init)
-            return
-        if isinstance(tgt, ast.Subscript):
-            owner = _self_attr(tgt.value)
-            if owner is not None:
-                self.state_attrs.add(owner)
-            return
-        attr = _self_attr(tgt)
-        if attr is None:
-            return
-        value = getattr(stmt, "value", None)
-        factory = self._factory_of(value)
-        if factory in LOCK_FACTORIES:
-            self.lock_attrs.add(attr)
-            self.lock_kind[attr] = factory
-            return
-        if factory in ATOMIC_FACTORIES:
-            self.atomic_attrs.add(attr)
-            return
-        if factory == "Thread":
-            self.thread_attrs.add(attr)
-        if not in_init:
-            self.state_attrs.add(attr)
-
-    @staticmethod
-    def _factory_of(value) -> Optional[str]:
-        if isinstance(value, ast.Call):
-            parts = _dotted(value.func)
-            if parts:
-                return parts[-1]
-        return None
-
-    def _methods(self):
-        for item in self.node.body:
-            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield item
-
-    # -- pass 2: walk --
-
-    def walk(self) -> None:
-        for method in self._methods():
-            walker = _MethodWalker(self, method.name)
-            for stmt in method.body:
-                walker.visit(stmt)
-
-    # -- held-by-callers fixpoint --
-
-    def held_extra(self) -> Dict[str, frozenset]:
-        """Locks a private method may assume held because every
-        intra-class call site holds them."""
-        sites: Dict[str, List[Tuple[frozenset, str, int]]] = {}
-        for call, held, method, depth in self.calls:
-            callee = _self_attr(call.func) \
-                if isinstance(call.func, ast.Attribute) else None
-            if callee and callee.startswith("_") and depth == 0:
-                sites.setdefault(callee, []).append(
-                    (held, method, depth))
-        extra: Dict[str, frozenset] = {}
-        for _ in range(3):  # call chains are shallow; 3 is plenty
-            changed = False
-            for callee, callsites in sites.items():
-                effective = [held | extra.get(method, frozenset())
-                             for held, method, _ in callsites]
-                new = frozenset.intersection(*effective) if effective \
-                    else frozenset()
-                if new != extra.get(callee, frozenset()):
-                    extra[callee] = new
-                    changed = True
-            if not changed:
-                break
-        return extra
-
-    # -- acquired-locks fixpoint (for interprocedural ordering) --
-
-    def acquired(self) -> Dict[str, Set[str]]:
-        direct: Dict[str, Set[str]] = {}
-        callees: Dict[str, Set[str]] = {}
-        for held, lock, _line, method, depth in self.lock_entries:
-            if depth == 0:
-                direct.setdefault(method, set()).add(lock)
-        for call, _held, method, depth in self.calls:
-            callee = _self_attr(call.func) \
-                if isinstance(call.func, ast.Attribute) else None
-            if callee and depth == 0:
-                callees.setdefault(method, set()).add(callee)
-        acq = {m: set(locks) for m, locks in direct.items()}
-        for _ in range(3):
-            changed = False
-            for method, cs in callees.items():
-                cur = acq.setdefault(method, set())
-                for c in cs:
-                    extra = acq.get(c, set()) - cur
-                    if extra:
-                        cur.update(extra)
-                        changed = True
-            if not changed:
-                break
-        return acq
+from ..program import _Access, _blocking_label, _ClassInfo, _self_attr
 
 
 @register
@@ -295,20 +61,19 @@ class GuardedStateChecker(Checker):
 
     def run(self, ctx: RepoContext) -> List[Finding]:
         findings: List[Finding] = []
+        program = ctx.program()
         for mod in ctx.target_modules():
             if mod.tree is None:
                 continue
             for node in ast.walk(mod.tree):
                 if isinstance(node, ast.ClassDef):
-                    findings.extend(self._check_class(mod.rel, node))
+                    cls = program.class_info(node)
+                    if not cls.lock_attrs:
+                        continue
+                    findings.extend(self._check_class(mod.rel, cls))
         return findings
 
-    def _check_class(self, rel: str, node: ast.ClassDef) -> List[Finding]:
-        cls = _ClassInfo(node)
-        cls.classify()
-        if not cls.lock_attrs:
-            return []
-        cls.walk()
+    def _check_class(self, rel: str, cls: _ClassInfo) -> List[Finding]:
         extra = cls.held_extra()
         findings: List[Finding] = []
         findings.extend(self._unguarded(rel, cls, extra))
@@ -362,12 +127,12 @@ class GuardedStateChecker(Checker):
                   extra: Dict[str, frozenset]) -> List[Finding]:
         findings = []
         seen: Set[str] = set()
-        for call, held, method, depth in cls.calls:
+        for call, held, method, depth, _fns in cls.calls:
             eff = held if depth > 0 else \
                 held | extra.get(method, frozenset())
             if not eff:
                 continue
-            label = self._blocking_label(cls, call)
+            label = _blocking_label(cls, call)
             if label is None:
                 continue
             anchor = f"{cls.name}.{method}:{label}"
@@ -384,42 +149,6 @@ class GuardedStateChecker(Checker):
                 anchor=anchor))
         return findings
 
-    def _blocking_label(self, cls: _ClassInfo,
-                        call: ast.Call) -> Optional[str]:
-        func = call.func
-        if isinstance(func, ast.Name):
-            return "open()" if func.id == "open" else None
-        if not isinstance(func, ast.Attribute):
-            return None
-        parts = _dotted(func)
-        root, leaf = parts[0], parts[-1]
-        if root in BLOCKING_MODULES:
-            return ".".join(parts) + "()"
-        if root == "time" and leaf == "sleep":
-            return "time.sleep()"
-        if root == "os" and leaf == "system":
-            return "os.system()"
-        if root == "shutil" and leaf in ("rmtree", "copytree"):
-            return f"shutil.{leaf}()"
-        if leaf == "sleep":
-            return ".".join(parts) + "()"
-        owner = _self_attr(func.value)
-        if leaf == "wait":
-            # Condition/Lock .wait releases the lock — the idiom, not a
-            # bug. A wait on anything else (Event, future) blocks with
-            # the lock held.
-            if owner in cls.lock_attrs:
-                return None
-            return ".".join(parts) + "()"
-        if leaf == "join" and owner is not None and \
-                owner in cls.thread_attrs:
-            return f"self.{owner}.join()"
-        if leaf == "result":
-            return ".".join(parts) + "()"
-        if leaf in ("get", "put") and owner in cls.atomic_attrs:
-            return f"self.{owner}.{leaf}()"
-        return None
-
     # --- RTA103 ---
 
     def _lock_order(self, rel: str, cls: _ClassInfo,
@@ -435,13 +164,12 @@ class GuardedStateChecker(Checker):
                 held | extra.get(method, frozenset())
             for outer in eff:
                 add_edge(outer, lock, line, method)
-        for call, held, method, depth in cls.calls:
+        for call, held, method, depth, _fns in cls.calls:
             eff = held if depth > 0 else \
                 held | extra.get(method, frozenset())
             if not eff:
                 continue
-            callee = _self_attr(call.func) \
-                if isinstance(call.func, ast.Attribute) else None
+            callee = _self_attr(call.func)
             if callee:
                 for inner in acq.get(callee, ()):  # locks the callee takes
                     for outer in eff:

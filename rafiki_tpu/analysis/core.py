@@ -159,6 +159,21 @@ class RepoContext:
             return self.modules
         return [m for m in self.modules if m.rel in self.changed]
 
+    def program(self):
+        """The whole-program model (``analysis.program.Program``) over
+        ALL parsed modules, built lazily ONCE per run and shared by
+        every checker — the single-parse/single-walk contract. Always
+        repo-wide, even in ``--changed`` mode: interprocedural facts
+        (a lock chain ending three modules away) are only sound with
+        the full symbol table."""
+        cached = getattr(self, "_program", None)
+        if cached is None:
+            from . import program as _program
+
+            cached = _program.Program(self.modules)
+            self._program = cached
+        return cached
+
 class Checker:
     """Base class; subclasses register via :func:`register`.
 
@@ -257,13 +272,18 @@ class Report:
     n_files: int
     checkers: List[str]
     stale_baseline: List[str]
+    #: Every code a checker that RAN could have emitted — so
+    #: counts_per_code carries explicit zeros (bench.py --config
+    #: analysis records per-code counts; a zero for RTA104 is
+    #: evidence the gate looked, absence would be ambiguous).
+    covered_codes: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def new(self) -> List[Finding]:
         return [f for f in self.findings if f.status == "new"]
 
     def counts(self) -> Dict[str, int]:
-        out: Dict[str, int] = {}
+        out: Dict[str, int] = {c: 0 for c in self.covered_codes}
         for f in self.findings:
             out[f.code] = out.get(f.code, 0) + 1
         return dict(sorted(out.items()))
@@ -303,12 +323,14 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
                 anchor="syntax"))
 
     ran = []
+    covered: List[str] = []
     for checker in all_checkers():
         if only and checker.name not in only:
             continue
         if not checker.should_run(ctx):
             continue
         ran.append(checker.name)
+        covered.extend(checker.codes)
         findings.extend(checker.run(ctx))
 
     # Reason-less waivers are findings in their own right, everywhere
@@ -370,7 +392,7 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
         stale = []
     return Report(root=ctx.root, findings=deduped,
                   n_files=len(ctx.modules), checkers=ran,
-                  stale_baseline=stale)
+                  stale_baseline=stale, covered_codes=covered)
 
 
 def _waiver_covers(codes: Set[str], code: str) -> bool:

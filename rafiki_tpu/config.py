@@ -178,6 +178,22 @@ class NodeConfig:
     # cache invalidation correctness leans on registration freshness.
     worker_reregister: float = 5.0
 
+    # How long a foreign node's RUNNING row stays credible without a
+    # heartbeat, seconds (admin/services_manager.py). Promoted from an
+    # env-only expert knob (r15): multi-node deployments size it from
+    # their own heartbeat cadence + NFS/sqlite stall budget, which
+    # makes it a per-deployment decision — and the old class-attribute
+    # read froze the value at FIRST import, before apply_env could run.
+    node_lease: float = 120.0
+
+    # InferenceWorker serving-pipeline auto-probe threshold, seconds:
+    # with serving_pipeline=auto the worker pipelines only when the
+    # measured device->host sync latency exceeds this (tunneled chips
+    # ~0.1-0.7s win; directly attached ~1ms lose). Promoted from an
+    # env-only expert knob (r15): the tunneled-vs-direct mix is a
+    # per-deployment fact, not an incident override.
+    pipeline_sync_min: float = 0.02
+
     # --- Trial lifecycle / dataset residency (docs/training.md) ---
     # Host dataset cache: parsed datasets stay resident across trials,
     # keyed by (path, mtime, size), byte-budget LRU. 0 disables.
@@ -366,6 +382,13 @@ class NodeConfig:
                 f"''/int8")
         if self.worker_reregister <= 0:
             raise ValueError("worker_reregister must be positive")
+        if self.node_lease <= 0:
+            raise ValueError("node_lease must be positive (it bounds "
+                             "foreign-node liveness detection)")
+        if self.pipeline_sync_min < 0:
+            raise ValueError("pipeline_sync_min must be >= 0 (0 = "
+                             "auto-pipeline whenever any sync latency "
+                             "is measured)")
         if self.autoscale_max_replicas < 1 or self.autoscale_step < 1:
             raise ValueError("autoscale_max_replicas and autoscale_step "
                              "must be >= 1")
@@ -457,6 +480,13 @@ class NodeConfig:
             str(self.serving_tier_threshold)
         os.environ[self.env_name("worker_reregister")] = \
             str(self.worker_reregister)
+        # Read at construction by ServicesManager (the lease window)
+        # and InferenceWorker (the pipeline auto-probe threshold) — env
+        # is the transport both in-process threads and spawned children
+        # inherit, so RTA505 tracks these two by name.
+        os.environ[self.env_name("node_lease")] = str(self.node_lease)
+        os.environ[self.env_name("pipeline_sync_min")] = \
+            str(self.pipeline_sync_min)
         # Autoscaler: the platform constructs the controller from these
         # at startup (admin/autoscaler.py Autoscaler.from_env); the
         # enable flag is popped when off so "absent = disabled" stays

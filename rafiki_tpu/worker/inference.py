@@ -274,6 +274,8 @@ class InferenceWorker:
         self.pipeline = pipeline
         # Auto threshold: pipeline when a round-trip sync costs more
         # than this many seconds (tunnel ~0.1-0.7s, direct chip ~1ms).
+        # NodeConfig.pipeline_sync_min (promoted from env-only in r15);
+        # env stays the transport so spawned children inherit it.
         self.pipeline_sync_min = float(os.environ.get(
             "RAFIKI_TPU_PIPELINE_SYNC_MIN", "0.02"))
         # The bus registration is a LEASE, not a one-shot: it is

@@ -1,0 +1,50 @@
+"""Concurrency FP guard: the same shapes as concurrency_tp, done
+right — one global lock order, blocking after release, a common guard
+on cross-thread state, and a Queue handoff. Must stay finding-free."""
+
+import threading
+import time
+
+from .sink import StatsSink
+
+
+class Coordinator:
+    """Both cross-class paths order Coordinator._lock ->
+    StatsSink._lock; no cycle."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sink = StatsSink()
+        self._epoch = 0
+
+    def advance(self):
+        with self._lock:
+            self._epoch += 1
+            self._tick()
+
+    def _tick(self):
+        self.sink.record(self._epoch)
+
+    def flush(self):
+        with self._lock:
+            self.sink.record(self._epoch)
+
+
+class Admission:
+    """Snapshot under the lock, block AFTER release — the RTA105 fix
+    shape."""
+
+    def __init__(self):
+        self._gate = threading.Lock()
+        self._n = 0
+
+    def admit(self):
+        with self._gate:
+            self._n += 1
+            n = self._n
+        _backoff(n)
+        return n
+
+
+def _backoff(n):
+    time.sleep(0.001 * n)

@@ -1,0 +1,69 @@
+"""RTA106 FP guard: cross-thread state with a common lock, a Queue
+handoff, and thread-config frozen in __init__ before start()."""
+
+import queue
+import threading
+
+
+class GuardedPoller:
+    """The loop thread and callers share _latest UNDER one lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latest = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._latest = 1
+
+    def read(self):
+        with self._lock:
+            return self._latest
+
+
+class ForeignGuardedPoller:
+    """Both sides guard shared state with a COLLABORATOR's lock — a
+    real guard the checker must honor (review-fix regression: the
+    foreign acquisition enters the held set)."""
+
+    def __init__(self, owner: "GuardedPoller"):
+        self.owner = owner
+        self._v = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self.owner._lock:
+                self._v += 1
+
+    def read(self):
+        with self.owner._lock:
+            return self._v
+
+
+class QueueWorker:
+    """Handoff through an atomic primitive; the interval is bound in
+    __init__ (before start) and only READ afterwards — config, not
+    shared state."""
+
+    def __init__(self, interval):
+        self._q = queue.Queue()
+        self.interval = interval
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+
+    def put(self, item):
+        self._q.put(item)
+
+    def describe(self):
+        return self.interval

@@ -1,0 +1,39 @@
+"""RTA105 TP: blocking reached through the call graph under a lock —
+``admit`` holds ``_gate`` while ``_backoff`` -> ``_pause`` (two frames
+of module-level helpers) reaches ``time.sleep``. RTA102 cannot see it:
+no blocking call appears IN ``admit``."""
+
+import threading
+import time
+
+
+def _backoff():
+    _pause()
+
+
+def _pause():
+    time.sleep(0.1)
+
+
+class Admission:
+    def __init__(self):
+        self._gate = threading.Lock()
+        self._tie_gate = threading.Lock()
+        self._n = 0
+
+    def admit(self):
+        with self._gate:
+            self._n += 1
+            _backoff()
+
+    def admit_both(self):
+        """Module function AND method reach the same terminal sleep at
+        equal chain depth — the dedup tie a review pass found crashing
+        (None-vs-str method-key comparison); kept as the regression.
+        Own lock, so it groups separately from admit()'s finding."""
+        with self._tie_gate:
+            _backoff()
+            self._local()
+
+    def _local(self):
+        _pause()

@@ -1,0 +1,34 @@
+"""RTA104 TP: cross-class lock-order cycle, >=3 frames, cross-module.
+
+``Coordinator.advance`` holds ``Coordinator._lock`` while a helper
+chain three frames deep (``_tick`` -> ``_note`` -> ``sink.record``)
+acquires ``StatsSink._lock`` in the OTHER module; ``StatsSink.flush``
+orders them the other way. Neither class alone looks wrong — exactly
+the shape RTA103 cannot see.
+"""
+
+import threading
+
+from .sink import StatsSink
+
+
+class Coordinator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sink = StatsSink(self)
+        self._epoch = 0
+
+    def advance(self):
+        with self._lock:
+            self._epoch += 1
+            self._tick()
+
+    def _tick(self):
+        self._note()
+
+    def _note(self):
+        self.sink.record(self._epoch)
+
+    def kick(self):
+        with self._lock:
+            self._epoch += 1

@@ -1,0 +1,19 @@
+"""The other half of the RTA104 cycle: StatsSink._lock ->
+Coordinator._lock (the reverse of pipeline.py's order)."""
+
+import threading
+
+
+class StatsSink:
+    def __init__(self, coord: "Coordinator"):
+        self._lock = threading.Lock()
+        self.coord = coord
+        self._rows = []
+
+    def record(self, epoch):
+        with self._lock:
+            self._rows.append(epoch)
+
+    def flush(self):
+        with self._lock:
+            self.coord.kick()

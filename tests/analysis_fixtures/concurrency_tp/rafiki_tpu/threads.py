@@ -1,0 +1,41 @@
+"""RTA106 TP: a thread-root pair sharing attributes with no lock.
+
+``Poller._latest`` is written by the ``Thread(target=self._loop)``
+body and read by callers; ``MiniService._hits`` is written by its loop
+thread and read by an HTTP route handler (the ("GET", path, handler)
+tuple idiom). Neither attribute is ever accessed under any lock.
+"""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._latest = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._latest = self._probe()
+
+    def _probe(self):
+        return 1
+
+    def read(self):
+        return self._latest
+
+
+class MiniService:
+    def __init__(self):
+        self._hits = 0
+        self.routes = [("GET", "/hits", self._get_hits)]
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self):
+        while True:
+            self._hits += 1
+
+    def _get_hits(self, params, body, ctx):
+        return 200, {"hits": self._hits}

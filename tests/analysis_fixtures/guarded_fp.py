@@ -92,3 +92,23 @@ class ReentrantHelper:
     def _insert_locked(self, row):
         with self._lock:
             self._rows.append(row)
+
+
+class ForeignConditionWaiter:
+    """Waiting on a COLLABORATOR's condition releases it — the same
+    release-and-wait idiom as an own-lock wait; must not be RTA102
+    (review-fix regression: foreign lock tokens enter the held set,
+    and the wait exemption must follow them)."""
+
+    def __init__(self, owner):
+        self._lock = threading.Lock()
+        self.owner = owner
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def wait_owner(self):
+        with self.owner._cond:
+            self.owner._cond.wait()

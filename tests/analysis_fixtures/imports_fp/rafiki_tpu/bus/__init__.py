@@ -1,0 +1,1 @@
+"""Fixture bus package (reachability root; clean)."""

@@ -1,0 +1,14 @@
+"""RTA602 FP guard: a TYPE_CHECKING jax import (never executes) and a
+LAZY function-scoped import of the jax-heavy module — the sanctioned
+observe/__init__ pattern."""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import jax  # noqa: F401
+
+
+def serve():
+    from .. import heavy
+
+    return heavy.helper()

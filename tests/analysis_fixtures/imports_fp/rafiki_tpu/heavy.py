@@ -1,0 +1,9 @@
+"""Eagerly imports jax, but NOT import-time reachable from the bus
+package (only the lazy function in broker.py touches it) — no
+RTA602."""
+
+import jax
+
+
+def helper():
+    return jax.devices()

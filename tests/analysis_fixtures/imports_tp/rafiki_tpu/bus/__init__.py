@@ -1,0 +1,1 @@
+"""Fixture bus package (the RTA602 reachability root)."""

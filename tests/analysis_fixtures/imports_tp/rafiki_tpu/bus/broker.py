@@ -1,0 +1,9 @@
+"""A broker module that (transitively) drags jax in at import time —
+the RTA602 TP: ``heavy`` is import-time reachable from the bus root
+and eagerly imports jax."""
+
+from ..heavy import helper
+
+
+def serve():
+    return helper()

@@ -1,0 +1,7 @@
+"""Eager jax import, reachable from rafiki_tpu/bus/ — RTA602."""
+
+import jax
+
+
+def helper():
+    return jax.devices()

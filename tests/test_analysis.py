@@ -132,6 +132,65 @@ def test_drift_false_positive_guard(tmp_path):
     assert report.findings == [], [f.render() for f in report.findings]
 
 
+def test_concurrency_true_positives(tmp_path):
+    root = str(tmp_path / "t")
+    shutil.copytree(os.path.join(FIXTURES, "concurrency_tp"), root)
+    report = _run(root, "concurrency")
+    codes = _codes(report)
+    assert codes == ["RTA104", "RTA105", "RTA106"]
+    by_anchor = {f.anchor: f for f in report.findings}
+    # The cross-class cycle was found through a >=3-frame cross-module
+    # chain: the message must name the intermediate frames.
+    cyc = by_anchor["Coordinator._lock<->StatsSink._lock"]
+    assert "Coordinator._tick" in cyc.message
+    assert "Coordinator._note" in cyc.message
+    assert "sink.py" in cyc.message  # the reverse path's module
+    # Blocking two module-function frames down, none of it in admit().
+    blk = by_anchor["Admission.admit->_backoff:time.sleep()"]
+    assert "_backoff -> _pause" in blk.message
+    # Thread-root pair sharing an attribute: Thread target and an HTTP
+    # route handler both fire.
+    assert "Poller._latest:cross-root" in by_anchor
+    assert "MiniService._hits:cross-root" in by_anchor
+
+
+def test_concurrency_false_positive_guard(tmp_path):
+    root = str(tmp_path / "t")
+    shutil.copytree(os.path.join(FIXTURES, "concurrency_fp"), root)
+    report = _run(root, "concurrency")
+    assert report.findings == [], [f.render() for f in report.findings]
+
+
+def test_import_hygiene_true_positives(tmp_path):
+    root = str(tmp_path / "t")
+    shutil.copytree(os.path.join(FIXTURES, "imports_tp"), root)
+    report = _run(root, "import-hygiene")
+    codes = _codes(report)
+    assert codes == ["RTA601", "RTA602"]
+    msgs = "\n".join(f.message for f in report.findings)
+    assert "builds/starts a thread" in msgs
+    assert "binds a socket/server" in msgs
+    assert "spawns a process" in msgs
+    assert "APP_DEBUG" in msgs       # module-level env read
+    assert "APP_LEASE" in msgs       # class-BODY env read (executes
+    #                                  on import — the NODE_LEASE bug)
+    assert "APP_ELSE" in msgs        # else-arm of a __main__ guard
+    assert "APP_INVERTED" in msgs    # body of an inverted guard
+    assert "APP_SUB_LEASE" in msgs   # os.environ["X"] subscript read
+    jax_f = [f for f in report.findings if f.code == "RTA602"]
+    assert len(jax_f) == 1
+    # The finding names the import chain from the bus root.
+    assert "rafiki_tpu/bus/broker.py -> rafiki_tpu/heavy.py" \
+        in jax_f[0].message
+
+
+def test_import_hygiene_false_positive_guard(tmp_path):
+    root = str(tmp_path / "t")
+    shutil.copytree(os.path.join(FIXTURES, "imports_fp"), root)
+    report = _run(root, "import-hygiene")
+    assert report.findings == [], [f.render() for f in report.findings]
+
+
 # --- Waivers -----------------------------------------------------------
 
 
@@ -324,14 +383,18 @@ def test_update_baseline_refuses_changed_scope(tmp_path):
 
 
 def _mutated_tree(tmp_path, rel_src, replacements, dst_name=None):
+    """Copy ONE real source file into a fixture tree, applying textual
+    mutations. ``dst_name`` may carry subdirectories (to preserve a
+    package path the checker keys on, e.g. ``bus/base.py``)."""
     with open(os.path.join(REPO, rel_src), encoding="utf-8") as f:
         text = f.read()
     for old, new in replacements:
         assert old in text, f"mutation target {old!r} missing in {rel_src}"
         text = text.replace(old, new)
-    pkg = tmp_path / "rafiki_tpu"
-    pkg.mkdir(parents=True, exist_ok=True)
-    (pkg / (dst_name or os.path.basename(rel_src))).write_text(text)
+    dst = tmp_path / "rafiki_tpu" / (dst_name or
+                                     os.path.basename(rel_src))
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(text)
     return str(tmp_path)
 
 
@@ -379,6 +442,105 @@ def test_donating_staged_arrays_fails_suite(tmp_path):
     report = run_suite(mutated, only=["donation"])
     assert any(f.code == "RTA401" for f in report.new), \
         [f.render() for f in report.new]
+
+
+def test_unguarded_cross_thread_write_fails_suite(tmp_path):
+    """r14 breaker-class invariant: _PersistStage state is shared
+    between the executor-submitted tail and the trial loop ONLY under
+    its lock; stripping the locks (the unguarded-cross-thread-write
+    mutation) must turn the suite red via RTA106."""
+    clean = _mutated_tree(tmp_path / "clean",
+                          "rafiki_tpu/worker/runner.py", [])
+    report = run_suite(clean, only=["concurrency"])
+    assert not [f for f in report.new if f.code == "RTA106"], \
+        [f.render() for f in report.new]
+    mutated = _mutated_tree(tmp_path / "mut",
+                            "rafiki_tpu/worker/runner.py",
+                            [("with self._lock:", "if True:")])
+    report = run_suite(mutated, only=["concurrency"])
+    cross = [f for f in report.new if f.code == "RTA106"]
+    assert any(f.anchor == "_PersistStage._pending:cross-root"
+               for f in cross), [f.render() for f in report.new]
+
+
+def test_cross_class_lock_inversion_fails_suite(tmp_path):
+    """RTA104 gate: the batcher already takes MicroBatcher._cond ->
+    ServingStats._lock (stats calls under the admission lock).
+    Re-introducing the reverse order — a method that freezes the stats
+    lock and then reaches for the admission lock, the accretion shape
+    r12-era review had to catch by hand — must fail the suite."""
+    inversion = (
+        "    def freeze_stats(self):\n"
+        "        with self.stats._lock:\n"
+        "            with self._cond:\n"
+        "                return len(self._queue)\n"
+        "\n"
+        "    def _retry_after(self) -> float:")
+    for name, reps in (("clean", []),
+                       ("mut", [("    def _retry_after(self) -> float:",
+                                 inversion)])):
+        root = _mutated_tree(tmp_path / name,
+                             "rafiki_tpu/predictor/batcher.py", reps)
+        _mutated_tree(tmp_path / name,
+                      "rafiki_tpu/observe/serving.py", [])
+        report = run_suite(root, only=["concurrency"])
+        cycles = [f for f in report.new if f.code == "RTA104"]
+        if name == "clean":
+            assert cycles == [], [f.render() for f in cycles]
+        else:
+            assert any(f.anchor ==
+                       "MicroBatcher._cond<->ServingStats._lock"
+                       for f in cycles), \
+                [f.render() for f in report.new]
+
+
+def test_eager_jax_on_bus_path_fails_suite(tmp_path):
+    """PR 2 lazy-import invariant, now enforced: observe.metrics is
+    import-time reachable from the bus package, so adding an eager
+    `import jax` there must fail the suite via RTA602."""
+    for name, reps in (("clean", []),
+                       ("mut", [("import json",
+                                 "import jax\nimport json")])):
+        root = _mutated_tree(tmp_path / name,
+                             "rafiki_tpu/observe/metrics.py", reps,
+                             dst_name="observe/metrics.py")
+        _mutated_tree(tmp_path / name, "rafiki_tpu/bus/base.py", [],
+                      dst_name="bus/base.py")
+        report = run_suite(root, only=["import-hygiene"])
+        eager = [f for f in report.new if f.code == "RTA602"]
+        if name == "clean":
+            assert eager == [], [f.render() for f in eager]
+        else:
+            assert any(f.path == "rafiki_tpu/observe/metrics.py"
+                       for f in eager), \
+                [f.render() for f in report.new]
+
+
+# --- CLI: --explain ----------------------------------------------------
+
+
+def test_cli_explain():
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.analysis", "--explain",
+         "RTA104"], capture_output=True, text=True, timeout=120,
+        cwd=REPO)
+    assert proc.returncode == 0
+    assert "cross-class lock-order cycle" in proc.stdout
+    assert "fix   :" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.analysis", "--explain",
+         "RTA999"], capture_output=True, text=True, timeout=120,
+        cwd=REPO)
+    assert proc.returncode == 2
+    assert "unknown code" in proc.stderr
+
+
+def test_catalog_covers_every_registered_code():
+    from rafiki_tpu.analysis.catalog import CATALOG
+
+    codes = {c for ch in core.all_checkers() for c in ch.codes}
+    codes |= {"RTA000", "RTA001", "RTA002"}
+    assert codes <= set(CATALOG), sorted(codes - set(CATALOG))
 
 
 # --- Integration: this repo, the committed baseline -------------------
