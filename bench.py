@@ -714,6 +714,12 @@ _WORKLOAD = None
 _QUANT = None
 _QUANT_TOL = 0.02
 
+#: --stacked override for serving-concurrent (set by _main_cli): runs
+#: the stacked-ensemble A/B (vmap-stacked multi-member bin vs the same
+#: bin served per-member) instead of the uniform matrix. The OFF side
+#: runs FIRST and is asserted to expose ZERO stacked series.
+_STACKED = False
+
 
 def _serving_wire_fields() -> dict:
     """``wire_format``/``quant`` on every serving record: which wire
@@ -887,6 +893,195 @@ def _serving_quant_ab(mode: str) -> dict:
         accuracy_delta=round(delta, 4),
         accuracy_tolerance=_QUANT_TOL,
         accuracy_gate=gate)
+
+
+def _serving_stacked_ab() -> dict:
+    """``--stacked`` — the compiled-megabatch ensemble A/B (ISSUE
+    r16): ONE worker owning the node's whole chip slice serves a
+    2-member same-family bin, stacked (one vmapped dispatch per
+    burst) vs per-member (one dispatch per member per burst).
+
+    Order matters for the disabled-plane evidence: the OFF side
+    deploys and serves FIRST and its /metrics are asserted to carry
+    ZERO stacked series (the registry is process-global, so this is
+    only judgeable before the ON side exists). The judged evidence is
+    counter deltas per the r9 discipline: ``stacked_dispatch_total``
+    strictly up over a counted request phase, dispatches/query =
+    delta/queries, and the per-member equivalent is ``members ×`` that
+    by construction (the same burst stream costs one dispatch per
+    member per-member — the unit gate in tests/test_stacked.py counts
+    the real calls); the qps ratio is recorded with per-side
+    windows+spread (multichip channel judges throughput)."""
+    import tempfile
+
+    import requests
+
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.config import NodeConfig
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.observe.metrics import parse_exposition
+    from rafiki_tpu.platform import LocalPlatform
+
+    n_clients, window_s, per_request = 8, 8.0, 16
+    counted_requests = 40  # the dispatch-accounting phase (side S)
+    stacked_env = NodeConfig.env_name("serving_stacked")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        train_path, val_path = make_synthetic_image_dataset_compat(
+            tmp, n_train=2048, n_val=256)
+        prior_stacked = os.environ.get(stacked_env)
+        os.environ[stacked_env] = "off"  # OFF side deploys first
+        platform = LocalPlatform(workdir=f"{tmp}/plat")
+        try:
+            import jax
+
+            n_devices = len(jax.devices())
+            admin = platform.admin
+            cache = Cache(platform.bus)
+            user = admin.create_user("cc@x.c", "pw",
+                                     UserType.MODEL_DEVELOPER)
+            mrow = admin.create_model(
+                user["id"], "ff-cc", TaskType.IMAGE_CLASSIFICATION,
+                "rafiki_tpu.models.feedforward:JaxFeedForward")
+            job = admin.create_train_job(
+                user["id"], "cc", TaskType.IMAGE_CLASSIFICATION,
+                [mrow["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 2},
+                train_path, val_path)
+            assert admin.wait_until_train_job_done(job["id"],
+                                                   timeout=1200)
+            val_ds = load_image_dataset(val_path)
+            batch = [encode_payload(val_ds.images[i % val_ds.size])
+                     for i in range(per_request)]
+            whole_slice = platform.services.allocator.n_chips
+
+            def start_job(want_stacked):
+                # chips_per_worker = the WHOLE slice: only one group
+                # fits, so both trials pack onto ONE worker whose
+                # mesh spans every device — the compiled-megabatch
+                # deploy shape (the second job's group time-slices
+                # the same slice; windows interleave per round, and
+                # the judged evidence is counter deltas anyway).
+                inf = admin.create_inference_job(
+                    user["id"], job["id"], max_models=2,
+                    chips_per_worker=max(1, whole_slice))
+                deadline = time.time() + 600
+                while not cache.running_workers(inf["id"]) \
+                        and time.time() < deadline:
+                    time.sleep(0.5)
+                info = cache.running_worker_info(inf["id"])
+                assert len(info) == 1, \
+                    f"expected ONE packed worker, got {len(info)}"
+                (reg,) = info.values()
+                members = str(reg["trial_id"]).split(",")
+                assert len(members) == 2, members
+                assert bool(reg.get("stacked")) is want_stacked, reg
+                host = admin.get_inference_job(inf["id"])[
+                    "predictor_host"]
+                r = requests.post(f"http://{host}/predict",
+                                  json={"queries": batch}, timeout=300)
+                r.raise_for_status()
+                return inf["id"], host, len(members)
+
+            def stacked_series(host):
+                m = parse_exposition(requests.get(
+                    f"http://{host}/metrics", timeout=30).text)
+                return {k: m[k] for k in (
+                    "rafiki_tpu_serving_stacked_dispatch_total",
+                    "rafiki_tpu_serving_dispatches_per_query_ratio")
+                    if m.get(k)}
+
+            def dispatch_total(host, mode):
+                m = parse_exposition(requests.get(
+                    f"http://{host}/metrics", timeout=30).text)
+                return sum(v for labels, v in m.get(
+                    "rafiki_tpu_serving_stacked_dispatch_total", [])
+                    if labels.get("mode") == mode)
+
+            inf_p, host_p, _ = start_job(False)
+            # The disabled-plane gate, judged while the ON side does
+            # not exist yet: a full serve registered NOTHING stacked.
+            off_series = stacked_series(host_p)
+            assert not off_series, off_series
+
+            os.environ[stacked_env] = "on"
+            try:
+                inf_s, host_s, members = start_job(True)
+            finally:
+                os.environ[stacked_env] = "off"
+
+            # Counted phase: a known query volume against the stacked
+            # side pins dispatches/query from counter deltas.
+            d0 = dispatch_total(host_s, "stacked")
+            for _ in range(counted_requests):
+                r = requests.post(f"http://{host_s}/predict",
+                                  json={"queries": batch}, timeout=300)
+                r.raise_for_status()
+            d_stacked = dispatch_total(host_s, "stacked") - d0
+            n_queries = counted_requests * per_request
+            # The MEASURED gates: the counter moved, and the stacked
+            # side paid at most ONE ensemble dispatch per request
+            # (i.e. per burst) — a regression to per-member dispatch
+            # under the stacked counter would show ~members x here.
+            assert d_stacked > 0, "stacked dispatch counter flat"
+            assert d_stacked <= counted_requests, \
+                (d_stacked, counted_requests)
+            dpq_stacked = d_stacked / n_queries
+            # The per-member figure is DERIVED (members x stacked):
+            # the off side exposes zero stacked series by design, so
+            # its dispatches are uncounted here — the measured
+            # members-vs-one comparison lives in tests/test_stacked.py
+            # (real dispatch-call counting on the same burst).
+            dpq_permember = members * dpq_stacked
+
+            def one_window(url):
+                return _closed_loop_window(
+                    url, {"queries": batch}, n_clients, window_s,
+                    count_by=len(batch))
+
+            url_s = f"http://{host_s}/predict"
+            url_p = f"http://{host_p}/predict"
+            one_window(url_s)  # warm (untimed)
+            one_window(url_p)
+            vals_s: list = []
+            vals_p: list = []
+            for _ in range(3):
+                vals_s.append(one_window(url_s))
+                vals_p.append(one_window(url_p))
+                if _settled(vals_s) and _settled(vals_p):
+                    break
+            fallback = dispatch_total(host_s, "fallback")
+            for inf in (inf_s, inf_p):
+                admin.stop_inference_job(inf)
+        finally:
+            platform.shutdown()
+            if prior_stacked is None:
+                os.environ.pop(stacked_env, None)
+            else:
+                os.environ[stacked_env] = prior_stacked
+
+    best_s, best_p = max(vals_s), max(vals_p)
+    return _emit(
+        "serving_concurrent_qps", best_s, "queries/s",
+        **_serving_wire_fields(),
+        stacked=True,
+        n_devices=n_devices,
+        n_members=members,
+        n_clients=n_clients,
+        n_windows=len(vals_s),
+        spread=round((best_s - min(vals_s)) / best_s, 3),
+        spread_off=round((best_p - min(vals_p)) / best_p, 3),
+        windows_stacked_on=[round(v, 2) for v in vals_s],
+        windows_stacked_off=[round(v, 2) for v in vals_p],
+        qps_stacked_on=round(best_s, 2),
+        qps_stacked_off=round(best_p, 2),
+        stacked_speedup=round(best_s / best_p, 3),
+        stacked_dispatches=int(d_stacked),
+        stacked_fallback_dispatches=int(fallback),
+        counted_queries=int(n_queries),
+        dispatches_per_query_stacked=round(dpq_stacked, 5),
+        dispatches_per_query_permember_derived=round(dpq_permember, 5),
+        off_new_series=0)
 
 
 def _serving_zipf_ab(workload: str) -> dict:
@@ -1184,6 +1379,8 @@ def main_serving_concurrent() -> dict:
 
     if _QUANT:
         return _serving_quant_ab(_QUANT)
+    if _STACKED:
+        return _serving_stacked_ab()
     if _WORKLOAD and _WORKLOAD.startswith("zipf"):
         return _serving_zipf_ab(_WORKLOAD)
 
@@ -2436,7 +2633,7 @@ def _main_cli() -> None:
     import argparse
     import os
 
-    global _QUANT, _QUANT_TOL, _WORKLOAD
+    global _QUANT, _QUANT_TOL, _WORKLOAD, _STACKED
 
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -2459,7 +2656,26 @@ def _main_cli() -> None:
         "--quant-tol", type=float, default=_QUANT_TOL,
         help="accuracy-delta tolerance for --quant (|acc_f32 - "
              "acc_int8| must not exceed it; default %(default)s).")
+    parser.add_argument(
+        "--stacked", action="store_true",
+        help="serving-concurrent stacked-ensemble A/B: ONE packed "
+             "worker serves a 2-member bin vmap-stacked (one device "
+             "dispatch per burst) vs per-member; counter-gated "
+             "(stacked dispatches up, off side zero stacked series).")
+    parser.add_argument(
+        "--devices", type=int, default=None,
+        help="force this many (virtual, on CPU fallback) devices — "
+             "the multichip channel's knob (e.g. 8 for the "
+             "MULTICHIP record).")
     args = parser.parse_args()
+    if args.stacked:
+        if args.config != "serving-concurrent":
+            parser.error("--stacked only applies to "
+                         "--config serving-concurrent")
+        if args.quant is not None or args.workload is not None:
+            parser.error("--stacked, --quant and --workload are "
+                         "separate experiments; pick one")
+        _STACKED = True
     if args.quant is not None:
         if args.config != "serving-concurrent":
             parser.error("--quant only applies to "
@@ -2510,7 +2726,8 @@ def _main_cli() -> None:
         # FIRST starved scale-up preempts the idle donor (the judged
         # causal chain, with minimal mid-ramp compile churn).
         ensure_platform(n_virtual_devices=(
-            (4 if _WORKLOAD else 2)
+            args.devices if args.devices
+            else (4 if _WORKLOAD else 2)
             if args.config == "serving-concurrent"
             else 3 if args.config == "chaos"
             else 4 if args.config == "autoscale" else None))

@@ -408,8 +408,14 @@ class Admin:
 
     def create_inference_job(self, user_id: str, train_job_id: str,
                              max_models: int = 2,
+                             chips_per_worker: int = 1,
                              claims: Optional[Dict[str, Any]] = None,
                              ) -> Dict[str, Any]:
+        """``chips_per_worker > 1`` deploys each serving worker on a
+        LARGER chip group — with a group spanning the node's slice,
+        the whole best-N ensemble packs onto ONE worker (the compiled
+        megabatch shape: stacked same-family bins serve as one vmapped
+        dispatch over the full dp width; docs/serving.md)."""
         self._owned_train_job(train_job_id, claims)
         best = self.meta.get_best_trials_of_train_job(train_job_id,
                                                       max_models)
@@ -420,7 +426,8 @@ class Admin:
                                              InferenceJobStatus.STARTED)
         try:
             self.services.create_inference_services(
-                inf["id"], [t["id"] for t in best])
+                inf["id"], [t["id"] for t in best],
+                chips_per_worker=chips_per_worker)
         except Exception:
             self.meta.update_inference_job(inf["id"],
                                            status=InferenceJobStatus.ERRORED)
@@ -495,21 +502,35 @@ class Admin:
             raise ValueError(
                 f"trial {trial_id} is already served by this job")
         old_rows: List[Dict[str, Any]] = []
+        multi_rows: List[Dict[str, Any]] = []
         if replace_trial_id is not None:
             for w in rows:
                 members = str(w["trial_id"]).split(",")
                 if replace_trial_id not in members:
                     continue
-                if len(members) > 1:
-                    raise ValueError(
-                        f"bin {w['trial_id']!r} packs several trials; "
-                        f"promotion cannot surgically replace one "
-                        f"member — replace the whole bin")
-                old_rows.append(w)
-            if not old_rows:
+                (multi_rows if len(members) > 1 else old_rows).append(w)
+            if not old_rows and not multi_rows:
                 raise ValueError(
                     f"trial {replace_trial_id} is not a served bin of "
                     f"this job")
+            if multi_rows and old_rows:
+                raise ValueError(
+                    f"trial {replace_trial_id} is served both alone "
+                    f"and inside a packed bin; promotion cannot "
+                    f"target that mix")
+        if multi_rows:
+            # Surgical member replacement inside a packed bin — only
+            # for workers that advertise ``stacked: true``: their
+            # vmap-stacked weights swap ONE member's slices in place
+            # (worker-side restack), the other members stay
+            # device-resident, and no new worker launches. Per-member
+            # runners cannot do this safely (the r12 refusal stands).
+            # rta: disable=RTA105 deliberate (r12 rationale): holding _promote_lock across the restack wait is what serializes concurrent promotes of one trial; see promote_trial's docstring
+            result = self._restack_packed_bins(
+                job, trial_id, replace_trial_id, multi_rows,
+                register_timeout)
+            self._invalidate_predictor_cache(job)
+            return result
         # Launch + wait-for-registration + teardown live in the
         # ServicesManager now (swap_inference_worker, the public
         # hot-swap seam): the new bin must be LIVE on the bus before
@@ -531,6 +552,111 @@ class Admin:
                 "replaced_trial_id": replace_trial_id,
                 "new_service_id": swap["new_service"]["id"],
                 "stopped_service_ids": swap["stopped_service_ids"]}
+
+    def _restack_packed_bins(self, job: Dict[str, Any],
+                             trial_id: str, replace_trial_id: str,
+                             multi_rows: List[Dict[str, Any]],
+                             register_timeout: float,
+                             ) -> Dict[str, Any]:
+        """The stacked promote path: push a ``__restack__`` marker to
+        every worker serving the packed bin, then WAIT for each
+        worker's re-registration to show the new member (the worker
+        re-registers only after the member's weights are swapped into
+        the stacked device arrays — the moment the new bin serves).
+        A worker whose restack fails (incongruent family, load error)
+        keeps its old registration, so the poll times out and this
+        raises — after converging the REST of the replicas back: any
+        worker that already confirmed gets a reverse restack
+        (new → old) so a multi-replica bin does not keep serving
+        split-brain, and the predictor edge cache is invalidated
+        best-effort (a still-queued marker on a backlogged worker may
+        apply after this raises; the predictor's serving-vector
+        self-check is the backstop for any answer cached across that
+        late swap)."""
+        import time as _time
+
+        from ..cache import Cache as _BusCache
+
+        inference_job_id = job["id"]
+        cache = _BusCache(self.services.serving_bus())
+        info = cache.running_worker_info(inference_job_id)
+        not_stacked = [w["service_id"] for w in multi_rows
+                       if not (info.get(w["service_id"]) or {})
+                       .get("stacked")]
+        if not_stacked:
+            raise ValueError(
+                f"bin {multi_rows[0]['trial_id']!r} packs several "
+                f"trials and worker(s) "
+                f"{[s[:8] for s in not_stacked]} serve it per-member; "
+                f"promotion cannot surgically replace one member — "
+                f"replace the whole bin (stacked workers restack in "
+                f"place; see docs/serving.md)")
+        for w in multi_rows:
+            cache.send_restack(w["service_id"], replace_trial_id,
+                               trial_id)
+        deadline = _time.monotonic() + register_timeout
+        pending = {w["service_id"] for w in multi_rows}
+        confirmed: List[str] = []
+        while pending:
+            if _time.monotonic() >= deadline:
+                self._rollback_restacks(cache, inference_job_id,
+                                        confirmed, trial_id,
+                                        replace_trial_id, job)
+                raise RuntimeError(
+                    f"worker(s) {[s[:8] for s in sorted(pending)]} did "
+                    f"not confirm the restack within "
+                    f"{register_timeout}s; confirmed replica(s) "
+                    f"{[s[:8] for s in confirmed]} were rolled back "
+                    f"(reverse restack) so the old member set keeps "
+                    f"serving")
+            info = cache.running_worker_info(inference_job_id)
+            for sid in list(pending):
+                members = str((info.get(sid) or {})
+                              .get("trial_id", "")).split(",")
+                if trial_id in members and \
+                        replace_trial_id not in members:
+                    pending.discard(sid)
+                    confirmed.append(sid)
+            if pending:
+                # rta: disable=RTA102 deliberate (r12 rationale): the registration-confirm poll must complete under _promote_lock or a concurrent promote could double-target the bin mid-swap
+                _time.sleep(0.1)
+        _log.info("promoted trial %s into inference job %s by "
+                  "restacking %d packed worker(s) (replaced %s in "
+                  "place)", trial_id, inference_job_id,
+                  len(multi_rows), replace_trial_id)
+        return {"inference_job_id": inference_job_id,
+                "promoted_trial_id": trial_id,
+                "replaced_trial_id": replace_trial_id,
+                "new_service_id": None,
+                "restacked_service_ids": [w["service_id"]
+                                          for w in multi_rows],
+                "stopped_service_ids": []}
+
+    def _rollback_restacks(self, cache, inference_job_id: str,
+                           confirmed: List[str], trial_id: str,
+                           replace_trial_id: str,
+                           job: Dict[str, Any]) -> None:
+        """Failure half of the surgical promote: reverse-restack every
+        replica that already swapped (so the bin converges back to the
+        OLD member set instead of serving split-brain) and invalidate
+        the predictor edge cache — answers computed during the partial
+        window must not outlive it. Both are best-effort: the promote
+        is raising anyway, and the reverse marker rides the same
+        queue-ordered mechanism as the forward one."""
+        for sid in confirmed:
+            try:
+                cache.send_restack(sid, trial_id, replace_trial_id)
+            except (ConnectionError, OSError, RuntimeError):
+                _log.exception(
+                    "reverse restack to %s failed; the replica keeps "
+                    "the promoted member until the next promote",
+                    sid[:8])
+        if confirmed:
+            try:
+                self._invalidate_predictor_cache(job)
+            except RuntimeError:
+                _log.exception("edge-cache invalidation after a "
+                               "partial restack failed")
 
     def _invalidate_predictor_cache(self, job: Dict[str, Any]) -> None:
         """Synchronous edge-cache invalidation on the job's predictor

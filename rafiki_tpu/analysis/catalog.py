@@ -39,6 +39,19 @@ CATALOG: Dict[str, Dict[str, str]] = {
         "hint": "Replace the placeholder with why the finding is "
                 "accepted.",
     },
+    "RTA003": {
+        "title": "stale waiver",
+        "flags": "A reasoned `# rta: disable=CODE` comment whose "
+                 "finding no longer fires (full runs; under "
+                 "--checker scoping only codes a ran checker covers).",
+        "bug": "A dead disable rots silently and pre-waives the NEXT "
+               "regression on that line — found live in r16: a "
+               "second RTA301 waiver for a label whose one "
+               "per-module finding was already anchored (and waived) "
+               "elsewhere. Not waivable, by design.",
+        "hint": "Delete the comment (the defect was fixed), or fix "
+                "the code list if it was a typo.",
+    },
     "RTA101": {
         "title": "guarded attribute accessed without its lock",
         "flags": "A class attribute accessed under `with self._lock:` "

@@ -34,7 +34,9 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 #: Meta-codes emitted by the framework itself (not waivable).
 CODE_WAIVER_NO_REASON = "RTA001"
 CODE_BASELINE_NO_REASON = "RTA002"
-_UNWAIVABLE = {CODE_WAIVER_NO_REASON, CODE_BASELINE_NO_REASON}
+CODE_STALE_WAIVER = "RTA003"
+_UNWAIVABLE = {CODE_WAIVER_NO_REASON, CODE_BASELINE_NO_REASON,
+               CODE_STALE_WAIVER}
 
 WAIVER_RE = re.compile(
     r"#\s*rta:\s*disable=([A-Z0-9x,]+)(?:\s+(\S.*))?\s*$")
@@ -353,7 +355,11 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
 
     # Classify: inline waiver first (same line or the line above the
     # finding — the comment-above form keeps long lines readable),
-    # baseline second.
+    # baseline second. Waiver lines that actually suppressed a finding
+    # are remembered: a reasoned waiver no finding matches anymore is
+    # itself a finding (RTA003 below) — silently rotting disables are
+    # how a real regression later slips in pre-waived.
+    used_waivers: Set[Tuple[str, int]] = set()
     seen: Set[str] = set()
     deduped: List[Finding] = []
     for f in findings:
@@ -367,6 +373,7 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
                 if entry and _waiver_covers(entry[0], f.code) \
                         and entry[1]:
                     f.status, f.reason = "waived", entry[1]
+                    used_waivers.add((f.path, line))
                     break
             if f.status == "new" and f.ident in baseline:
                 reason = baseline[f.ident]
@@ -381,6 +388,33 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
                         anchor=f"baseline:{f.ident}"))
                     f.status, f.reason = "baselined", reason
         deduped.append(f)
+
+    # Stale-WAIVER detection (RTA003): a reasoned `# rta: disable=`
+    # comment that suppressed nothing this run is dead — either the
+    # guarded defect was fixed (delete the comment) or the code it
+    # names is a typo (it never guarded anything). Only sound when the
+    # full file view ran (``--changed`` skips unscanned modules whose
+    # waivers would all read unused); under ``--checker`` scoping a
+    # waiver counts only when a ran checker COVERS one of its codes.
+    if changed is None:
+        for mod in ctx.modules:
+            for line, (codes, reason) in mod.waivers().items():
+                if not reason or (mod.rel, line) in used_waivers:
+                    continue  # reasonless = RTA001's finding already
+                if only and not any(_code_covered(c, covered)
+                                    for c in codes):
+                    continue  # that checker didn't run this time
+                deduped.append(Finding(
+                    code=CODE_STALE_WAIVER, path=mod.rel, line=line,
+                    message="stale waiver: `# rta: disable=%s` "
+                            "suppresses nothing — the finding no "
+                            "longer fires (or the code is unknown); "
+                            "delete the comment"
+                            % ",".join(sorted(codes)),
+                    hint="a dead disable pre-waives the NEXT "
+                         "regression on this line; remove it (or fix "
+                         "the code list if it was a typo)",
+                    anchor=f"stale-waiver:{line}"))
 
     # Stale detection is only sound on a FULL run: a scoped run
     # (--changed / --checker) never produces findings for unscanned
@@ -401,6 +435,19 @@ def _waiver_covers(codes: Set[str], code: str) -> bool:
         return True
     return any(c.endswith("xx") and code.startswith(c[:-2])
                for c in codes)
+
+
+def _code_covered(code: str, covered: Sequence[str]) -> bool:
+    """Whether a waiver's ``code`` (exact or ``RTAxx`` class form)
+    belongs to a checker that RAN — the RTA003 scoping guard.
+    Framework meta-codes count as always covered (run_suite itself
+    emits them every run, and they are unwaivable — a waiver naming
+    one is dead by construction)."""
+    if code in covered or code in _UNWAIVABLE or code == "RTA000":
+        return True
+    if code.endswith("xx"):
+        return any(c.startswith(code[:-2]) for c in covered)
+    return False
 
 
 # --- Git (--changed mode) --------------------------------------------

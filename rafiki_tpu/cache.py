@@ -63,6 +63,12 @@ _CORRUPT_REPLY_CAP = 4096
 #: finishes the burst in hand and exits its serve loop cleanly.
 DRAIN_KEY = "__drain__"
 
+#: Promote-path restack marker frame key (Admin.promote_trial on a
+#: stacked multi-member bin): the worker swaps ONE served member in
+#: place — queue-ordered like the drain marker, so everything enqueued
+#: before it serves from the old member set.
+RESTACK_KEY = "__restack__"
+
 
 def encode_payload(value: Any) -> Any:
     """JSON-safe encoding; numpy arrays → base64 frames."""
@@ -622,6 +628,18 @@ class Cache:
         'let in-flight shards finish' is by construction."""
         self.bus.push(f"q:{worker_id}", {DRAIN_KEY: 1})
 
+    def send_restack(self, worker_id: str, old_trial_id: str,
+                     new_trial_id: str) -> None:
+        """Queue a member-swap marker for a STACKED multi-member bin
+        (the surgical promote path): the worker replaces
+        ``old_trial_id``'s member with ``new_trial_id``'s in place —
+        the other members stay device-resident — and re-registers with
+        the updated bin. Queue ordering makes the cutover exact: every
+        shard enqueued before the marker is answered by the old member
+        set."""
+        self.bus.push(f"q:{worker_id}", {RESTACK_KEY: {
+            "old": str(old_trial_id), "new": str(new_trial_id)}})
+
     # --- Queries (InferenceWorker side) ---
 
     def pop_queries(self, worker_id: str, max_items: int = 0,
@@ -638,7 +656,7 @@ class Cache:
                                  timeout=timeout)
         counting = _wire.counting()
         for it in items:
-            if DRAIN_KEY in it:
+            if DRAIN_KEY in it or RESTACK_KEY in it:
                 pass  # control marker; the worker's loop acts on it
             elif "batch" in it:
                 raw = it["batch"]

@@ -132,6 +132,14 @@ class NodeConfig:
     # bench.py --config serving-concurrent --quant int8 gates on the
     # f32-vs-int8 accuracy delta.
     serving_quant: str = ""
+    # Stacked-ensemble serving (docs/serving.md "Stacked ensembles"):
+    # "on" (default) lets an InferenceWorker hosting a multi-member
+    # same-family bin stack the member weights along a leading model
+    # axis and serve every burst as ONE vmapped device dispatch
+    # (shape-congruence probed at load; incongruent or sk-style
+    # members fall back to per-member runners). "off" = per-member
+    # serving and ZERO stacked metric series.
+    serving_stacked: str = "on"
 
     # --- Metrics-driven autoscaler (docs/autoscaling.md) ---
     # Default OFF: supervise pays one attribute check, zero new metric
@@ -370,7 +378,8 @@ class NodeConfig:
         # env readers fail SAFE on anything outside them; config
         # rejects typos LOUDLY here — one list, two postures).
         from .observe.wire import (known_packed_wire_spelling,
-                                   known_quant_spelling)
+                                   known_quant_spelling,
+                                   known_stacked_spelling)
 
         if not known_packed_wire_spelling(self.serving_packed_wire):
             raise ValueError(
@@ -380,6 +389,10 @@ class NodeConfig:
             raise ValueError(
                 f"serving_quant {self.serving_quant!r} is not one of "
                 f"''/int8")
+        if not known_stacked_spelling(self.serving_stacked):
+            raise ValueError(
+                f"serving_stacked {self.serving_stacked!r} is not one "
+                f"of on/off")
         if self.worker_reregister <= 0:
             raise ValueError("worker_reregister must be positive")
         if self.node_lease <= 0:
@@ -511,7 +524,7 @@ class NodeConfig:
         # snapshot these at construction (observe.wire normalizes the
         # spellings); the quant knob pops when empty so a worker's
         # getenv default ("" = serve trained dtype) stays the contract.
-        from .observe.wire import packed_wire_mode
+        from .observe.wire import packed_wire_mode, stacked_mode
 
         os.environ[self.env_name("serving_packed_wire")] = \
             packed_wire_mode(self.serving_packed_wire)
@@ -520,6 +533,10 @@ class NodeConfig:
                 self.serving_quant
         else:
             os.environ.pop(self.env_name("serving_quant"), None)
+        # Stacked serving: the InferenceWorker snapshots this at
+        # construction (observe.wire normalizes the spellings).
+        os.environ[self.env_name("serving_stacked")] = \
+            "on" if stacked_mode(self.serving_stacked) else "off"
         # The adaptive ceiling defaults to the legacy fixed knob; only
         # an explicit override is exported (consumers fall back to
         # SERVING_FILL_WINDOW themselves).

@@ -161,6 +161,42 @@ DATASET_CACHE_ENV = "RAFIKI_TPU_DATASET_CACHE_BYTES"
 DATASET_CACHE_DEFAULT = 1 << 30  # keep NodeConfig.dataset_cache_bytes equal
 
 
+# --- Cache-entry ownership (cross-sub-job eviction preference) -------
+#
+# The residency caches are process-global but their entries belong to
+# a JOB: a resident runner cycling several sub-train-jobs through one
+# worker should evict the OTHER jobs' datasets before its own (the
+# carried r9 item — plain LRU let job B's first staging evict job A's
+# still-hot dataset between A's trials). The owner is a thread-local
+# context the TrialRunner binds around train/evaluate (the same
+# pattern as metrics.label_context); direct SDK callers never bind
+# one and keep plain LRU behavior.
+
+_owner_local = threading.local()
+
+
+class stage_owner:
+    """``with stage_owner(sub_train_job_id): ...`` — marks cache
+    entries created on this thread as owned by that job, and makes
+    evictions it triggers prefer OTHER owners' entries first."""
+
+    def __init__(self, owner: Optional[str]):
+        self._owner = owner
+
+    def __enter__(self):
+        self._prior = getattr(_owner_local, "owner", None)
+        _owner_local.owner = self._owner
+        return self
+
+    def __exit__(self, *exc):
+        _owner_local.owner = self._prior
+        return False
+
+
+def current_stage_owner() -> Optional[str]:
+    return getattr(_owner_local, "owner", None)
+
+
 class ByteBudgetLRU:
     """Byte-budget LRU shared by BOTH residency caches (this module's
     host dataset cache and ``jax_model``'s device staging cache), so
@@ -171,8 +207,9 @@ class ByteBudgetLRU:
     def __init__(self, metrics_name: str):
         self._name = metrics_name
         self._lock = threading.Lock()
-        #: key -> (value, nbytes)
-        self._entries: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
+        #: key -> (value, nbytes, owner)
+        self._entries: "OrderedDict[Any, Tuple[Any, int, Optional[str]]]" \
+            = OrderedDict()
         self._bytes = 0
 
     def get(self, key: Any) -> Optional[Any]:
@@ -187,15 +224,29 @@ class ByteBudgetLRU:
             budget: int) -> None:
         if nbytes > budget:
             return  # would evict everything and still not fit
+        owner = current_stage_owner()
         n_evicted = 0
         with self._lock:
             prev = self._entries.pop(key, None)
             if prev is not None:
                 self._bytes -= prev[1]
-            self._entries[key] = (value, nbytes)
+            self._entries[key] = (value, nbytes, owner)
             self._bytes += nbytes
             while self._bytes > budget and len(self._entries) > 1:
-                _, (_, ev_bytes) = self._entries.popitem(last=False)
+                # Cross-sub-job preference: evict the oldest entry a
+                # DIFFERENT job staged before touching this job's own
+                # residency (an unowned entry counts as foreign to an
+                # owned insert, and vice versa); same-owner entries
+                # fall back to plain LRU order.
+                victim = None
+                for k, (_, _, ent_owner) in self._entries.items():
+                    if k != key and ent_owner != owner:
+                        victim = k
+                        break
+                if victim is None:
+                    victim = next(k for k in self._entries
+                                  if k != key)
+                _, ev_bytes, _ = self._entries.pop(victim)
                 self._bytes -= ev_bytes
                 n_evicted += 1
             held = self._bytes
@@ -211,7 +262,7 @@ class ByteBudgetLRU:
 
     def values(self) -> List[Any]:
         with self._lock:
-            return [v for v, _ in self._entries.values()]
+            return [v for v, _, _ in self._entries.values()]
 
     def info(self) -> Dict[str, int]:
         with self._lock:

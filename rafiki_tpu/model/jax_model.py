@@ -276,6 +276,28 @@ def dynamic_int8_matmul(x: Any, wq: Any, scale: Any) -> Any:
     return acc.astype(jnp.float32) * s_x * scale[None, :]
 
 
+def dynamic_int8_conv(x: Any, wq: Any, scale: Any,
+                      strides=(1, 1), padding="SAME") -> Any:
+    """Dequant-free int8 x int8 NHWC convolution, the conv-zoo
+    counterpart of :func:`dynamic_int8_matmul`: activations quantize
+    dynamically per SAMPLE (symmetric max-abs over the sample's
+    h/w/c — per-pixel scales would defeat the int8 conv's single
+    rescale), both operands enter the convolution as int8 with an
+    int32 accumulator, and the result rescales to f32 once with the
+    per-output-channel weight ``scale``. ``wq`` is an ``(kh, kw, cin,
+    cout)`` int8 kernel from :meth:`JaxModel.enable_serving_quant`
+    (4-D conv kernels carry per-``cout`` scales exactly like the 2-D
+    dense ones)."""
+    s_x = jnp.max(jnp.abs(x), axis=(1, 2, 3), keepdims=True) / 127.0
+    s_x = jnp.maximum(s_x, 1e-8)
+    xq = jnp.clip(jnp.round(x / s_x), -127, 127).astype(jnp.int8)
+    acc = jax.lax.conv_general_dilated(
+        xq, wq, strides, padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * s_x * scale[None, None, None, :]
+
+
 def _canonicalize_state(state: Any, mesh) -> Any:
     """Pin every train-state leaf to a mesh NamedSharding and a strong
     dtype. ``TrainState.create`` leaves the step counter as a weak Python
@@ -1243,11 +1265,13 @@ class JaxModel(BaseModel):
         """``(qvars, scales, fvars, layers)`` as flat ``path -> array``
         host dicts, computed ONCE per loaded parameters (the report at
         load time and the first compile share it). Eligible leaves —
-        2-D floating ``kernel``s — carry int8 weights +
-        per-output-channel symmetric scales (``max|W[:,j]| / 127``);
-        everything else (biases, norms, conv kernels, batch_stats)
-        passes through in f32: the per-layer fallback the wire contract
-        promises."""
+        2-D dense and 4-D conv floating ``kernel``s — carry int8
+        weights + per-output-channel symmetric scales
+        (``max|W[..., j]| / 127`` over every non-output axis; the conv
+        eligibility is the r13 carry that moves the conv zoo off the
+        all-f32 path); everything else (biases, norms, batch_stats,
+        expert stacks) passes through in f32: the per-layer fallback
+        the wire contract promises."""
         if self._quant_host is not None:
             return self._quant_host
         flat = traverse_util.flatten_dict(self._variables, sep="/")
@@ -1257,10 +1281,11 @@ class JaxModel(BaseModel):
         layers: Dict[str, str] = {}
         for k, v in flat.items():
             arr = np.asarray(v)
-            if k.endswith("kernel") and arr.ndim == 2 and \
+            if k.endswith("kernel") and arr.ndim in (2, 4) and \
                     np.issubdtype(arr.dtype, np.floating):
                 w = arr.astype(np.float32)
-                s = np.max(np.abs(w), axis=0) / 127.0
+                s = np.max(np.abs(w),
+                           axis=tuple(range(w.ndim - 1))) / 127.0
                 s = np.where(s <= 0, 1.0, s).astype(np.float32)
                 qvars[k] = np.clip(np.round(w / s), -127, 127) \
                     .astype(np.int8)
@@ -1299,6 +1324,25 @@ class JaxModel(BaseModel):
         fallback. Called at TRACE time inside the compiled predict
         variant, so the choice is static per executable."""
         return None
+
+    # --- Stacked-ensemble congruence metadata ---
+
+    #: Whether members of this class may be vmap-stacked into one
+    #: compiled program (``stack_members``). True for the JaxModel zoo
+    #: by default — the structural probe still has the final word.
+    stack_compatible: bool = True
+
+    def stack_signature(self) -> Any:
+        """Static family identity for the stacked-ensemble congruence
+        probe: two members stack only if their signatures compare
+        equal. The default — concrete class, the flax module (dataclass
+        equality covers every static attr: supernet widths, depths,
+        dtypes), and the served output contract — is sufficient for
+        zoo models whose per-trial knobs are traced inputs; subclasses
+        with extra static serving state must extend it."""
+        return (type(self).__name__, self._module,
+                int(self._meta.get("n_classes", 0)),
+                tuple(self._meta.get("image_shape", ())))
 
     def warmup(self) -> None:
         """Pre-compile the smallest predict bucket (both the uint8 and
@@ -1358,3 +1402,344 @@ class JaxModel(BaseModel):
         self._invalidate_compiled()
         self._variables = None
         self._module = None
+
+
+# --- Stacked ensembles (compiled megabatch serving) -------------------
+#
+# Same-family ensemble bins — the common AutoML case, where the best-N
+# trials of one search all share a model family and differ only in
+# weights — used to serve as N separately compiled runners time-slicing
+# one chip group (_PackedEnsemble): one dispatch and one weight-set
+# residency per member per burst. Here the member weights stack along a
+# leading model axis at load time (ONE device_put of the stacked
+# pytree) and ONE jax.vmap-over-the-model-axis program compiles per
+# (bucket, dtype, quant) — a multi-bin burst on one chip becomes ONE
+# device dispatch producing per-member probabilities, which the
+# worker's _finish_members consumes unchanged (per-member confidence,
+# __members__ envelopes, fault isolation via the member-validity
+# mask). docs/serving.md "Stacked ensembles".
+
+
+def stack_congruence(models: List[Any]) -> Optional[str]:
+    """The congruence probe: None when ``models`` can serve as one
+    vmap-stacked program, else a human-readable reason (the worker
+    logs it and falls back to per-member runners). Congruent means:
+    same concrete JaxModel family (``stack_signature`` equality — the
+    flax module's static attrs included), shape/dtype-congruent param
+    trees, same extra-input signature, and one serving quant mode."""
+    if len(models) < 2:
+        return "fewer than two members"
+    for i, m in enumerate(models):
+        if not isinstance(m, JaxModel):
+            return (f"member {i} ({type(m).__name__}) is not a "
+                    f"JaxModel (sk-style/sequence members serve "
+                    f"per-member)")
+        if not getattr(m, "stack_compatible", False):
+            return (f"member {i} ({type(m).__name__}) opts out of "
+                    f"stacking")
+        if m._variables is None or m._module is None:
+            return f"member {i} has no loaded parameters"
+    m0 = models[0]
+    sig0 = m0.stack_signature()
+    flat0 = traverse_util.flatten_dict(m0._variables, sep="/")
+    extra0 = m0.extra_apply_inputs()
+    for i, m in enumerate(models[1:], start=1):
+        if type(m) is not type(m0):
+            return (f"member {i} is {type(m).__name__}, member 0 is "
+                    f"{type(m0).__name__}")
+        if m.stack_signature() != sig0:
+            return f"member {i} has a different stack signature"
+        if m._quant_mode != m0._quant_mode:
+            return f"member {i} has a different serving quant mode"
+        flat = traverse_util.flatten_dict(m._variables, sep="/")
+        if set(flat) != set(flat0):
+            return f"member {i} has a different parameter tree"
+        for k, v0 in flat0.items():
+            v = flat[k]
+            if tuple(np.shape(v)) != tuple(np.shape(v0)) or \
+                    np.asarray(v).dtype != np.asarray(v0).dtype:
+                return (f"member {i} leaf {k}: "
+                        f"{np.shape(v)}/{np.asarray(v).dtype} != "
+                        f"{np.shape(v0)}/{np.asarray(v0).dtype}")
+        extra = m.extra_apply_inputs()
+        if set(extra) != set(extra0):
+            return f"member {i} has different extra apply inputs"
+        for k, v0 in extra0.items():
+            if tuple(np.shape(extra[k])) != tuple(np.shape(v0)):
+                return f"member {i} extra input {k} shape differs"
+    return None
+
+
+def stack_members(models: List[Any]) -> Optional["StackedMembers"]:
+    """Build the stacked execution group for shape-congruent
+    same-family members, or None (with the probe's reason logged)
+    when the group must serve per-member."""
+    reason = stack_congruence(models)
+    if reason is not None:
+        _log.info("ensemble not stackable (%s); serving per-member",
+                  reason)
+        return None
+    return StackedMembers(models)
+
+
+class StackedMembers:
+    """N shape-congruent members as ONE device-resident stacked weight
+    pytree plus vmapped-over-the-model-axis compiled runners.
+
+    The member list is kept (host-side) for fallback serving and
+    restacks; the device holds exactly one stacked copy of the weights
+    (and, under int8 serving, one stacked copy of qvars/scales/fvars),
+    uploaded with a single ``device_put`` of the stacked pytree.
+    Runners read ``self._vars_dev`` at CALL time, so a promote-path
+    restack (``update_member``: swap one member's slices in place)
+    never recompiles and never re-uploads the other members.
+    ``valid`` is the member-validity mask: a member whose restack
+    failed mid-flight is masked out of the served votes (fault
+    isolation) until a later restack lands."""
+
+    def __init__(self, models: List[Any]):
+        self.models = list(models)
+        self.mesh = models[0].mesh
+        self.valid: List[bool] = [True] * len(models)
+        self._quant = models[0]._quant_mode
+        self._runner_cache: Dict[Any, Any] = {}
+        rep = replicated(self.mesh)
+        stackf = lambda *xs: np.stack(  # noqa: E731
+            [np.asarray(x) for x in xs])
+        if self._quant:
+            stacks = [m._quant_host_arrays() for m in models]
+            qvars = {k: stackf(*[s[0][k] for s in stacks])
+                     for k in stacks[0][0]}
+            scales = {k: stackf(*[s[1][k] for s in stacks])
+                      for k in stacks[0][1]}
+            fvars = {k: stackf(*[s[2][k] for s in stacks])
+                     for k in stacks[0][2]}
+            self._vars_dev = jax.device_put(
+                {"q": qvars, "s": scales, "f": fvars}, rep)
+            for m in models:
+                # The per-member host quant tuples are full extra
+                # weight copies; the stacked device arrays are now the
+                # serving truth (a fallback burst recomputes from
+                # _variables).
+                m._quant_host = None
+        else:
+            stacked = jax.tree.map(stackf,
+                                   *[m._variables for m in models])
+            self._vars_dev = jax.device_put(stacked, rep)
+        extras = [m.extra_apply_inputs() for m in models]
+        self._extra_dev = jax.device_put(
+            {k: stackf(*[e[k] for e in extras]) for k in extras[0]},
+            rep)
+
+    @property
+    def n_members(self) -> int:
+        return len(self.models)
+
+    @property
+    def n_valid(self) -> int:
+        return sum(1 for v in self.valid if v)
+
+    def predict_bucket(self, n: int, dtype: Any = None) -> Optional[int]:
+        """Same bucket ladder as the members (congruence guarantees
+        they agree — one family, one mesh)."""
+        return self.models[0].predict_bucket(n, dtype)
+
+    # --- Dispatch ---
+
+    def staged_submit(self, buf: np.ndarray, n: int):
+        """One vmapped dispatch straight from the shared host staging
+        buffer; returns the ``(M, bucket, n_classes)`` device future.
+        Mirrors ``JaxModel.predict_staged_submit``'s contract (buffer
+        leading dim is exactly the bucket, rows [n:] padding)."""
+        m0 = self.models[0]
+        shape = tuple(m0._meta["image_shape"])
+        if buf.shape[1:] != shape:
+            if int(np.prod(buf.shape[1:])) == int(np.prod(shape)):
+                buf = buf.reshape((buf.shape[0], *shape))  # view
+            else:
+                raise ValueError(f"staged rows {buf.shape[1:]} != "
+                                 f"{shape}")
+        expect = self.predict_bucket(n, buf.dtype)
+        if expect is None or buf.shape[0] != expect:
+            raise ValueError(
+                f"staging buffer leading dim {buf.shape[0]} != bucket "
+                f"{expect} for n={n}")
+        return self._dispatch(buf), n
+
+    def submit(self, queries: List[Any]):
+        """Per-query-object path (legacy frames / mixed bursts): stack
+        on the host once, then ONE vmapped dispatch per
+        max_predict_batch chunk. Returns ``[(device future, count)]``
+        handles for ``member_finishers``."""
+        m0 = self.models[0]
+        imgs = m0._stack_queries(queries)
+        handles = []
+        for start in range(0, imgs.shape[0], m0.max_predict_batch):
+            chunk = imgs[start:start + m0.max_predict_batch]
+            n = chunk.shape[0]
+            bucket = self.predict_bucket(n, chunk.dtype)
+            if n < bucket:
+                _wire.count_copies("pad", 1)
+                chunk = np.concatenate(
+                    [chunk, np.zeros((bucket - n, *chunk.shape[1:]),
+                                     chunk.dtype)])
+            handles.append((self._dispatch(chunk), n))
+        return handles
+
+    def _dispatch(self, chunk: np.ndarray):
+        bucket = chunk.shape[0]
+        is_u8 = chunk.dtype == np.uint8
+        key = (bucket, is_u8, self._quant)
+        runner = self._runner_cache.get(key)
+        if runner is None:
+            runner = self._build_runner(bucket, chunk.shape[1:], is_u8)
+            self._runner_cache[key] = runner
+        x = jax.device_put(chunk, batch_sharding(self.mesh))
+        return runner(x)
+
+    def member_finishers(self, handles) -> List[Any]:
+        """Per-member zero-arg finishers over ONE shared device
+        readback (the first finisher pays the D2H; the rest slice the
+        fetched array) — the exact shape ``_finish_members`` consumes;
+        per-handle counts come from the handles themselves. Invalid
+        (masked) members are excluded up front: their votes drop
+        without touching the healthy members' results."""
+        if not isinstance(handles, list):
+            handles = [handles]
+        fetched: Dict[int, np.ndarray] = {}
+
+        def fetch(j: int) -> np.ndarray:
+            out = fetched.get(j)
+            if out is None:
+                out = np.asarray(handles[j][0])  # (M, bucket, C)
+                fetched[j] = out
+            return out
+
+        fins = []
+        for i, ok in enumerate(self.valid):
+            if not ok:
+                continue
+
+            def fin(i=i) -> List[Any]:
+                rows: List[Any] = []
+                for j, (_, count) in enumerate(handles):
+                    rows.extend(p.tolist() for p in fetch(j)[i, :count])
+                return rows
+
+            fins.append(fin)
+        return fins
+
+    def _build_runner(self, bucket: int, feat_shape, is_u8: bool):
+        """AOT-compile ONE program for this (bucket, dtype, quant):
+        the member forward vmapped over the leading model axis of the
+        stacked weights (and stacked extras), the batch broadcast.
+        The closure reads ``self._vars_dev`` per call so restacks swap
+        weights without recompiling."""
+        mesh = self.mesh
+        m0 = self.models[0]
+        module = m0._module
+        x_shape = jax.ShapeDtypeStruct(
+            (bucket, *feat_shape), jnp.uint8 if is_u8 else jnp.float32,
+            sharding=batch_sharding(mesh))
+        struct = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, a.dtype, sharding=a.sharding)
+
+        if self._quant:
+            quantized_apply = m0.quantized_apply
+
+            def member_fn(packed, extra, x):
+                qvars, scales, fvars = (packed["q"], packed["s"],
+                                        packed["f"])
+                xf = x.astype(jnp.float32)
+                if is_u8:
+                    xf = xf / 255.0
+                logits = quantized_apply(qvars, scales, fvars, xf,
+                                         extra)
+                if logits is None:
+                    flat = dict(fvars)
+                    for k, wq in qvars.items():
+                        flat[k] = wq.astype(jnp.float32) * scales[k]
+                    variables = traverse_util.unflatten_dict(flat,
+                                                             sep="/")
+                    logits = module.apply(variables, xf, train=False,
+                                          **extra)
+                return jax.nn.softmax(logits.astype(jnp.float32),
+                                      axis=-1)
+        else:
+            def member_fn(variables, extra, x):
+                xf = x.astype(jnp.float32)
+                if is_u8:
+                    xf = xf / 255.0
+                logits = module.apply(variables, xf, train=False,
+                                      **extra)
+                return jax.nn.softmax(logits.astype(jnp.float32),
+                                      axis=-1)
+
+        fn = jax.vmap(member_fn, in_axes=(0, 0, None))
+        compiled = jax.jit(fn).lower(
+            jax.tree.map(struct, self._vars_dev),
+            jax.tree.map(struct, self._extra_dev), x_shape).compile()
+        return lambda x: compiled(self._vars_dev, self._extra_dev, x)
+
+    def warmup(self) -> None:
+        """Pre-compile the smallest bucket's uint8 + float32 vmapped
+        variants (the quant mode is part of the runner key by
+        construction) and execute each once, so a stacked worker pays
+        its XLA compiles before registering for traffic — the stacked
+        counterpart of ``JaxModel.warmup``'s coverage."""
+        shape = tuple(self.models[0]._meta["image_shape"])
+        bucket = self.predict_bucket(1, np.float32)
+        for dtype in (np.float32, np.uint8):
+            np.asarray(self._dispatch(np.zeros((bucket, *shape),
+                                               dtype)))
+
+    # --- Promote-path restack ---
+
+    def update_member(self, index: int, model: Any) -> None:
+        """Swap member ``index``'s weights (and quant scales and
+        extras) inside the stacked device arrays — the other members
+        stay device-resident and every compiled runner stays valid
+        (shapes unchanged; closures read the swapped tree per call).
+        Raises on an incongruent incoming model BEFORE touching device
+        state; a failure mid-update marks the member invalid (masked
+        out of votes) rather than serving half-swapped weights."""
+        if not (0 <= index < len(self.models)):
+            raise IndexError(f"no stacked member {index}")
+        ref = self.models[1] if index == 0 else self.models[0]
+        reason = stack_congruence([ref, model])
+        if reason is not None:
+            raise ValueError(f"incoming member is not congruent with "
+                             f"the stacked group: {reason}")
+        # Fallible PREP first, before any device state moves: a
+        # failure here (e.g. quantizing the incoming weights) raises
+        # with the old member still fully valid — masking is reserved
+        # for the genuinely half-swapped window below.
+        if self._quant:
+            q, s, f, _ = model._quant_host_arrays()
+            new_host: Any = {"q": q, "s": s, "f": f}
+        else:
+            new_host = model._variables
+        extra = model.extra_apply_inputs()
+        try:
+            setat = lambda st, new: st.at[index].set(  # noqa: E731
+                jnp.asarray(np.asarray(new), dtype=st.dtype))
+            self._vars_dev = jax.tree.map(
+                lambda st, new: setat(st, new), self._vars_dev,
+                new_host)
+            self._extra_dev = {k: setat(st, extra[k])
+                               for k, st in self._extra_dev.items()}
+        except Exception:
+            # Weights may be swapped while extras are not (or the
+            # weight tree itself is part-updated): mask the member out
+            # of votes rather than serve half-swapped state.
+            self.valid[index] = False
+            raise
+        if self._quant:
+            model._quant_host = None
+        self.models[index] = model
+        self.valid[index] = True
+
+    def destroy(self) -> None:
+        self._vars_dev = None
+        self._extra_dev = None
+        self._runner_cache.clear()

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..model import CategoricalKnob, FixedKnob, FloatKnob, IntegerKnob
-from ..model.jax_model import JaxModel
+from ..model.jax_model import (JaxModel, dynamic_int8_conv,
+                               dynamic_int8_matmul)
 
 MAX_WIDTH = 64   # stage-0 channels; stage i uses MAX_WIDTH * 2**i
 N_STAGES = 3
@@ -96,3 +97,57 @@ class JaxCnn(JaxModel):
         sixteenths = int(self.knobs.get("width_16ths", 16))
         return {"width_16ths":
                 (np.arange(16) < sixteenths).astype(np.float32)}
+
+    def stack_signature(self):
+        # Congruence metadata for vmap-stacked serving: the supernet
+        # constants pin the family (module dataclass equality already
+        # carries n_classes/base_width; the explicit tuple keeps the
+        # contract stated even if the module grows non-compared state).
+        return (*super().stack_signature(), MAX_WIDTH, N_STAGES)
+
+    def quantized_apply(self, qvars, scales, fvars, x, extra):
+        """Dequant-free int8 serving path for the conv zoo (the r13
+        carry): every stage conv runs int8 x int8 -> int32 via
+        ``dynamic_int8_conv`` (4-D kernels carry per-output-channel
+        scales since r16) and the head Denses via
+        ``dynamic_int8_matmul``, mirroring ``_Cnn.__call__``'s
+        masked-supernet forward exactly — the ``bench.py --quant
+        int8`` accuracy-delta gate is the regression net. A kernel
+        the quantizer left in f32 falls back per layer, as the wire
+        contract promises."""
+        mask16 = extra["width_16ths"]
+        h = x
+        conv_i = 0
+        for stage in range(N_STAGES):
+            ch = MAX_WIDTH * (2 ** stage)
+            mask = jnp.repeat(mask16, ch // 16)
+            for _ in range(2):
+                k = f"params/Conv_{conv_i}/kernel"
+                b = fvars[f"params/Conv_{conv_i}/bias"] \
+                    .astype(jnp.float32)
+                if k in qvars:
+                    h = dynamic_int8_conv(
+                        h, qvars[k], scales[k],
+                        padding=((1, 1), (1, 1))) + b
+                else:  # per-layer f32 fallback
+                    import jax
+
+                    h = jax.lax.conv_general_dilated(
+                        h, fvars[k].astype(jnp.float32), (1, 1),
+                        ((1, 1), (1, 1)),
+                        dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+                h = jnp.maximum(h, 0.0)
+                h = h * mask
+                conv_i += 1
+            if min(h.shape[1], h.shape[2]) >= 2:
+                h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = h.reshape((h.shape[0], -1))
+
+        def dense(v, i):
+            k = f"params/Dense_{i}/kernel"
+            b = fvars[f"params/Dense_{i}/bias"].astype(jnp.float32)
+            if k in qvars:
+                return dynamic_int8_matmul(v, qvars[k], scales[k]) + b
+            return v @ fvars[k].astype(jnp.float32) + b
+        h = jnp.maximum(dense(h, 0), 0.0)
+        return dense(h, 1)

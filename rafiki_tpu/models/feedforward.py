@@ -100,6 +100,12 @@ class JaxFeedForward(JaxModel):
                 (np.arange(MAX_UNITS) < units).astype(np.float32),
         }
 
+    def stack_signature(self):
+        # Congruence metadata for vmap-stacked serving: every trial
+        # shares the fixed supernet, so same-family bins stack no
+        # matter which width/depth masks their knobs trace in.
+        return (*super().stack_signature(), MAX_LAYERS, MAX_UNITS)
+
     def quantized_apply(self, qvars, scales, fvars, x, extra):
         """Dequant-free int8 serving path: every Dense matmul runs
         int8 x int8 -> int32 on the MXU (``dynamic_int8_matmul``:

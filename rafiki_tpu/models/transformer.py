@@ -126,6 +126,65 @@ class _EncoderBlock(nn.Module):
         return x + nn.Dense(d_model, dtype=self.dtype)(h)
 
 
+def quantized_encoder_block(qvars, scales, fvars, prefix: str, x,
+                            attn_fn, n_heads: int, kv_mask=None):
+    """Dequant-free int8 forward of ONE dense-FFN ``_EncoderBlock``
+    (deterministic — the serving path never drops out): the four
+    Dense matmuls run int8 x int8 -> int32 via ``dynamic_int8_matmul``
+    from per-output-channel weight scales, LayerNorms stay f32,
+    mirroring ``_EncoderBlock.__call__`` exactly. ``prefix`` is the
+    block's flat param path (e.g. ``params/_EncoderBlock_0``). Returns
+    None for a MoE block (3-D expert stacks sit outside the
+    quantizer's 2-D/4-D kernel eligibility) so callers fall back to
+    the generic dequantized path. Shared by the transformer zoo's
+    ``quantized_apply`` implementations (models/vit.py); the
+    ``bench.py --quant int8`` accuracy gate is the regression net."""
+    from ..model.jax_model import dynamic_int8_matmul
+
+    if f"{prefix}/moe_gate" in fvars or f"{prefix}/moe_gate" in qvars:
+        return None
+
+    def ln(h, name):
+        g = fvars[f"{prefix}/{name}/scale"].astype(jnp.float32)
+        b = fvars[f"{prefix}/{name}/bias"].astype(jnp.float32)
+        hf = h.astype(jnp.float32)
+        m = hf.mean(-1, keepdims=True)
+        v = ((hf - m) ** 2).mean(-1, keepdims=True)
+        return (hf - m) * jax.lax.rsqrt(v + 1e-6) * g + b
+
+    def dense(h, name):
+        k = f"{prefix}/{name}/kernel"
+        flat2d = h.reshape(-1, h.shape[-1])
+        if k in qvars:
+            out = dynamic_int8_matmul(flat2d, qvars[k], scales[k])
+        else:  # per-layer f32 fallback
+            out = flat2d @ fvars[k].astype(jnp.float32)
+        out = out.reshape(*h.shape[:-1], out.shape[-1])
+        bkey = f"{prefix}/{name}/bias"
+        if bkey in fvars:
+            out = out + fvars[bkey].astype(jnp.float32)
+        return out
+
+    d_model = x.shape[-1]
+    x = x.astype(jnp.float32)
+    h = ln(x, "LayerNorm_0")
+    qkv = dense(h, "Dense_0")
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(a):  # (B, T, D) -> (B, H, T, Dh)
+        b, t, _ = a.shape
+        return a.reshape(b, t, n_heads,
+                         d_model // n_heads).transpose(0, 2, 1, 3)
+
+    o = attn_fn(heads(q), heads(k), heads(v), kv_mask)
+    b, nh, t, dh = o.shape
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, nh * dh)
+    x = x + dense(o, "Dense_1")
+    h = ln(x, "LayerNorm_1")
+    h = nn.gelu(dense(h, "Dense_2"))
+    return x + dense(h, "Dense_3")
+
+
 class _TransformerTagger(nn.Module):
     vocab_size: int
     d_model: int
@@ -155,6 +214,12 @@ class _TransformerTagger(nn.Module):
 
 class JaxTransformerTagger(BaseModel):
     """Transformer token tagger; flash attention, optional sp ring."""
+
+    #: Congruence metadata for the stacked-ensemble probe: sequence
+    #: taggers serve variable-length token batches through their own
+    #: predict path (no JaxModel bucket substrate), so same-family
+    #: bins fall back to per-member runners by contract.
+    stack_compatible = False
 
     @staticmethod
     def get_knob_config():

@@ -118,3 +118,61 @@ class JaxViT(JaxModel):
         depth = int(self.knobs.get("depth", MAX_DEPTH))
         return {"depth":
                 (np.arange(MAX_DEPTH) < depth).astype(np.float32)}
+
+    def stack_signature(self):
+        # Congruence metadata for vmap-stacked serving (module
+        # dataclass equality already compares d_model/n_heads/patch/
+        # n_tokens; the supernet depth is the family constant).
+        return (*super().stack_signature(), MAX_DEPTH)
+
+    def quantized_apply(self, qvars, scales, fvars, x, extra):
+        """Dequant-free int8 serving for the transformer zoo (the r13
+        carry): the patchify conv runs via ``dynamic_int8_conv``, each
+        encoder block via the shared ``quantized_encoder_block``
+        (models/transformer.py — int8 QKV/proj/FFN matmuls, f32
+        LayerNorms), mirroring ``_ViT.__call__``'s depth-masked
+        forward. A block the int8 path cannot take (MoE) or a kernel
+        left f32 falls back per layer."""
+        from ..model.jax_model import (dynamic_int8_conv,
+                                       dynamic_int8_matmul)
+        from .transformer import quantized_encoder_block
+
+        module = self._module
+        patch = module.patch
+        k = "params/Conv_0/kernel"
+        b = fvars["params/Conv_0/bias"].astype(jnp.float32)
+        if k in qvars:
+            h = dynamic_int8_conv(x, qvars[k], scales[k],
+                                  strides=(patch, patch),
+                                  padding="VALID") + b
+        else:
+            h = jax.lax.conv_general_dilated(
+                x, fvars[k].astype(jnp.float32), (patch, patch),
+                "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+        bsz = h.shape[0]
+        h = h.reshape(bsz, -1, module.d_model)
+        cls = fvars["params/cls"].astype(jnp.float32)
+        h = jnp.concatenate([jnp.tile(cls, (bsz, 1, 1)), h], axis=1)
+        h = h + fvars["params/pos_embed"].astype(jnp.float32)
+        attn = default_attention(causal=False)
+        depth = extra["depth"]
+        for i in range(module.max_depth):
+            y = quantized_encoder_block(
+                qvars, scales, fvars, f"params/_EncoderBlock_{i}", h,
+                attn, module.n_heads)
+            if y is None:
+                return None  # MoE block: generic fallback path
+            gate = depth[i].astype(y.dtype)
+            h = h + gate * (y - h)  # masked block == identity
+        g = fvars["params/LayerNorm_0/scale"].astype(jnp.float32)
+        bb = fvars["params/LayerNorm_0/bias"].astype(jnp.float32)
+        hf = h[:, 0].astype(jnp.float32)
+        m = hf.mean(-1, keepdims=True)
+        v = ((hf - m) ** 2).mean(-1, keepdims=True)
+        hf = (hf - m) * jax.lax.rsqrt(v + 1e-6) * g + bb
+        k = "params/Dense_0/kernel"
+        b = fvars["params/Dense_0/bias"].astype(jnp.float32)
+        if k in qvars:
+            return dynamic_int8_matmul(hf, qvars[k], scales[k]) + b
+        return hf @ fvars[k].astype(jnp.float32) + b

@@ -20,6 +20,11 @@ are noise).
 - ``rafiki_tpu_serving_quant_total{mode}`` — queries served by a
   quantized model (worker-side; own lazy family, so a quant-off
   process never grows a series).
+- ``rafiki_tpu_serving_stacked_dispatch_total{mode=stacked|fallback}``
+  + ``rafiki_tpu_serving_dispatches_per_query_ratio`` — the stacked-
+  ensemble dispatch evidence (worker-side; own lazy family gated on
+  ``RAFIKI_TPU_SERVING_STACKED``, so the stacked-off side of the
+  bench A/B exposes zero stacked series).
 
 Gating (the r11 disabled-means-free discipline): the wire/copies
 family exists only while ``RAFIKI_TPU_SERVING_PACKED_WIRE`` is not
@@ -43,6 +48,7 @@ from . import metrics as _metrics
 
 PACKED_WIRE_ENV = "RAFIKI_TPU_SERVING_PACKED_WIRE"
 QUANT_ENV = "RAFIKI_TPU_SERVING_QUANT"
+STACKED_ENV = "RAFIKI_TPU_SERVING_STACKED"
 
 #: The ONE accepted-spelling vocabulary for each knob — NodeConfig
 #: validation imports these (rejecting typos loudly at config time),
@@ -52,6 +58,8 @@ PACKED_WIRE_SPELLINGS = ("", "1", "on", "true", "yes",
                          "0", "off", "false", "no", "compat")
 QUANT_OFF_SPELLINGS = ("", "0", "off", "none", "no", "false")
 QUANT_MODES = ("int8",)
+STACKED_SPELLINGS = ("", "1", "on", "true", "yes",
+                     "0", "off", "false", "no")
 
 
 def known_packed_wire_spelling(raw: str) -> bool:
@@ -60,6 +68,10 @@ def known_packed_wire_spelling(raw: str) -> bool:
 
 def known_quant_spelling(raw: str) -> bool:
     return raw.strip().lower() in QUANT_OFF_SPELLINGS + QUANT_MODES
+
+
+def known_stacked_spelling(raw: str) -> bool:
+    return raw.strip().lower() in STACKED_SPELLINGS
 
 
 def packed_wire_mode(raw: Optional[str] = None) -> str:
@@ -114,10 +126,39 @@ def quant_mode(raw: Optional[str] = None) -> str:
     return ""
 
 
+def stacked_mode(raw: Optional[str] = None) -> bool:
+    """Whether stacked-ensemble serving is requested
+    (``RAFIKI_TPU_SERVING_STACKED``, default on — stacking is a pure
+    dispatch-count win gated by the congruence probe, and parity is
+    pinned by tests). Unrecognized spellings fail SAFE to **off** with
+    a warning: for a perf feature the behavior-correct fallback is the
+    per-member path a typo'd rollback was reaching for (NodeConfig
+    validation still rejects typos loudly)."""
+    if raw is None:
+        raw = os.environ.get(STACKED_ENV, "on")
+    raw = raw.strip().lower()
+    if raw in ("0", "off", "false", "no"):
+        return False
+    if raw in STACKED_SPELLINGS:  # the remaining on-spellings
+        return True
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "%s=%r is not one of on/off; failing safe to per-member "
+        "serving", STACKED_ENV, raw)
+    return False
+
+
 #: (wire_bytes counter | None, host_copies counter | None); resolved at
 #: first use under the lock, then read lock-free.
 _state: Optional[Tuple] = None
 _quant_counter = None
+#: (dispatch counter, dispatches-per-query gauge) | (None, None);
+#: lazy own family like the quant counter — registered only when a
+#: stacked-capable ensemble actually serves AND the knob is on, so a
+#: stacked-off process (the bench A/B's off side) exposes ZERO stacked
+#: series.
+_stacked_state: Optional[Tuple] = None
 _lock = threading.Lock()
 
 
@@ -193,11 +234,61 @@ def count_quant(n: int, mode: str) -> None:
     c.inc(n, mode=mode)
 
 
+def _stacked_counters() -> Tuple:
+    global _stacked_state
+    s = _stacked_state
+    if s is None:
+        with _lock:
+            s = _stacked_state
+            if s is None:
+                if stacked_mode() and _metrics.metrics_enabled():
+                    reg = _metrics.registry()
+                    s = (
+                        reg.counter(
+                            "rafiki_tpu_serving_stacked_dispatch_total",
+                            "Ensemble-burst device dispatches on a "
+                            "stacked-capable worker (mode=stacked: one "
+                            "vmapped program served the whole member "
+                            "group; mode=fallback: per-member "
+                            "dispatches of a burst that could not ride "
+                            "the stacked program)"),
+                        reg.gauge(
+                            "rafiki_tpu_serving_dispatches_per_query_ratio",
+                            "Device dispatches per served query of the "
+                            "last ensemble burst (stacked mode: "
+                            "1/queries; per-member fallback: "
+                            "members/queries)"),
+                    )
+                else:
+                    s = (None, None)
+                _stacked_state = s
+    return s
+
+
+def count_stacked_dispatch(mode: str, n: int = 1) -> None:
+    """``mode="stacked"``: one vmapped dispatch served the whole
+    member group; ``mode="fallback"``: per-member dispatches of a
+    burst a stacked-capable worker had to serve the legacy way."""
+    c = _stacked_counters()[0]
+    if c is not None and n > 0:
+        # No RTA301 waiver needed: the module's one `mode` finding
+        # anchors at count_quant's earlier inc, waived there (fixed
+        # vocabularies both).
+        c.inc(n, mode=mode)
+
+
+def observe_dispatches_per_query(dispatches: int, queries: int) -> None:
+    g = _stacked_counters()[1]
+    if g is not None and queries > 0:
+        g.set(dispatches / queries)
+
+
 def reset_for_tests() -> None:
     """Drop the cached enabled-state so a test that flips
     ``RAFIKI_TPU_SERVING_PACKED_WIRE`` / ``RAFIKI_TPU_METRICS`` sees
     its env take effect (production resolves once, by design)."""
-    global _state, _quant_counter
+    global _state, _quant_counter, _stacked_state
     with _lock:
         _state = None
         _quant_counter = None
+        _stacked_state = None

@@ -613,6 +613,18 @@ class MetaStore:
             "service_id": service_id, "inference_job_id": inference_job_id,
             "trial_id": trial_id})
 
+    def update_inference_job_worker(self, service_id: str,
+                                    trial_id: str) -> None:
+        """Repoint one worker mapping row at a new trial bin — the
+        promote-path restack swaps a stacked worker's member in place,
+        so the row must follow the served bin (promote validation and
+        ``active_inference_workers`` read it)."""
+        with self._lock:
+            self._conn.execute(
+                "UPDATE inference_job_workers SET trial_id = ? "
+                "WHERE service_id = ?", (trial_id, service_id))
+            self._conn.commit()
+
     def get_inference_job_workers(self, inference_job_id: str) -> List[Row]:
         return self._select(
             "SELECT * FROM inference_job_workers WHERE inference_job_id = ?",

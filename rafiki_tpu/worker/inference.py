@@ -24,6 +24,7 @@ import numpy as np
 from .. import faults
 from ..bus import BaseBus, BusOpError
 from ..cache import DRAIN_KEY as _CACHE_DRAIN_KEY
+from ..cache import RESTACK_KEY as _CACHE_RESTACK_KEY
 from ..cache import WIRE_NDBATCH, Cache
 from ..constants import ServiceStatus
 from ..observe import trace
@@ -111,21 +112,55 @@ class _PackedEnsemble:
 
     ``predict_submit`` dispatches every member's compute back-to-back
     (all async) before any result readback, so members overlap on the
-    device. The finisher pre-averages numeric (probability) predictions
-    and reports ``last_weight`` = surviving member count, so the
-    Predictor's weighted cross-worker mean equals the unweighted mean
-    over all trials; non-numeric predictions ship un-combined in a
+    device. With a STACKED group (``stacked`` — same-family members
+    whose weights rode one device_put as a vmap-stacked pytree,
+    ``model/jax_model.stack_members``) the whole burst is instead ONE
+    compiled dispatch producing per-member probabilities; the
+    per-member finishers it yields slice one shared readback, so
+    ``_finish_members`` consumes both modes unchanged. The finisher
+    pre-averages numeric (probability) predictions and reports
+    ``last_weight`` = surviving member count, so the Predictor's
+    weighted cross-worker mean equals the unweighted mean over all
+    trials; non-numeric predictions ship un-combined in a
     ``__members__`` envelope (the Predictor votes over individual
     trials — pre-voting would lose the member distribution). A failing
     member drops ONLY its own vote: the other packed trials keep
-    serving (per-member fault isolation).
+    serving (per-member fault isolation — in stacked mode via the
+    member-validity mask, and a burst the stacked program cannot take
+    falls back to the per-member runners below).
     """
 
-    def __init__(self, models: list):
+    def __init__(self, models: list, stacked: Optional[Any] = None):
         self.models = models
+        self.stacked = stacked
         self.last_weight = len(models)
 
+    def _stacked_usable(self) -> bool:
+        return self.stacked is not None and self.stacked.n_valid > 0
+
+    def _count_fallback(self, n_dispatches: int, n_queries: int) -> None:
+        """Per-member dispatch accounting on a stacked-CAPABLE worker
+        (the evidence half of the dispatch-count gate); a plain packed
+        ensemble (no stacked group formed / knob off) records nothing
+        — the off side must expose zero stacked series."""
+        if self.stacked is not None:
+            _wire.count_stacked_dispatch("fallback", n_dispatches)
+            _wire.observe_dispatches_per_query(n_dispatches, n_queries)
+
     def predict_submit(self, queries: list):
+        if self._stacked_usable():
+            try:
+                handles = self.stacked.submit(queries)
+            except Exception:
+                _log.exception("stacked dispatch failed; serving this "
+                               "burst per-member")
+            else:
+                _wire.count_stacked_dispatch("stacked", len(handles))
+                _wire.observe_dispatches_per_query(len(handles),
+                                                   len(queries))
+                return self._finish_members(
+                    self.stacked.member_finishers(handles),
+                    len(queries))
         finishers = []
         for m in self.models:
             try:
@@ -133,6 +168,7 @@ class _PackedEnsemble:
             except Exception:
                 _log.exception("packed member dispatch failed; dropping "
                                "its vote")
+        self._count_fallback(len(finishers), len(queries))
         return self._finish_members(finishers, len(queries))
 
     def predict_bucket(self, n: int, dtype: Any = None) -> Optional[int]:
@@ -140,7 +176,11 @@ class _PackedEnsemble:
         must take the burst at the SAME bucket (they share one chip
         group, so same dp — differing buckets would mean mismatched
         staging shapes); any member without a staged entry, or any
-        disagreement, falls the burst back to the per-query path."""
+        disagreement, falls the burst back to the per-query path. A
+        stacked group answers once for everyone (congruence guarantees
+        agreement)."""
+        if self._stacked_usable():
+            return self.stacked.predict_bucket(n, dtype)
         buckets = set()
         for m in self.models:
             fn = getattr(m, "predict_bucket", None)
@@ -157,7 +197,19 @@ class _PackedEnsemble:
         the SAME shared staging buffer (one host buffer per burst for
         the whole ensemble — the per-member ``np.stack`` of the legacy
         path is gone entirely), overlapping on the device exactly like
-        ``predict_submit``."""
+        ``predict_submit``. A stacked group collapses even that: ONE
+        device_put, ONE vmapped dispatch for the whole member group."""
+        if self._stacked_usable():
+            try:
+                handle = self.stacked.staged_submit(buf, n)
+            except Exception:
+                _log.exception("stacked staged dispatch failed; "
+                               "serving this burst per-member")
+            else:
+                _wire.count_stacked_dispatch("stacked", 1)
+                _wire.observe_dispatches_per_query(1, n)
+                return self._finish_members(
+                    self.stacked.member_finishers([handle]), n)
         finishers = []
         for m in self.models:
             try:
@@ -165,7 +217,24 @@ class _PackedEnsemble:
             except Exception:
                 _log.exception("packed member staged dispatch failed; "
                                "dropping its vote")
+        self._count_fallback(len(finishers), n)
         return self._finish_members(finishers, n)
+
+    def replace_member(self, index: int, model: Any) -> None:
+        """The promote-path restack: swap ONE member while the others
+        stay device-resident. Stacked groups swap the member's slices
+        inside the stacked device arrays (no recompile, no re-upload
+        of the other members — ``StackedMembers.update_member``; an
+        incongruent incoming model raises BEFORE any state changes);
+        per-member groups just swap the model."""
+        old = self.models[index]
+        if self.stacked is not None:
+            self.stacked.update_member(index, model)
+        self.models[index] = model
+        try:
+            old.destroy()
+        except Exception:  # freeing the outgoing member is best-effort
+            _log.exception("replaced member destroy failed")
 
     def _finish_members(self, finishers: list, n: int):
         """The shared gather half of both dispatch paths: per-member
@@ -202,12 +271,21 @@ class _PackedEnsemble:
         return self.predict_submit(queries)()
 
     def warmup(self) -> None:
+        if self.stacked is not None:
+            # The stacked program is what serves; warming the N
+            # per-member runners too would pay N extra XLA compiles
+            # for a path only taken on a fallback burst (which then
+            # compiles lazily, logged).
+            self.stacked.warmup()
+            return
         for m in self.models:
             warm = getattr(m, "warmup", None)
             if warm is not None:
                 warm()
 
     def destroy(self) -> None:
+        if self.stacked is not None:
+            self.stacked.destroy()
         for m in self.models:
             m.destroy()
 
@@ -308,6 +386,14 @@ class InferenceWorker:
         # actually happened and rides the registration.
         self._quant_req = _wire.quant_mode()
         self._quant_active = False
+        # Stacked-ensemble request (NodeConfig.serving_stacked,
+        # default on): a multi-member same-family bin serves as ONE
+        # vmapped device dispatch per burst; _stacked_active reflects
+        # whether the congruence probe actually formed a group and
+        # rides the registration (the admin's surgical promote path
+        # keys restacks on it).
+        self._stacked_req = _wire.stacked_mode()
+        self._stacked_active = False
         self._stager = _HostStager()
         # Broker-REPORTED op failures (BusOpError) this many times in a
         # row — with zero successful iterations in between — mean
@@ -343,37 +429,54 @@ class InferenceWorker:
 
     # --- Setup + loop ---
 
+    def _load_member(self, tid: str):
+        """Load ONE trial's model (+ serving quantization when
+        requested); returns ``(model, score-or-None)``. Shared by the
+        initial load and the promote-path restack, so a restacked
+        member re-derives per-bin state (int8 scales in particular)
+        exactly like a fresh worker would."""
+        trial = self.meta.get_trial(tid)
+        if trial is None:
+            raise ValueError(f"unknown trial {tid}")
+        score = (float(trial["score"])
+                 if isinstance(trial.get("score"), (int, float))
+                 else None)
+        model_row = self.meta.get_model(trial["model_id"])
+        model_class = load_model_class(model_row["model_class"],
+                                       model_row.get("model_source"))
+        model = model_class(
+            **model_class.validate_knobs(trial["knobs"]))
+        model.load_parameters(self.params.load(trial["params_id"]))
+        if self._quant_req:
+            enable = getattr(model, "enable_serving_quant", None)
+            if enable is None:
+                _log.warning(
+                    "trial %s: %s has no serving quantization; "
+                    "serving f32", tid, type(model).__name__)
+            else:
+                report = enable(self._quant_req)
+                self._quant_active = True
+                _log.info(
+                    "trial %s quantized for serving: mode=%s "
+                    "int8=%d f32-fallback=%d", tid, report["mode"],
+                    report.get("n_int8", 0), report.get("n_f32", 0))
+        return model, score
+
     def _load_model(self) -> Any:
         """Load the worker's trial model(s); ``trial_id`` may be a
         comma-joined list when the scheduler packed an ensemble onto one
-        chip group (see ServicesManager.create_inference_services)."""
+        chip group (see ServicesManager.create_inference_services).
+        Same-family multi-member bins additionally try STACKED
+        formation (``RAFIKI_TPU_SERVING_STACKED``, default on): the
+        member weights stack along a leading model axis and every
+        burst serves as ONE vmapped dispatch; incongruent or sk-style
+        members fall back to the per-member runners unchanged."""
         models = []
         scores = []
         for tid in str(self.trial_id).split(","):
-            trial = self.meta.get_trial(tid)
-            if trial is None:
-                raise ValueError(f"unknown trial {tid}")
-            if isinstance(trial.get("score"), (int, float)):
-                scores.append(float(trial["score"]))
-            model_row = self.meta.get_model(trial["model_id"])
-            model_class = load_model_class(model_row["model_class"],
-                                           model_row.get("model_source"))
-            model = model_class(
-                **model_class.validate_knobs(trial["knobs"]))
-            model.load_parameters(self.params.load(trial["params_id"]))
-            if self._quant_req:
-                enable = getattr(model, "enable_serving_quant", None)
-                if enable is None:
-                    _log.warning(
-                        "trial %s: %s has no serving quantization; "
-                        "serving f32", tid, type(model).__name__)
-                else:
-                    report = enable(self._quant_req)
-                    self._quant_active = True
-                    _log.info(
-                        "trial %s quantized for serving: mode=%s "
-                        "int8=%d f32-fallback=%d", tid, report["mode"],
-                        report.get("n_int8", 0), report.get("n_f32", 0))
+            model, score = self._load_member(tid)
+            if score is not None:
+                scores.append(score)
             models.append(model)
         # The bin's tracked eval score (max over packed members) rides
         # the bus registration so the Predictor's tiered path can rank
@@ -381,7 +484,18 @@ class InferenceWorker:
         self._bin_score = max(scores) if scores else None
         if len(models) == 1:
             return models[0]
-        return _PackedEnsemble(models)
+        stacked = None
+        if self._stacked_req:
+            from ..model.jax_model import stack_members
+
+            stacked = stack_members(models)
+            if stacked is not None:
+                _log.info(
+                    "inference worker %s: %d same-family members "
+                    "stacked — one vmapped dispatch per burst",
+                    self.service_id, stacked.n_members)
+        self._stacked_active = stacked is not None
+        return _PackedEnsemble(models, stacked=stacked)
 
     def run(self) -> None:
         from ..utils.service_logs import bind_service_log
@@ -421,13 +535,18 @@ class InferenceWorker:
             # indistinguishable to the predictor — both keep the
             # per-query format. "quant" records what this worker
             # actually serves (bench/debug evidence, not negotiation).
+            # "stacked" advertises that this worker's multi-member bin
+            # serves via ONE vmapped program — the admin's promote
+            # path may then restack a single member in place
+            # (send_restack) instead of refusing surgical replacement.
             self._reg_info = {"trial_id": self.trial_id,
                               "pipeline": bool(self.pipeline),
                               "sync_latency_ms": sync_ms,
                               "score": self._bin_score,
                               "wire": self._wire_formats,
                               "quant": (self._quant_req
-                                        if self._quant_active else None)}
+                                        if self._quant_active else None),
+                              "stacked": self._stacked_active}
             self.cache.register_worker(self.inference_job_id,
                                        self.service_id,
                                        info=self._reg_info)
@@ -489,8 +608,20 @@ class InferenceWorker:
                     if draining:
                         items = [it for it in items
                                  if _CACHE_DRAIN_KEY not in it]
+                    # Promote-path restack markers (queue-ordered like
+                    # drain): everything enqueued before the marker
+                    # serves from the OLD member set — this burst
+                    # included — and the swap applies right after.
+                    restacks = [it[_CACHE_RESTACK_KEY] for it in items
+                                if _CACHE_RESTACK_KEY in it]
+                    if restacks:
+                        items = [it for it in items
+                                 if _CACHE_RESTACK_KEY not in it]
                     handle = (self._dispatch_batch(items) if items
                               else None)
+                    for r in restacks:
+                        self._restack_member(r)
+                        last_reg = _time.monotonic()
                     if not self.pipeline and handle is not None:
                         self._complete_batch(*handle)
                         handle = None
@@ -551,6 +682,65 @@ class InferenceWorker:
             raise
         else:
             self._unregister_best_effort()
+
+    def _restack_member(self, req: Any) -> None:
+        """Apply one promote-path restack request (``{"old": tid,
+        "new": tid}``): load the incoming trial's model, swap it into
+        the served ensemble IN PLACE (stacked groups swap device
+        slices — the other members stay resident and no runner
+        recompiles), then re-register with the updated bin so the
+        admin's poll observes the swap. Every failure leaves the old
+        member serving and the old registration standing — the admin's
+        registration-poll timeout is the rollback signal."""
+        old_tid = (req or {}).get("old")
+        new_tid = (req or {}).get("new")
+        tids = str(self.trial_id).split(",")
+        if not new_tid or old_tid not in tids:
+            _log.warning(
+                "inference worker %s: stale restack request %r "
+                "(serving %s); ignoring", self.service_id, req,
+                self.trial_id)
+            return
+        if not isinstance(self._model, _PackedEnsemble):
+            _log.warning(
+                "inference worker %s: restack requested but the bin "
+                "is not a packed ensemble; ignoring", self.service_id)
+            return
+        try:
+            model, _score = self._load_member(new_tid)
+            self._model.replace_member(tids.index(old_tid), model)
+        except Exception:
+            _log.exception(
+                "inference worker %s: restack %s -> %s failed; the "
+                "old member set keeps serving", self.service_id,
+                old_tid, new_tid)
+            return
+        tids[tids.index(old_tid)] = new_tid
+        self.trial_id = ",".join(tids)
+        scores = [s for s in (self._trial_score(t) for t in tids)
+                  if s is not None]
+        self._bin_score = max(scores) if scores else None
+        self._reg_info["trial_id"] = self.trial_id
+        self._reg_info["score"] = self._bin_score
+        # The meta mapping row follows the served bin (the admin's
+        # active_inference_workers / promote validation read it), then
+        # the re-registration makes the swap observable on the bus.
+        try:
+            self.meta.update_inference_job_worker(self.service_id,
+                                                  self.trial_id)
+        except Exception:
+            _log.exception("restack meta update failed; registration "
+                           "still reflects the swap")
+        self.cache.register_worker(self.inference_job_id,
+                                   self.service_id, info=self._reg_info)
+        _log.info("inference worker %s restacked %s -> %s (bin now "
+                  "%s)", self.service_id, old_tid, new_tid,
+                  self.trial_id)
+
+    def _trial_score(self, tid: str) -> Optional[float]:
+        trial = self.meta.get_trial(tid)
+        score = (trial or {}).get("score")
+        return float(score) if isinstance(score, (int, float)) else None
 
     def _unregister_best_effort(self) -> None:
         """Drop this worker's bus registration on the way out (crash or

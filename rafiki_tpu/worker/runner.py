@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Type
 from ..advisor.base import Proposal
 from ..constants import BudgetOption, TrialStatus
 from ..model.base import BaseModel
+from ..model.dataset import stage_owner
 from ..model.logger import logger
 from ..observe import metrics, trace_session, trial_trace_dir
 from ..observe import phases as _phases
@@ -409,15 +410,21 @@ class TrialRunner:
                 # label context attributes the train loop's MFU gauge /
                 # step-time histogram to THIS trial — the loop itself
                 # has no idea which trial it runs for.
+                # stage_owner marks the residency-cache entries this
+                # trial stages as THIS sub-train-job's, so evictions
+                # under budget pressure prefer other jobs' datasets
+                # (model/dataset.py ByteBudgetLRU).
                 t_train = time.monotonic()
                 with metrics.label_context(trial=trial_id[:12]), \
+                        stage_owner(self.sub_train_job_id), \
                         trace_session(trial_trace_dir(trial_id)):
                     model.train(self.train_dataset_path,
                                 shared_params=shared, **train_kwargs)
                 _phases.observe_phase("train",
                                       time.monotonic() - t_train)
                 t_eval = time.monotonic()
-                score = float(model.evaluate(self.val_dataset_path))
+                with stage_owner(self.sub_train_job_id):
+                    score = float(model.evaluate(self.val_dataset_path))
                 _phases.observe_phase("eval",
                                       time.monotonic() - t_eval)
                 # A proposal may retrieve from one scope and save under

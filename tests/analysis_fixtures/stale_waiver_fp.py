@@ -1,0 +1,22 @@
+"""RTA003 false-positive guard: reasoned waivers that DO suppress a
+live finding must not be reported as stale — in either placement form
+(same line, or the comment-above form)."""
+
+import threading
+
+
+class StillRacy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def a(self):
+        with self._lock:
+            self._n += 1
+
+    def b(self):
+        # rta: disable=RTA101 benign monotonic peek
+        return self._n
+
+    def c(self):
+        return self._n  # rta: disable=RTA101 benign monotonic peek

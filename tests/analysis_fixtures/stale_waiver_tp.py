@@ -1,0 +1,30 @@
+"""RTA003 true positives: reasoned waivers that suppress nothing.
+
+The access under the first waiver IS locked (the defect the comment
+once guarded was fixed, the comment rotted in place); the second
+waiver names a code no checker emits (a typo'd disable never guarded
+anything). Both must be reported as stale instead of silently
+pre-waiving the next regression on their lines.
+"""
+
+import threading
+
+
+class FixedLongAgo:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def a(self):
+        with self._lock:
+            self._n += 1
+
+    def b(self):
+        # rta: disable=RTA101 benign monotonic peek
+        with self._lock:
+            return self._n
+
+    def c(self):
+        # rta: disable=RTA999 this code does not exist
+        with self._lock:
+            return self._n

@@ -257,6 +257,71 @@ def test_waiver_class_form_covers_all_codes(tmp_path):
                for f in report.findings)
 
 
+def test_stale_waiver_true_positive(tmp_path):
+    """A reasoned waiver whose finding no longer fires is RTA003 —
+    and the unknown-code form is covered by a FULL run."""
+    root = _tree(tmp_path, "stale_waiver_tp.py")
+    report = run_suite(root, only=["guarded-state"])
+    stale = [f for f in report.new if f.code == "RTA003"]
+    # Only the RTA101 waiver under --checker scoping (RTA999 belongs
+    # to no ran checker, so the scoped run cannot judge it).
+    assert len(stale) == 1 and "RTA101" in stale[0].message
+    full = run_suite(root)
+    msgs = [f.message for f in full.new if f.code == "RTA003"]
+    assert len(msgs) == 2 and any("RTA999" in m for m in msgs)
+
+
+def test_stale_waiver_false_positive_guard(tmp_path):
+    """A waiver that suppresses a live finding (same-line and
+    comment-above forms) is never stale."""
+    report = run_suite(_tree(tmp_path, "stale_waiver_fp.py"),
+                       only=["guarded-state"])
+    assert not [f for f in report.new if f.code == "RTA003"]
+    assert len([f for f in report.findings
+                if f.status == "waived"]) == 2
+
+
+def test_stale_waiver_is_unwaivable(tmp_path):
+    pkg = tmp_path / "rafiki_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def f():\n"
+        "    # rta: disable=RTA003 trying to silence the detector\n"
+        "    # rta: disable=RTA101 stale reason\n"
+        "    return 1\n")
+    report = run_suite(str(tmp_path), only=["guarded-state"])
+    codes = sorted(f.code for f in report.new)
+    assert codes.count("RTA003") >= 1  # the stale RTA101 waiver
+    # ... and the RTA003-waiver itself is both inert and stale.
+    assert codes.count("RTA003") == 2
+
+
+def test_stale_waiver_skipped_in_changed_mode(tmp_path):
+    """--changed runs see a partial file view; stale-waiver judgment
+    would be unsound there and must not fire."""
+    root = _tree(tmp_path, "stale_waiver_tp.py")
+    report = run_suite(root, changed={"rafiki_tpu/stale_waiver_tp.py"})
+    assert not [f for f in report.findings if f.code == "RTA003"]
+
+
+def test_fixing_waived_finding_without_deleting_waiver_fails_suite(
+        tmp_path):
+    """Mutation gate on REAL source: jax_model.py's RTA301 waiver is
+    live because the train loop samples per-trial labels; removing
+    the labeled samples while keeping the comment must turn the suite
+    red with RTA003 (the rotting-disable class)."""
+    clean = _mutated_tree(tmp_path / "clean",
+                          "rafiki_tpu/model/jax_model.py", [])
+    report = run_suite(clean, only=["series-lifecycle"])
+    assert not [f for f in report.new
+                if f.code in ("RTA003", "RTA301")]
+    mutated = _mutated_tree(
+        tmp_path / "mut", "rafiki_tpu/model/jax_model.py",
+        [(", **_mlabels)", ")")])
+    report = run_suite(mutated, only=["series-lifecycle"])
+    assert any(f.code == "RTA003" for f in report.new)
+
+
 def test_waiver_inside_string_literal_is_inert(tmp_path):
     """Waiver-shaped text in a string/docstring is not a comment: it
     must neither suppress the adjacent finding nor mint an RTA001."""

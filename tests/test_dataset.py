@@ -95,6 +95,47 @@ def test_dataset_cache_byte_budget_lru(tmp_path, monkeypatch):
     assert load_image_dataset(pa) is not a  # was evicted, re-parsed
 
 
+def test_eviction_prefers_other_owners_entries_first():
+    """Cross-sub-job eviction preference (carried r9 item): under
+    budget pressure the residency caches evict OTHER jobs' entries
+    before the inserting job's own — counter-pinned on the evict
+    counter the caches share."""
+    from rafiki_tpu.model.dataset import ByteBudgetLRU, stage_owner
+    from rafiki_tpu.observe import metrics as obs_metrics
+
+    lru = ByteBudgetLRU("stage")
+    budget = 100
+    c = obs_metrics.registry().counter(
+        "rafiki_tpu_trial_stage_cache_total",
+        "Device staging cache events (event=hit|miss|evict)")
+    before = c.value(event="evict")
+    with stage_owner("jobA"):
+        lru.put("a1", "A1", 40, budget)
+    with stage_owner("jobB"):
+        lru.put("b1", "B1", 40, budget)
+    with stage_owner("jobA"):
+        # Over budget by one entry: plain LRU would evict a1 (the
+        # oldest); the preference evicts jobB's b1 instead, keeping
+        # jobA's still-hot dataset resident between ITS trials.
+        lru.put("a2", "A2", 40, budget)
+    assert lru.get("b1") is None
+    assert lru.get("a1") == "A1" and lru.get("a2") == "A2"
+    assert c.value(event="evict") == before + 1
+    # Same-owner pressure falls back to plain LRU order (a2 was
+    # touched by the get above, so a1 is now the LRU victim).
+    with stage_owner("jobA"):
+        lru.put("a3", "A3", 40, budget)
+    assert lru.get("a1") is None
+    assert lru.get("a2") == "A2" and lru.get("a3") == "A3"
+    assert c.value(event="evict") == before + 2
+    # Unowned inserts (direct SDK callers, no TrialRunner context)
+    # treat owned entries as foreign too.
+    lru.put("u1", "U1", 40, budget)
+    assert lru.get("u1") == "U1"
+    assert lru.get("a2") is None          # oldest foreign entry
+    assert lru.get("a3") == "A3"
+
+
 def test_dataset_cache_disabled_and_oversized(tmp_path, monkeypatch):
     p = _write(tmp_path, "a.npz", seed=0)
     monkeypatch.setenv(mod_dataset.DATASET_CACHE_ENV, "0")
