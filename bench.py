@@ -1736,6 +1736,82 @@ def main_serving_concurrent() -> dict:
                 side_p
             assert side_p["wire_bytes_per_query"] < \
                 side_q["wire_bytes_per_query"], (side_p, side_q)
+            # --- Trace-plane overhead (r17): tail-sampling ON vs OFF
+            # on the packed job, judged the r9 way — counter deltas
+            # (spans actually written, tail verdicts) are the stable
+            # evidence; the latency deltas ride along for the overhead
+            # question. The OFF side runs first under the process
+            # default (eager span writes); the ON side arms
+            # TRACE_TAIL_SAMPLE so only error/slow/sampled traces
+            # reach the store.
+            stats_p = requests.get(f"http://{host_p}/stats",
+                                   timeout=30).json()
+            tail_env = NodeConfig.env_name("trace_tail_sample")
+            prior_tail = os.environ.get(tail_env)
+
+            def spans_total():
+                m = parse_exposition(requests.get(
+                    f"http://{host_p}/metrics", timeout=30).text)
+                total = sum(v for _, v in m.get(
+                    "rafiki_tpu_trace_spans_total", []))
+                verdicts = {la.get("verdict"): int(v) for la, v in
+                            m.get("rafiki_tpu_trace_tail_total", [])}
+                return total, verdicts
+
+            def trace_window():
+                s0, v0 = spans_total()
+                b0 = _http_predict_buckets(host_p,
+                                           stats_p.get("http_service"))
+                q0 = requests.get(f"http://{host_p}/stats",
+                                  timeout=30).json()["queries"]
+                qps = one_window(url_p, batch, duration=4.0)
+                s1, v1 = spans_total()
+                b1 = _http_predict_buckets(host_p,
+                                           stats_p.get("http_service"))
+                q1 = requests.get(f"http://{host_p}/stats",
+                                  timeout=30).json()["queries"]
+                lat = _bucket_delta_percentiles_ms(b0, b1)
+                # spans/query from THIS window's own query delta — the
+                # packed A/B's cumulative count is a different workload
+                # and would skew the figure by its size ratio.
+                spans = int(s1 - s0)
+                return {"qps": round(qps, 2),
+                        "queries": int(q1 - q0),
+                        "spans_written": spans,
+                        "spans_per_query": round(
+                            spans / max(1, q1 - q0), 4),
+                        "tail_verdicts": {k: v1.get(k, 0) - v0.get(k, 0)
+                                          for k in v1},
+                        "latency_ms_p50_p95_p99": lat}
+
+            trace_off = trace_window()
+            os.environ[tail_env] = "0.05"
+            try:
+                trace_on = trace_window()
+            finally:
+                if prior_tail is None:
+                    os.environ.pop(tail_env, None)
+                else:
+                    os.environ[tail_env] = prior_tail
+            # Tail sampling must actually have dropped fast traces:
+            # fewer spans per query reach the store on the armed side.
+            assert trace_on["tail_verdicts"].get("dropped", 0) > 0, \
+                trace_on
+            trace_plane = {"tail_off": trace_off, "tail_on": trace_on}
+
+            # --- Disabled-side zero-series gate (r17 acceptance): this
+            # whole config ran WITHOUT attribution/exemplars, so the
+            # exposition must carry ZERO bin/tenant series and no
+            # exemplar annotations anywhere.
+            raw = requests.get(f"http://{host_p}/metrics",
+                               timeout=30).text
+            assert "rafiki_tpu_serving_bin_" not in raw, \
+                "attribution-off side exposed bin series"
+            assert "rafiki_tpu_serving_tenant_" not in raw, \
+                "attribution-off side exposed tenant series"
+            assert " # {" not in raw, \
+                "exemplars-off side exposed exemplar annotations"
+
             packed_ab = {
                 "wire_bytes_ratio": round(
                     side_p["wire_bytes_per_query"]
@@ -1767,6 +1843,7 @@ def main_serving_concurrent() -> dict:
         "serving_concurrent_qps", best_a, "queries/s",
         **_serving_wire_fields(),
         packed_ab=packed_ab,
+        trace_plane=trace_plane,
         n_windows=len(vals_a),
         spread=round((best_a - min(vals_a)) / best_a, 3),
         windows_microbatch=[round(v, 2) for v in vals_a],

@@ -705,7 +705,81 @@ class Admin:
             raise ValueError(
                 f"predictor at {host} unreachable: {e}") from None
         stats["inference_job_id"] = inference_job_id
+        # Exemplars (when RAFIKI_TPU_METRICS_EXEMPLARS is on): the
+        # frontend's /predict latency buckets each remember the last
+        # traced observation, so the dashboard can link a p99 bucket
+        # straight to its stitched GET /trace/<id> timeline. Resident-
+        # runner visibility: the predictor shares this process's
+        # registry; a subprocess frontend's exemplars ride its own
+        # /metrics and this proxy simply reports none.
+        from ..observe import metrics as obs_metrics
+
+        hist = obs_metrics.registry().find(
+            "rafiki_tpu_http_request_seconds")
+        if hist is not None and stats.get("http_service"):
+            stats["exemplars"] = hist.exemplars(
+                service=stats["http_service"], route="/predict")
         return stats
+
+    def profile_inference_job(self, inference_job_id: str,
+                              duration_s: float = 5.0,
+                              claims: Optional[Dict[str, Any]] = None,
+                              ) -> Dict[str, Any]:
+        """Trigger a bounded on-demand ``jax.profiler`` session on ONE
+        live inference worker of the job (``POST
+        /inference_jobs/<id>/profile``). The request travels as a
+        queue-ordered ``__profile__`` control frame — exactly the
+        drain/restack mechanism — so the worker starts the session
+        between bursts and its serve loop stops it at the deadline:
+        serving is never paused, the profile just observes the bursts
+        that run inside its window. The artifact lands under the
+        service log dir (``profiles/<job>/<ts>``, TensorBoard's
+        profile plugin reads it); a worker whose profiler is busy (a
+        trial trace in flight) skips the request, which the caller
+        sees as an empty artifact dir."""
+        import os as _os
+        import uuid as _uuid
+
+        from ..cache import Cache
+        from ..observe.profiling import PROFILE_MAX_S
+
+        job = self._owned_inference_job(inference_job_id, claims)
+        if job["status"] != InferenceJobStatus.RUNNING:
+            raise ValueError(
+                f"inference job {inference_job_id} is not RUNNING")
+        try:
+            duration_s = float(duration_s)
+        except (TypeError, ValueError):
+            raise ValueError(f"duration_s {duration_s!r} is not a "
+                             f"number") from None
+        # Bounded by contract: the profiler holds device buffers and a
+        # process-wide lock, so an abusive duration must clamp, not
+        # honor.
+        duration_s = min(max(0.5, duration_s), PROFILE_MAX_S)
+        rows = self.services.active_inference_workers(inference_job_id)
+        if not rows:
+            raise ValueError(
+                f"inference job {inference_job_id} has no active "
+                f"workers to profile")
+        target = rows[0]["service_id"]
+        base = self.services.log_dir
+        if not base:  # log capture disabled; still give the artifact
+            import tempfile as _tempfile  # a well-known place to land
+
+            base = _os.path.join(_tempfile.gettempdir(),
+                                 "rafiki_tpu_profiles")
+        out_dir = _os.path.join(
+            base, "profiles", inference_job_id[:8],
+            f"{int(time.time())}-{_uuid.uuid4().hex[:6]}")
+        Cache(self.services.serving_bus()).send_profile(
+            target, out_dir, duration_s)
+        _log.info("profile session queued on worker %s of job %s "
+                  "(%.1fs into %s)", target[:8], inference_job_id[:8],
+                  duration_s, out_dir)
+        return {"inference_job_id": inference_job_id,
+                "service_id": target,
+                "duration_s": duration_s,
+                "profile_dir": out_dir}
 
     def get_trace(self, trace_id: str,
                   claims: Optional[Dict[str, Any]] = None,

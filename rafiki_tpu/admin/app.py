@@ -24,6 +24,10 @@ route-compatible so reference quickstart scripts port 1:1:
                                      optional ``replace_trial_id``);
                                      invalidates the predictor edge
                                      cache before returning
+- ``POST /inference_jobs/<id>/profile``  bounded on-demand
+                                     ``jax.profiler`` session on a live
+                                     worker (``duration_s``; serving
+                                     never pauses — docs/observability)
 - ``GET  /trace/<trace_id>``         stitched span timeline of one trace
 - ``GET  /autoscale``                autoscaler decision ring + per-bin
                                      replica targets (``enabled: false``
@@ -80,6 +84,8 @@ class AdminApp:
              self._stop_inference_job),
             ("POST", "/inference_jobs/<job_id>/promote",
              self._promote_trial),
+            ("POST", "/inference_jobs/<job_id>/profile",
+             self._profile_inference_job),
             ("GET", "/trace/<trace_id>", self._get_trace),
             ("GET", "/users", self._list_users),
             ("POST", "/users/<user_id>/ban", self._ban_user),
@@ -224,6 +230,12 @@ class AdminApp:
         claims = self._auth(ctx)
         return 200, self.admin.get_inference_job_stats(params["job_id"],
                                                        claims=claims)
+
+    def _profile_inference_job(self, params, body, ctx):
+        claims = self._auth(ctx)
+        duration = (body or {}).get("duration_s", 5.0)
+        return 200, self.admin.profile_inference_job(
+            params["job_id"], duration_s=duration, claims=claims)
 
     def _get_trace(self, params, body, ctx):
         self._auth(ctx)

@@ -86,6 +86,17 @@ class PolicyKnobs:
     idle_sweeps: int = 3           # consecutive idle sweeps to preempt
 
 
+@dataclass(frozen=True)
+class BinSignals:
+    """One serving bin's load, from the r17 attribution ledger
+    (``rafiki_tpu_serving_bin_*``): smoothed queries/s scattered toward
+    the bin and smoothed admission-wait seconds accrued per second by
+    work bound for it."""
+
+    qps: float = 0.0
+    queue_rate: float = 0.0
+
+
 @dataclass
 class JobSignals:
     """One sweep's observed load for one inference job."""
@@ -95,10 +106,21 @@ class JobSignals:
     queue_cap: float = 1.0         # the frontend's admission bound
     backpressure_delta: float = 0.0  # 429s since the previous sweep
     p99_ms: Optional[float] = None   # /predict p99 over this sweep
+    # Per-bin load (None when the scraped frontend exposes no
+    # attribution ledger — pre-r17 workers / attribution off — the
+    # per-job fallback). Keyed by the ledger's truncated bin label.
+    bins: Optional[Dict[str, BinSignals]] = None
 
     @property
     def queue_frac(self) -> float:
         return self.queue_depth / max(self.queue_cap, 1.0)
+
+    def bin_signal(self, bin_id: str) -> Optional[BinSignals]:
+        """Ledger rows label bins by ``trial_id[:12]`` (bounded
+        cardinality); replica counts key the full id — match here."""
+        if not self.bins:
+            return None
+        return self.bins.get(str(bin_id)[:12])
 
 
 @dataclass
@@ -113,6 +135,11 @@ class JobState:
     prev_backpressure: Optional[float] = None
     prev_buckets: Dict[float, int] = field(default_factory=dict)
     prev_mono: Optional[float] = None
+    # Per-bin attribution totals + EWMAs (empty until a scrape exposes
+    # the ledger families).
+    prev_bin: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+    bin_qps_ewma: Dict[str, float] = field(default_factory=dict)
+    bin_queue_ewma: Dict[str, float] = field(default_factory=dict)
     # /stats memo: (serving service label, http service label,
     # queue cap, microbatch on?).
     labels: Optional[Tuple[str, str, float, bool]] = None
@@ -171,12 +198,32 @@ class AutoscalePolicy:
         k = self.knobs
         regime, reason = self.classify(sig)
         out: List[Decision] = []
+
+        def per_replica_load(b: str) -> Optional[float]:
+            s = sig.bin_signal(b)
+            if s is None:
+                return None
+            return s.qps / max(replicas[b], 1)
+
         if regime == "up":
             if now - state.last_up_mono < k.up_cooldown_s:
                 return []
-            # Fewest-replicas-first, bin id as the deterministic tie
-            # break; at most `step` adds per sweep, per-bin ceiling.
-            order = sorted(replicas, key=lambda b: (replicas[b], b))
+            if sig.bins:
+                # Per-bin signals (r17 attribution ledger): the
+                # HOTTEST bin per replica gets the capacity — a cold
+                # bin that merely has fewer replicas no longer absorbs
+                # a hot bin's scale-up. Unmeasured bins rank below any
+                # measured one; replicas then bin id break ties.
+                order = sorted(
+                    replicas,
+                    key=lambda b: (-(per_replica_load(b)
+                                     if per_replica_load(b) is not None
+                                     else -1.0), replicas[b], b))
+            else:
+                # Per-job fallback (old workers / attribution off):
+                # fewest-replicas-first, bin id as the deterministic
+                # tie break.
+                order = sorted(replicas, key=lambda b: (replicas[b], b))
             budget = k.step
             for b in order:
                 if budget == 0:
@@ -189,11 +236,25 @@ class AutoscalePolicy:
             if now - max(state.last_up_mono,
                          state.last_down_mono) < k.down_cooldown_s:
                 return []
-            # Most-replicated bin first; never below one replica (a
-            # bin's last replica is its ensemble vote, not capacity).
-            order = sorted(replicas, key=lambda b: (-replicas[b], b))
-            if replicas[order[0]] > 1:
-                out.append(Decision("scale_down", order[0], reason))
+            # Never below one replica (a bin's last replica is its
+            # ensemble vote, not capacity).
+            candidates = [b for b in replicas if replicas[b] > 1]
+            if candidates:
+                if sig.bins:
+                    # COLDEST bin per replica drains first (most-
+                    # replicated as the tie break). An UNMEASURED bin
+                    # ranks coldest of all: no ledger rows means no
+                    # observed traffic (a tiered best bin keeps every
+                    # query from its siblings) — ranking it hottest
+                    # would drain the one bin actually serving.
+                    victim = min(candidates, key=lambda b: (
+                        per_replica_load(b)
+                        if per_replica_load(b) is not None
+                        else -1.0, -replicas[b], b))
+                else:
+                    victim = sorted(candidates,
+                                    key=lambda b: (-replicas[b], b))[0]
+                out.append(Decision("scale_down", victim, reason))
         return out
 
 
@@ -392,9 +453,57 @@ class Autoscaler:
             bound = float("inf") if le == "+Inf" else float(le)
             buckets[bound] = buckets.get(bound, 0) + int(v)
 
+        # Per-bin attribution ledger (present only when the scraped
+        # frontend runs with RAFIKI_TPU_SERVING_ATTRIBUTION): fold the
+        # per-bin query/queue-seconds totals into per-bin rate EWMAs.
+        # Absent families leave `bins` None — the per-job fallback.
+        bin_now: Dict[str, Tuple[float, float]] = {}
+        for labels, v in metrics.get(
+                "rafiki_tpu_serving_bin_queries_total", []):
+            if labels.get("service") != service:
+                continue
+            b = labels.get("bin", "")
+            q, w = bin_now.get(b, (0.0, 0.0))
+            bin_now[b] = (q + v, w)
+        for labels, v in metrics.get(
+                "rafiki_tpu_serving_bin_queue_seconds_total", []):
+            if labels.get("service") != service:
+                continue
+            b = labels.get("bin", "")
+            q, w = bin_now.get(b, (0.0, 0.0))
+            bin_now[b] = (q, w + v)
+
         sig = JobSignals(queue_depth=depth, queue_cap=queue_cap)
         dt = (now - state.prev_mono) if state.prev_mono is not None \
             else None
+        if bin_now and dt and dt > 0:
+            bins: Dict[str, BinSignals] = {}
+            for b, (q, w) in bin_now.items():
+                pq, pw = state.prev_bin.get(b, (None, None))
+                if pq is None:
+                    continue  # first sight of this bin: basis only
+                inst_q = max(0.0, q - pq) / dt
+                inst_w = max(0.0, w - pw) / dt
+                prev = state.bin_qps_ewma.get(b)
+                state.bin_qps_ewma[b] = (
+                    inst_q if prev is None else
+                    _QPS_ALPHA * inst_q + (1.0 - _QPS_ALPHA) * prev)
+                prev = state.bin_queue_ewma.get(b)
+                state.bin_queue_ewma[b] = (
+                    inst_w if prev is None else
+                    _QPS_ALPHA * inst_w + (1.0 - _QPS_ALPHA) * prev)
+                bins[b] = BinSignals(
+                    qps=state.bin_qps_ewma[b],
+                    queue_rate=state.bin_queue_ewma[b])
+            if bins:
+                sig.bins = bins
+        if bin_now:
+            state.prev_bin = bin_now
+            # Bins retired by promotion churn must not pin stale EWMAs.
+            for stale in [b for b in state.bin_qps_ewma
+                          if b not in bin_now]:
+                state.bin_qps_ewma.pop(stale, None)
+                state.bin_queue_ewma.pop(stale, None)
         if dt and dt > 0 and state.prev_requests is not None:
             inst = max(0.0, requests - state.prev_requests) / dt
             state.qps_ewma = (inst if state.qps_ewma is None else
@@ -464,6 +573,11 @@ class Autoscaler:
                         "backpressure_delta": sig.backpressure_delta,
                         "p99_ms": sig.p99_ms},
         }
+        if sig.bins:
+            entry["signals"]["bins"] = {
+                b: {"qps": round(s.qps, 2),
+                    "queue_rate": round(s.queue_rate, 4)}
+                for b, s in sorted(sig.bins.items())}
         ok = True
         if not self.dry_run:
             try:
@@ -682,7 +796,8 @@ class Autoscaler:
         with self._lock:
             self._ring.append(entry)
         if self._m_actions is not None:
-            # rta: disable=RTA301 action/reason are a small fixed vocabulary; the whole family is dropped in close()
+            # action/reason are a small fixed vocabulary; the whole
+            # family is dropped by close()'s bare remove().
             self._m_actions.inc(action=action, reason=reason[:40])
         ctx = _trace.TraceContext(_trace.new_trace_id())
         _trace.record_event(f"autoscale.{action}", "autoscaler", [ctx],

@@ -43,7 +43,7 @@ from ..core import Checker, Finding, RepoContext, register
 PREFIX = "rafiki_tpu_"
 
 SUBSYSTEMS = {"bus", "serving", "http", "train", "trial", "trace",
-              "node", "fault", "autoscale"}
+              "node", "fault", "autoscale", "profile"}
 
 # _total marks counters (Prometheus convention); everything else is the
 # physical unit of a gauge/histogram.

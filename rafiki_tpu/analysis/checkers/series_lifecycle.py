@@ -69,6 +69,15 @@ class SeriesLifecycleChecker(Checker):
             func = node.func
             if isinstance(func, ast.Attribute) and \
                     func.attr == "remove":
+                if not node.keywords and not node.args:
+                    # A bare .remove() matches the EMPTY label subset —
+                    # it deletes every series of that metric (the r17
+                    # ledger's close-last-owner path), so it covers any
+                    # dynamic label in the module. Positional-arg
+                    # removes are NOT this: `os.remove(path)` and
+                    # `list.remove(x)` in a metrics module must never
+                    # silently disable the checker.
+                    has_splat_remove = True
                 for kw in node.keywords:
                     if kw.arg is None:
                         has_splat_remove = True

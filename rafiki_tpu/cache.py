@@ -44,6 +44,7 @@ from typing import Any, Collection, Dict, List, Optional
 import numpy as np
 
 from .bus import BaseBus
+from .observe import attribution as _attr
 from .observe import trace as _trace
 from .observe import wire as _wire
 
@@ -68,6 +69,12 @@ DRAIN_KEY = "__drain__"
 #: place — queue-ordered like the drain marker, so everything enqueued
 #: before it serves from the old member set.
 RESTACK_KEY = "__restack__"
+
+#: On-demand profiling marker frame key (Admin.profile_inference_job):
+#: the worker starts a bounded jax.profiler session between bursts —
+#: queue-ordered like drain/restack, so the session observes real
+#: serving traffic without ever pausing it.
+PROFILE_KEY = "__profile__"
 
 
 def encode_payload(value: Any) -> Any:
@@ -471,6 +478,7 @@ class Cache:
                                 trace_ctxs: Optional[List] = None,
                                 packed: Optional[PackedBatch] = None,
                                 packed_ok: Collection[str] = (),
+                                tenants: Optional[List] = None,
                                 ) -> str:
         """Scatter ONE pre-encoded batch to every worker in one bus
         call (``push_many``). The encoded payload list is SHARED across
@@ -486,9 +494,13 @@ class Cache:
         batch as ONE shared packed ``"batch"`` frame — encoded once for
         the entire fanout; the rest keep the per-query list.
         ``encoded_queries`` may be None only when every worker is in
-        ``packed_ok``."""
+        ``packed_ok``. ``tenants`` is the coalesced requests' tenant
+        mix (``[(tenant_hash, n_queries), ...]``) — it rides every
+        per-worker frame under the ``_tenant`` envelope key, exactly
+        like the trace carry."""
         batch_id = batch_id or uuid.uuid4().hex
         env = _trace_envelope(trace_ctxs)
+        tenant_env = _attr.inject_tenants(tenants)
         counting = _wire.counting()
         packed_frame = None
         if packed is not None and any(w in packed_ok
@@ -511,6 +523,8 @@ class Cache:
                                       _payload_nbytes(encoded_queries))
             if env is not None:
                 frame[_trace.ENVELOPE_KEY] = env
+            if tenant_env is not None:
+                frame[_attr.ENVELOPE_KEY] = tenant_env
             frames.append((f"q:{w}", frame))
         self.bus.push_many(frames)
         return batch_id
@@ -520,7 +534,8 @@ class Cache:
                           batch_id: Optional[str] = None,
                           trace_ctxs: Optional[List] = None,
                           packed: Optional[PackedBatch] = None,
-                          packed_ok: Collection[str] = ()) -> str:
+                          packed_ok: Collection[str] = (),
+                          tenants: Optional[List] = None) -> str:
         """Scatter per-SHARD slices of one pre-encoded batch — the
         data-parallel fanout behind ``Predictor``'s replica sharding.
 
@@ -542,7 +557,11 @@ class Cache:
         is exactly the rolling-promote / mixed-fleet case.
         ``encoded_queries`` may be None only when every planned worker
         is packed-capable (the caller materializes per-query frames
-        lazily otherwise)."""
+        lazily otherwise). ``tenants`` (the batch-level tenant mix)
+        rides each shard frame SCALED to the shard's slice of the
+        batch, so a worker prorating its burst's device time over the
+        frame's counts attributes one shard's worth, not the whole
+        batch's."""
         batch_id = batch_id or uuid.uuid4().hex
         env = _trace_envelope(trace_ctxs)
         n = packed.n if packed is not None else len(encoded_queries)
@@ -551,6 +570,19 @@ class Cache:
         for worker_id, start, count, shard_id in shards:
             frame: Dict[str, Any] = {"batch_id": batch_id,
                                      "shard": shard_id}
+            if tenants:
+                # FLOOR, no floor-of-one: a tenant whose scaled share
+                # of this shard truncates to zero is simply
+                # unattributed here (the under-report-never-fabricate
+                # rule) — rounding up would let a shard frame carry
+                # more attributed queries than it holds, and a
+                # floor of one would charge a 1-query tenant a slice
+                # of EVERY shard's device time.
+                tenant_env = _attr.inject_tenants(
+                    [(t, int(c * count / max(n, 1)))
+                     for t, c in tenants])
+                if tenant_env is not None:
+                    frame[_attr.ENVELOPE_KEY] = tenant_env
             if self._packed_wire_on:
                 frame["rw"] = [WIRE_NDBATCH]
             if packed is not None and worker_id in packed_ok:
@@ -640,6 +672,19 @@ class Cache:
         self.bus.push(f"q:{worker_id}", {RESTACK_KEY: {
             "old": str(old_trial_id), "new": str(new_trial_id)}})
 
+    def send_profile(self, worker_id: str, out_dir: str,
+                     duration_s: float) -> None:
+        """Queue an on-demand profiling marker
+        (``Admin.profile_inference_job``): the worker starts a bounded
+        ``jax.profiler`` session into ``out_dir`` between bursts and
+        its serve loop stops it once ``duration_s`` elapses — serving
+        is never paused, the session just observes the bursts that run
+        inside its window. A worker whose profiler is busy (a trial
+        trace in flight) skips the request; old workers ignore the
+        marker outright."""
+        self.bus.push(f"q:{worker_id}", {PROFILE_KEY: {
+            "dir": str(out_dir), "duration_s": float(duration_s)}})
+
     # --- Queries (InferenceWorker side) ---
 
     def pop_queries(self, worker_id: str, max_items: int = 0,
@@ -656,7 +701,7 @@ class Cache:
                                  timeout=timeout)
         counting = _wire.counting()
         for it in items:
-            if DRAIN_KEY in it or RESTACK_KEY in it:
+            if DRAIN_KEY in it or RESTACK_KEY in it or PROFILE_KEY in it:
                 pass  # control marker; the worker's loop acts on it
             elif "batch" in it:
                 raw = it["batch"]

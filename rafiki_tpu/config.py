@@ -243,8 +243,33 @@ class NodeConfig:
     trace_sample: float = 1.0              # fresh-trace sample rate 0..1
     #                                        (incoming X-Trace-Id always
     #                                        honored)
-    trace_max_mb: float = 64.0             # spans.jsonl size cap before
-    #                                        rolling to one .1 generation
+    trace_max_mb: float = 64.0             # per-SEGMENT spans.jsonl size
+    #                                        cap before a roll
+    # Segmented span-store retention: how many rolled generations
+    # (.1 .. .N, each sidecar-indexed for GET /trace/<id>) stay on
+    # disk, and the total byte budget across them (oldest deleted
+    # first; the newest rolled segment always survives).
+    trace_retain_segments: int = 4
+    trace_retain_mb: float = 256.0
+    # Tail-based sampling, decided at trace COMPLETION on the minting
+    # edge: error and slower-than-trace_tail_slow_ms traces are always
+    # retained; fast/ok ones are kept at trace_tail_sample. 1.0 (the
+    # default) disables tail sampling — every head-sampled trace is
+    # written eagerly, the pre-r17 behavior. Head trace_sample
+    # semantics are unchanged and apply first.
+    trace_tail_sample: float = 1.0
+    trace_tail_slow_ms: float = 250.0
+    # OpenMetrics-style exemplars: histograms attach the last traced
+    # observation's trace id per bucket to the exposition (and the
+    # dashboard links p99 to its stitched timeline). Default off.
+    metrics_exemplars: bool = False
+    # Serving attribution ledger (docs/observability.md): per-bin and
+    # per-tenant request/queue/device-time accounting at the serving
+    # frontend and inference workers. Default OFF — disabled means one
+    # None check per account site and ZERO rafiki_tpu_serving_bin_* /
+    # serving_tenant_* series; the autoscaler consumes the per-bin
+    # signals when a scraped frontend exposes them.
+    serving_attribution: bool = False
     # Metrics-only HTTP server for subprocess/docker worker runners
     # (they have no HTTP surface of their own). 0 = off; spawned
     # children inherit it via apply_env only when set.
@@ -444,6 +469,16 @@ class NodeConfig:
             raise ValueError("trace_sample must be within [0, 1]")
         if self.trace_max_mb <= 0:
             raise ValueError("trace_max_mb must be positive")
+        if self.trace_retain_segments < 1:
+            raise ValueError("trace_retain_segments must be >= 1 "
+                             "(1 = the legacy single .1 generation)")
+        if self.trace_retain_mb <= 0:
+            raise ValueError("trace_retain_mb must be positive")
+        if not (0.0 <= self.trace_tail_sample <= 1.0):
+            raise ValueError("trace_tail_sample must be within [0, 1] "
+                             "(1.0 disables tail sampling)")
+        if self.trace_tail_slow_ms < 0:
+            raise ValueError("trace_tail_slow_ms must be >= 0")
         if not (0 <= self.metrics_port <= 65535):
             raise ValueError(f"metrics_port {self.metrics_port} out of "
                              f"range (0 = no standalone server)")
@@ -586,6 +621,33 @@ class NodeConfig:
             "1" if self.metrics else "0"
         os.environ[self.env_name("trace_sample")] = str(self.trace_sample)
         os.environ[self.env_name("trace_max_mb")] = str(self.trace_max_mb)
+        # Span-store retention + tail sampling: the sink reads these
+        # per roll / per mint, so late-spawned children and in-process
+        # services resolve the same store shape. The tail knob pops at
+        # 1.0 (absent = tail off) so the legacy eager-write contract
+        # stays the default for hand-launched children.
+        os.environ[self.env_name("trace_retain_segments")] = \
+            str(self.trace_retain_segments)
+        os.environ[self.env_name("trace_retain_mb")] = \
+            str(self.trace_retain_mb)
+        if self.trace_tail_sample < 1.0:
+            os.environ[self.env_name("trace_tail_sample")] = \
+                str(self.trace_tail_sample)
+        else:
+            os.environ.pop(self.env_name("trace_tail_sample"), None)
+        os.environ[self.env_name("trace_tail_slow_ms")] = \
+            str(self.trace_tail_slow_ms)
+        # Exemplars + the attribution ledger resolve once at first use
+        # (observe.metrics / observe.attribution); both pop when off so
+        # "absent = disabled" stays the contract.
+        if self.metrics_exemplars:
+            os.environ[self.env_name("metrics_exemplars")] = "1"
+        else:
+            os.environ.pop(self.env_name("metrics_exemplars"), None)
+        if self.serving_attribution:
+            os.environ[self.env_name("serving_attribution")] = "1"
+        else:
+            os.environ.pop(self.env_name("serving_attribution"), None)
         # 0 = "no standalone metrics server": exporting "0" would make
         # worker runners bind port 0 (a random free port) — pop instead,
         # mirroring serving_client_header's absent-means-off contract.

@@ -16,19 +16,25 @@ first-party observability; the TPU-native rebuild makes it first-class:
   the metrics registry (``/stats`` and ``/metrics`` read one source).
 - ``observe.phases`` — trial-lifecycle phase timings and the
   dataset/staging residency-cache counters (``docs/training.md``).
+- ``observe.attribution`` — the serving attribution ledger: per-bin
+  and per-tenant request/queue/device-time accounting
+  (``docs/observability.md``; default off, zero series when disabled).
 
-``metrics``/``trace``/``serving``/``phases`` are stdlib-only; the
-profiling symbols load lazily so a bus broker or metrics scrape never
-imports jax.
+``metrics``/``trace``/``serving``/``phases``/``attribution`` are
+stdlib-only; the profiling symbols load lazily so a bus broker or
+metrics scrape never imports jax.
 """
 
-from . import metrics, phases, trace
+from . import attribution, metrics, phases, trace
 from .serving import ServingStats
 
-_PROFILING = ("MfuMeter", "device_peak_flops", "flops_of_compiled",
-              "flops_of_lowered", "trace_session", "trial_trace_dir")
+_PROFILING = ("MfuMeter", "DeviceProfileSession", "device_peak_flops",
+              "flops_of_compiled", "flops_of_lowered",
+              "start_device_profile", "trace_session",
+              "trial_trace_dir")
 
-__all__ = ["metrics", "phases", "trace", "ServingStats", *_PROFILING]
+__all__ = ["attribution", "metrics", "phases", "trace", "ServingStats",
+           *_PROFILING]
 
 
 def __getattr__(name):

@@ -38,6 +38,7 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 METRICS_ENV = "RAFIKI_TPU_METRICS"
+EXEMPLARS_ENV = "RAFIKI_TPU_METRICS_EXEMPLARS"
 
 #: Default latency buckets (seconds): 0.5 ms .. 10 s, roughly
 #: logarithmic — wide enough for a bus push (~us, lands in the first
@@ -52,6 +53,37 @@ def metrics_enabled() -> bool:
     per operation."""
     return os.environ.get(METRICS_ENV, "1").strip().lower() not in (
         "0", "false", "no", "off")
+
+
+#: Exemplar wiring, resolved ONCE at first histogram observe (the r11
+#: disabled-means-free discipline: off = one None check per observe).
+_exemplars_flag: Optional[bool] = None
+_exemplars_lock = threading.Lock()
+
+
+def exemplars_enabled() -> bool:
+    """Whether histograms attach a last-trace-id exemplar per bucket
+    (``RAFIKI_TPU_METRICS_EXEMPLARS``, default off), rendered
+    OpenMetrics-style in the exposition. Resolved once per process."""
+    global _exemplars_flag
+    flag = _exemplars_flag
+    if flag is None:
+        with _exemplars_lock:
+            flag = _exemplars_flag
+            if flag is None:
+                raw = os.environ.get(EXEMPLARS_ENV, "0")
+                flag = raw.strip().lower() not in (
+                    "0", "false", "no", "off", "")
+                _exemplars_flag = flag
+    return flag
+
+
+def reset_exemplars_for_tests() -> None:
+    """Drop the cached exemplar flag so a test that flips
+    ``RAFIKI_TPU_METRICS_EXEMPLARS`` sees its env take effect."""
+    global _exemplars_flag
+    with _exemplars_lock:
+        _exemplars_flag = None
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -154,6 +186,14 @@ class Histogram:
         self._lock = threading.Lock()
         # label key -> [per-bucket counts..., +Inf count, sum]
         self._series: Dict[Tuple, List[float]] = {}
+        # label key -> {bucket index (len(buckets) = +Inf): (trace_id,
+        # observed value, wall ts)} — the LAST traced observation per
+        # bucket, attached OpenMetrics-style in the exposition so a p99
+        # bucket links to an actual stitched timeline. Populated only
+        # when RAFIKI_TPU_METRICS_EXEMPLARS is on AND the observing
+        # thread carries a trace context.
+        self._exemplars: Dict[Tuple, Dict[int, Tuple[str, float,
+                                                     float]]] = {}
 
     def _row(self, key: Tuple) -> List[float]:
         row = self._series.get(key)
@@ -163,6 +203,18 @@ class Histogram:
         return row
 
     def observe(self, v: float, **labels: str) -> None:
+        exemplar = None
+        if exemplars_enabled():
+            from . import trace as _trace
+
+            ctx = _trace.current()
+            # exemplar_ok: a tail-sampled trace whose verdict is still
+            # pending (or dropped) must not be referenced — the link
+            # would resolve to an empty timeline.
+            if ctx is not None and _trace.exemplar_ok(ctx):
+                import time as _time
+
+                exemplar = (ctx.trace_id, float(v), _time.time())
         key = _label_key(labels)
         with self._lock:
             row = self._row(key)
@@ -171,8 +223,11 @@ class Histogram:
                     row[i] += 1
                     break
             else:
-                row[len(self.buckets)] += 1  # +Inf only
+                i = len(self.buckets)
+                row[i] += 1  # +Inf only
             row[-1] += v
+            if exemplar is not None:
+                self._exemplars.setdefault(key, {})[i] = exemplar
 
     # --- Reads ---
 
@@ -203,6 +258,22 @@ class Histogram:
     def percentile(self, q: float, **labels: str) -> Optional[float]:
         return bucket_percentile(self.cumulative_buckets(**labels), q)
 
+    def exemplars(self, **labels: str) -> Dict[str, Dict[str, Any]]:
+        """``{le: {"trace_id", "value", "ts"}}`` for one label set —
+        what the dashboard's stats panel links from (empty unless
+        exemplars are enabled and traced observations landed)."""
+        with self._lock:
+            ex = self._exemplars.get(_label_key(labels))
+            if not ex:
+                return {}
+            out = {}
+            for i, (tid, v, ts) in ex.items():
+                le = (_fmt(self.buckets[i]) if i < len(self.buckets)
+                      else "+Inf")
+                out[le] = {"trace_id": tid, "value": v,
+                           "ts": round(ts, 3)}
+            return out
+
     def remove(self, **labels: str) -> None:
         """Drop every series whose labels include this subset (see
         :meth:`Counter.remove`)."""
@@ -210,21 +281,39 @@ class Histogram:
         with self._lock:
             for key in [k for k in self._series if match <= set(k)]:
                 del self._series[key]
+                self._exemplars.pop(key, None)
 
-    def expose(self) -> List[str]:
+    @staticmethod
+    def _exemplar_suffix(ex: Optional[Tuple[str, float, float]]) -> str:
+        """OpenMetrics exemplar annotation for one bucket line
+        (`` # {trace_id="…"} <value> <ts>``), empty when absent."""
+        if ex is None:
+            return ""
+        tid, v, ts = ex
+        return (f' # {{trace_id="{tid}"}} {_fmt(v)} '
+                f"{round(ts, 3)}")
+
+    def expose(self, exemplars: bool = False) -> List[str]:
         lines = []
         with self._lock:
             series = sorted(self._series.items())
+            exemplars_by_key = ({k: dict(v)
+                                 for k, v in self._exemplars.items()}
+                                if exemplars else {})
         for key, row in series:
+            ex = exemplars_by_key.get(key, {})
             cum = 0
-            for bound, n in zip(self.buckets, row):
+            for i, (bound, n) in enumerate(zip(self.buckets, row)):
                 cum += int(n)
                 lines.append(
                     f"{self.name}_bucket"
-                    f"{_render_labels(key, {'le': _fmt(bound)})} {cum}")
+                    f"{_render_labels(key, {'le': _fmt(bound)})} {cum}"
+                    f"{self._exemplar_suffix(ex.get(i))}")
             total = cum + int(row[len(self.buckets)])
-            lines.append(f"{self.name}_bucket"
-                         f"{_render_labels(key, {'le': '+Inf'})} {total}")
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_render_labels(key, {'le': '+Inf'})} {total}"
+                f"{self._exemplar_suffix(ex.get(len(self.buckets)))}")
             lines.append(f"{self.name}_sum{_render_labels(key)} "
                          f"{_fmt(row[-1])}")
             lines.append(f"{self.name}_count{_render_labels(key)} {total}")
@@ -291,8 +380,14 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
-    def expose(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def expose(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4. ``exemplars=True``
+        (the explicit ``?exemplars=1`` debug view — see
+        ``metrics_route``) additionally annotates histogram buckets
+        with their last traced observation, OpenMetrics-style; the
+        default exposition never carries them — annotation syntax is
+        not part of 0.0.4, and a scrape config must never receive it
+        by accident."""
         with self._lock:
             metrics = sorted(self._metrics.items())
         lines: List[str] = []
@@ -300,7 +395,10 @@ class MetricsRegistry:
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
-            lines.extend(m.expose())
+            if exemplars and isinstance(m, Histogram):
+                lines.extend(m.expose(exemplars=True))
+            else:
+                lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
 
@@ -344,17 +442,52 @@ def bound_labels() -> Dict[str, str]:
 
 # --- Exposition parsing (bench / tests read what production exposes) --
 
+def _is_escaped(s: str, i: int) -> bool:
+    """Whether ``s[i]`` is escaped: preceded by an ODD number of
+    backslashes (a value ending in ``\\\\`` must not hide its closing
+    quote — the bug a single-backslash look-behind has)."""
+    n = 0
+    j = i - 1
+    while j >= 0 and s[j] == "\\":
+        n += 1
+        j -= 1
+    return n % 2 == 1
+
+
+def strip_exemplar(line: str) -> str:
+    """Drop an OpenMetrics exemplar annotation (`` # {...} value
+    [ts]``) from a sample line, respecting quotes — a ``#`` inside a
+    quoted label value is data, not an annotation. Scrapers of the
+    exposition (bench, the autoscaler, tests) route through
+    :func:`parse_exposition`, so exemplars can never break them."""
+    if "#" not in line:  # the overwhelming default: no scan at all
+        return line
+    in_quote = False
+    for i, ch in enumerate(line):
+        if ch == '"' and not _is_escaped(line, i):
+            in_quote = not in_quote
+        elif ch == "#" and not in_quote and i >= 1 \
+                and line[i - 1] in " \t":
+            return line[:i - 1].rstrip()
+    return line
+
+
 def parse_exposition(text: str) -> Dict[str, List[Tuple[Dict[str, str],
                                                         float]]]:
     """Parse Prometheus text into ``{name: [(labels, value), ...]}``.
-    Minimal by design: handles exactly what ``MetricsRegistry.expose``
-    emits (it is how the bench and the exposition tests read
-    ``/metrics`` instead of re-deriving numbers client-side)."""
+    Minimal by design: handles what ``MetricsRegistry.expose`` emits —
+    including OpenMetrics-style exemplar annotations on histogram
+    bucket lines (tolerated and stripped) and json-escaped label
+    values (``\\"``, ``\\n``, ``\\\\`` round-trip exactly). It is how
+    the bench and the autoscaler read ``/metrics`` instead of
+    re-deriving numbers client-side, so it must never regress on what
+    the exposition grows."""
     out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        line = strip_exemplar(line)
         name_part, _, value_part = line.rpartition(" ")
         labels: Dict[str, str] = {}
         if "{" in name_part:
@@ -374,13 +507,14 @@ def parse_exposition(text: str) -> Dict[str, List[Tuple[Dict[str, str],
 
 
 def _split_labels(body: str) -> Iterable[str]:
-    """Split ``k1="v1",k2="v2"`` on commas outside quoted values."""
+    """Split ``k1="v1",k2="v2"`` on commas outside quoted values
+    (escape-aware: ``\\"`` stays inside a value, ``\\\\"`` closes it)."""
     depth_quote = False
     start = 0
     i = 0
     while i < len(body):
         ch = body[i]
-        if ch == '"' and (i == 0 or body[i - 1] != "\\"):
+        if ch == '"' and not _is_escaped(body, i):
             depth_quote = not depth_quote
         elif ch == "," and not depth_quote:
             yield body[start:i]

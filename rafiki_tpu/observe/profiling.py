@@ -82,6 +82,86 @@ def trace_session(trace_dir: Optional[str]) -> Iterator[None]:
         _trace_lock.release()
 
 
+#: Hard ceiling on an on-demand profile session: the profiler holds
+#: buffers and a process-wide lock, so a forgotten/abusive request must
+#: self-bound.
+PROFILE_MAX_S = 60.0
+
+
+def _profile_counter():
+    from . import metrics
+
+    return metrics.registry().counter(
+        "rafiki_tpu_profile_sessions_total",
+        "On-demand device profile sessions (event=start|busy|stop)")
+
+
+class DeviceProfileSession:
+    """One bounded on-demand ``jax.profiler`` session on a LIVE
+    serving worker (``POST /inference_jobs/<id>/profile``): started
+    between bursts, stopped by the worker's serve loop once the
+    deadline passes (or on loop exit), so serving itself is never
+    paused — the session only observes the bursts that happen to run
+    inside its window.
+
+    Shares the process-wide profiler lock with the per-trial
+    ``trace_session``: jax supports ONE active trace per process, and
+    a busy profiler means "no session" (the admin surfaces that),
+    never a failed worker."""
+
+    def __init__(self, out_dir: str, deadline_mono: float):
+        self.out_dir = out_dir
+        self.deadline_mono = deadline_mono
+        self._stopped = False
+
+    def expired(self, now: float) -> bool:
+        return now >= self.deadline_mono
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            jax.profiler.stop_trace()
+            _log.info("on-demand profile written to %s", self.out_dir)
+        except Exception:
+            _log.exception("on-demand profile stop failed")
+        finally:
+            _trace_lock.release()
+            try:
+                _profile_counter().inc(event="stop")
+            except Exception:
+                pass
+
+
+def start_device_profile(out_dir: str, duration_s: float,
+                         ) -> Optional[DeviceProfileSession]:
+    """Begin a bounded on-demand profile into ``out_dir``; None when
+    the profiler is busy (a trial trace or another session holds it)
+    or cannot start — the caller keeps serving either way."""
+    duration_s = min(max(0.5, float(duration_s)), PROFILE_MAX_S)
+    if not _trace_lock.acquire(blocking=False):
+        _log.info("profiler busy; on-demand profile request skipped")
+        try:
+            _profile_counter().inc(event="busy")
+        except Exception:
+            pass
+        return None
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+    except Exception:
+        _trace_lock.release()
+        _log.exception("on-demand profile start failed")
+        return None
+    try:
+        _profile_counter().inc(event="start")
+    except Exception:
+        pass
+    return DeviceProfileSession(out_dir,
+                                time.monotonic() + duration_s)
+
+
 def device_peak_flops(device: Optional[Any] = None) -> Optional[float]:
     """Peak FLOP/s of one device, or None when unknown.
 

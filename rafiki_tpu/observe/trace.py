@@ -8,19 +8,40 @@ scatter (``"_trace"`` key — old frames simply lack it, old consumers
 ignore it: both directions of the version skew degrade to "no trace"),
 and recovered by the inference worker on the far side of the bus.
 
-Span *events* are flat JSONL lines appended to one shared file per log
-dir (``<log_dir>/spans.jsonl`` — the same directory
-``utils/service_logs`` gives every service), written with O_APPEND
-semantics so resident-runner threads and subprocess services
-interleave whole lines. ``Admin.get_trace`` (``GET /trace/<id>``)
-stitches the file's lines for one trace id into an ordered timeline —
-"why was this /predict slow" is one curl.
+Span *events* are flat JSONL lines appended to a **segmented store**
+under the log dir (``utils/service_logs`` gives every service the same
+directory): the active segment is ``spans.jsonl``, written with
+O_APPEND semantics so resident-runner threads and subprocess services
+interleave whole lines; at ``RAFIKI_TPU_TRACE_MAX_MB`` it rolls to
+``spans.jsonl.1`` (older generations shift to ``.2`` .. ``.N``), with
+retention bounded by ``RAFIKI_TPU_TRACE_RETAIN_SEGMENTS`` (generation
+count) and ``RAFIKI_TPU_TRACE_RETAIN_MB`` (total rolled bytes). Each
+frozen segment gets a **sidecar index** (``<segment>.idx``: trace id →
+byte offsets) built once at roll time, so ``Admin.get_trace``
+(``GET /trace/<id>``) is an indexed seek-and-read per frozen segment
+instead of a full-store scan; the active segment is covered by an
+incremental in-process scan cache that only ever reads the appended
+tail. "Why was this /predict slow, yesterday" stays one curl on a
+busy node.
 
-Knobs: ``RAFIKI_TPU_TRACE_SAMPLE`` (0..1, default 1.0) samples freshly
-minted traces at the edge; a request that ARRIVES with a trace id is
-always honored (the caller already decided to trace it). Sampling out
-costs nothing downstream — no context means no envelope field and no
-span writes.
+Sampling, two stages:
+
+- **Head** (``RAFIKI_TPU_TRACE_SAMPLE``, 0..1, default 1.0) samples
+  freshly minted traces at the edge; a request that ARRIVES with a
+  trace id is always honored (the caller already decided to trace it).
+  Sampling out costs nothing downstream — no context means no envelope
+  field and no span writes.
+- **Tail** (``RAFIKI_TPU_TRACE_TAIL_SAMPLE`` < 1.0 enables): spans of
+  freshly minted traces are buffered in memory until the minting edge
+  completes its request, then the verdict is made on the OUTCOME —
+  error responses and requests slower than
+  ``RAFIKI_TPU_TRACE_TAIL_SLOW_MS`` are always retained, fast/ok ones
+  are kept at the tail sample rate. The interesting 1% survives a
+  sample rate that would have dropped it head-side. Per-process by
+  construction: spans recorded by a *different* process (subprocess
+  workers) are written eagerly and can't be un-written — the orphan
+  spans of a dropped trace are the documented cost of not running a
+  central collector.
 """
 
 from __future__ import annotations
@@ -32,12 +53,16 @@ import random
 import threading
 import time
 import uuid
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 _log = logging.getLogger(__name__)
 
 TRACE_SAMPLE_ENV = "RAFIKI_TPU_TRACE_SAMPLE"
 TRACE_MAX_MB_ENV = "RAFIKI_TPU_TRACE_MAX_MB"
+TRACE_RETAIN_SEGMENTS_ENV = "RAFIKI_TPU_TRACE_RETAIN_SEGMENTS"
+TRACE_RETAIN_MB_ENV = "RAFIKI_TPU_TRACE_RETAIN_MB"
+TRACE_TAIL_SAMPLE_ENV = "RAFIKI_TPU_TRACE_TAIL_SAMPLE"
+TRACE_TAIL_SLOW_MS_ENV = "RAFIKI_TPU_TRACE_TAIL_SLOW_MS"
 TRACE_HEADER = "X-Trace-Id"
 
 #: Envelope key inside bus message frames. Absent on old frames (the
@@ -51,6 +76,18 @@ ENVELOPE_KEY = "_trace"
 MAX_ENVELOPE_TRACES = 32
 
 SPAN_FILE = "spans.jsonl"
+INDEX_SUFFIX = ".idx"
+
+#: Tail-sampling buffer bounds: a pending trace whose edge never
+#: completes (crashed handler, client that holds the socket forever)
+#: must not grow memory without bound — overflowing traces/spans are
+#: flushed to the store (retain-on-doubt, never silently dropped).
+_PENDING_MAX_TRACES = 512
+_PENDING_MAX_SPANS = 200
+#: Recently-dropped trace ids remembered so a straggler span arriving
+#: after the tail verdict (a late worker reply) doesn't resurrect a
+#: dropped trace as orphan lines.
+_DROPPED_REMEMBER = 1024
 
 
 def new_trace_id() -> str:
@@ -63,18 +100,22 @@ def new_span_id() -> str:
 
 class TraceContext:
     """One request's position in its trace: the trace id plus the
-    CURRENT span id (children parent onto it)."""
+    CURRENT span id (children parent onto it). ``tail=True`` marks a
+    context whose retention verdict is deferred to edge completion
+    (set only on the minting edge, under tail sampling)."""
 
-    __slots__ = ("trace_id", "span_id", "parent_id")
+    __slots__ = ("trace_id", "span_id", "parent_id", "tail")
 
     def __init__(self, trace_id: str, span_id: Optional[str] = None,
-                 parent_id: Optional[str] = None):
+                 parent_id: Optional[str] = None, tail: bool = False):
         self.trace_id = trace_id
         self.span_id = span_id or new_span_id()
         self.parent_id = parent_id
+        self.tail = tail
 
     def child(self) -> "TraceContext":
-        return TraceContext(self.trace_id, parent_id=self.span_id)
+        return TraceContext(self.trace_id, parent_id=self.span_id,
+                            tail=self.tail)
 
     def header_value(self) -> str:
         return f"{self.trace_id}-{self.span_id}"
@@ -119,6 +160,30 @@ def sample_rate() -> float:
         return 1.0
 
 
+def tail_sample_rate() -> Optional[float]:
+    """The tail-sampling keep rate for fast/ok traces, or None when
+    tail sampling is off (unset / 1.0 / unparseable — fail toward the
+    legacy keep-everything behavior)."""
+    raw = os.environ.get(TRACE_TAIL_SAMPLE_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        rate = float(raw)
+    except ValueError:
+        return None
+    if rate >= 1.0:
+        return None
+    return max(0.0, rate)
+
+
+def tail_slow_ms() -> float:
+    try:
+        return max(0.0, float(os.environ.get(TRACE_TAIL_SLOW_MS_ENV,
+                                             "250") or 250))
+    except ValueError:
+        return 250.0
+
+
 _HEADER_RE = None
 
 
@@ -128,8 +193,11 @@ def start_trace(header: Optional[str] = None) -> Optional[TraceContext]:
     trace + parent span; ANY other non-empty value (a dashed UUID, an
     opaque upstream id) is taken whole as the trace id — splitting at
     a dash would silently truncate standard ``str(uuid4())`` ids.
-    Otherwise a fresh trace is minted subject to the sample rate
-    (None = sampled out)."""
+    Honored traces are never tail-buffered (the caller already decided
+    to retain). Otherwise a fresh trace is minted subject to the head
+    sample rate (None = sampled out); under tail sampling the fresh
+    trace is registered PENDING — its spans buffer until
+    :func:`complete` delivers the outcome verdict."""
     global _HEADER_RE
     if header and header.strip():
         import re
@@ -146,7 +214,11 @@ def start_trace(header: Optional[str] = None) -> Optional[TraceContext]:
     rate = sample_rate()
     if rate <= 0.0 or (rate < 1.0 and random.random() >= rate):
         return None
-    return TraceContext(new_trace_id())
+    ctx = TraceContext(new_trace_id())
+    if tail_sample_rate() is not None and _sink_path is not None:
+        ctx.tail = True
+        _tail_register(ctx.trace_id)
+    return ctx
 
 
 # --- Envelope carry (bus frames) --------------------------------------
@@ -197,11 +269,25 @@ def extract_frames(frames: Iterable[Any]) -> List[TraceContext]:
     return out
 
 
-# --- Span sink (JSONL through the service log dir) --------------------
+# --- Span sink (segmented JSONL store through the service log dir) ----
 
 _sink_lock = threading.Lock()
 _sink_path: Optional[str] = None
 _sink_file = None
+
+# Tail-sampling state: pending (buffered) trace ids -> span lines, an
+# insertion-ordered dict so overflow flushes the OLDEST pending trace;
+# recently dropped ids suppress straggler spans.
+_tail_lock = threading.Lock()
+_tail_pending: "Dict[str, List[str]]" = {}
+_tail_dropped: "Dict[str, None]" = {}
+_tail_rng = random.Random()
+
+# Incremental scan cache for the ACTIVE segment: path -> [bytes
+# scanned, {trace_id: [line offsets]}]. Lookups only ever read the
+# tail appended since the previous lookup.
+_active_lock = threading.Lock()
+_active_cache: Dict[str, List[Any]] = {}
 
 
 def span_log_path(log_dir: str) -> str:
@@ -212,8 +298,11 @@ def configure(log_dir: Optional[str]) -> None:
     """Point this process's span sink at ``<log_dir>/spans.jsonl``
     (append; created on first span). ``None``/"" disables recording.
     Resident-runner mode configures once per platform; subprocess
-    services configure from their ``RAFIKI_TPU_LOG_DIR`` env."""
+    services configure from their ``RAFIKI_TPU_LOG_DIR`` env. Any
+    tail-pending buffers are flushed to the OLD sink first (retained:
+    reconfiguring must not silently eat buffered spans)."""
     global _sink_path, _sink_file
+    _tail_flush_all()
     with _sink_lock:
         if _sink_file is not None:
             try:
@@ -236,8 +325,37 @@ def _max_span_bytes() -> int:
         return 64 * 1024 * 1024
 
 
+def retain_segments() -> int:
+    """Rolled generations kept (``.1`` .. ``.N``). Default 4; the
+    pre-r17 single-``.1`` behavior is ``=1``."""
+    try:
+        return max(1, int(os.environ.get(TRACE_RETAIN_SEGMENTS_ENV,
+                                         "4") or 4))
+    except ValueError:
+        return 4
+
+
+def _retain_total_bytes() -> int:
+    try:
+        return int(float(os.environ.get(TRACE_RETAIN_MB_ENV, "256")
+                         or 256) * 1024 * 1024)
+    except ValueError:
+        return 256 * 1024 * 1024
+
+
+def _store_counter():
+    from . import metrics
+
+    return metrics.registry().counter(
+        "rafiki_tpu_trace_store_total",
+        "Trace span-store events (event=roll|index_build|index_read|"
+        "tail_scan)")
+
+
 def _write_lines(lines: List[str]) -> None:
     global _sink_file
+    wrote = 0
+    rolled: Optional[str] = None
     with _sink_lock:
         if _sink_path is None:
             return
@@ -248,19 +366,312 @@ def _write_lines(lines: List[str]) -> None:
                 _sink_file = open(_sink_path, "a", encoding="utf-8")
             _sink_file.write("".join(lines))
             _sink_file.flush()
-            # Size cap (RAFIKI_TPU_TRACE_MAX_MB, default 64): roll to
-            # ONE .1 generation so a busy node (or a client that always
-            # sends X-Trace-Id, bypassing sampling) cannot fill the
-            # disk. Append mode means tell() is the file size; a
-            # concurrent multi-process rotation race is benign — the
-            # atomic replace at worst drops some spans of one
-            # generation.
+            wrote = len(lines)
+            # Size cap (RAFIKI_TPU_TRACE_MAX_MB, default 64): roll the
+            # active segment into the retained generation chain so a
+            # busy node (or a client that always sends X-Trace-Id,
+            # bypassing sampling) cannot fill the disk while multi-day
+            # lookback stays possible. Append mode means tell() is the
+            # file size; a concurrent multi-process rotation race is
+            # benign — the atomic replaces at worst drop some spans of
+            # one generation.
             if _sink_file.tell() > _max_span_bytes():
                 _sink_file.close()
                 _sink_file = None
-                os.replace(_sink_path, _sink_path + ".1")
+                rolled = _roll_segments(_sink_path)
         except OSError:  # sink dir vanished (test teardown); drop spans
             _sink_file = None
+    if rolled is not None:
+        # The sidecar index scans the whole frozen segment — done
+        # OUTSIDE the sink lock, or every in-flight handler's span
+        # write (and tail flush) would stall behind a multi-MB read at
+        # each roll. The segment is frozen, so nothing races the scan;
+        # a reader arriving before the .idx lands just rebuilds it
+        # lazily (the _load_index fallback).
+        try:
+            _build_index(rolled)
+        except OSError:
+            pass
+    if wrote:
+        # Counted at WRITE time (outside the sink lock), so a tail-
+        # buffered span only counts once its trace's verdict actually
+        # lands it in the store — the bench's overhead delta reads
+        # spans that exist, not spans that were considered.
+        from . import metrics
+
+        metrics.registry().counter(
+            "rafiki_tpu_trace_spans_total",
+            "Span events written to the span log").inc(wrote)
+
+
+def _roll_segments(path: str) -> Optional[str]:
+    """Shift the generation chain (``.k`` -> ``.k+1``, oldest beyond
+    the retention bounds deleted) and freeze the active file as
+    ``.1``; returns the frozen segment's path so the CALLER can build
+    its sidecar index outside the sink lock (None when the freeze
+    itself failed). Caller holds ``_sink_lock``."""
+    n = retain_segments()
+    # Drop the generation that would shift past the count bound.
+    for stale in (f"{path}.{n}", f"{path}.{n}{INDEX_SUFFIX}"):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+    for k in range(n - 1, 0, -1):
+        for suffix in (INDEX_SUFFIX, ""):
+            src = f"{path}.{k}{suffix}"
+            if os.path.exists(src):
+                try:
+                    os.replace(src, f"{path}.{k + 1}{suffix}")
+                except OSError:
+                    pass
+    try:
+        os.replace(path, f"{path}.1")
+    except OSError:
+        return None
+    with _active_lock:
+        _active_cache.pop(path, None)  # the active file restarted
+    # Total-bytes retention: delete oldest generations until the rolled
+    # chain fits the byte budget (the newest generation always stays —
+    # a budget below one segment must not erase the roll entirely).
+    budget = _retain_total_bytes()
+    sizes = []
+    for k in range(1, n + 1):
+        try:
+            sizes.append((k, os.path.getsize(f"{path}.{k}")))
+        except OSError:
+            continue
+    total = sum(s for _, s in sizes)
+    for k, size in sorted(sizes, reverse=True):
+        if total <= budget or k == 1:
+            break
+        for stale in (f"{path}.{k}", f"{path}.{k}{INDEX_SUFFIX}"):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        total -= size
+    try:
+        _store_counter().inc(event="roll")
+    except Exception:  # metrics must never fail the span sink
+        pass
+    return f"{path}.1"
+
+
+def _trace_id_of_line(line: str) -> Optional[str]:
+    """Cheap trace-id extraction without a full JSON parse. Tolerates
+    whitespace after the key separator (lines written by other tools /
+    older versions with default ``json.dumps`` spacing); trace ids are
+    hex, so the value can never contain escapes."""
+    marker = '"trace_id":'
+    i = line.find(marker)
+    if i < 0:
+        return None
+    j = i + len(marker)
+    while j < len(line) and line[j] in " \t":
+        j += 1
+    if j >= len(line) or line[j] != '"':
+        return None
+    k = line.find('"', j + 1)
+    if k < 0:
+        return None
+    return line[j + 1:k]
+
+
+def _scan_offsets(path: str, start: int = 0,
+                  ) -> Tuple[Dict[str, List[int]], int]:
+    """``{trace_id: [byte offsets]}`` for every span line from byte
+    ``start`` to EOF, plus the byte position scanned to."""
+    offsets: Dict[str, List[int]] = {}
+    with open(path, "rb") as f:
+        f.seek(start)
+        pos = start
+        for raw in f:
+            if raw.endswith(b"\n"):
+                tid = _trace_id_of_line(
+                    raw.decode("utf-8", errors="replace"))
+                if tid:
+                    offsets.setdefault(tid, []).append(pos)
+                pos += len(raw)
+            else:
+                break  # torn tail write; re-scan it next lookup
+    return offsets, pos
+
+
+def index_path(segment_path: str) -> str:
+    return segment_path + INDEX_SUFFIX
+
+
+def _build_index(segment_path: str) -> Dict[str, List[int]]:
+    """Scan one FROZEN segment once and persist its sidecar index
+    (``{trace_id: [offsets]}``). The write is atomic (tmp + replace)
+    so a concurrent reader never loads a torn index."""
+    offsets, _pos = _scan_offsets(segment_path)
+    tmp = index_path(segment_path) + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"v": 1, "traces": offsets}, f,
+                      separators=(",", ":"))
+        os.replace(tmp, index_path(segment_path))
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+    try:
+        _store_counter().inc(event="index_build")
+    except Exception:
+        pass
+    return offsets
+
+
+def _load_index(segment_path: str) -> Optional[Dict[str, List[int]]]:
+    try:
+        with open(index_path(segment_path), encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    traces = data.get("traces") if isinstance(data, dict) else None
+    return traces if isinstance(traces, dict) else None
+
+
+def _read_lines_at(path: str, offsets: List[int],
+                   ) -> Tuple[List[str], int]:
+    """Seek-and-read one line per offset; returns the lines and the
+    bytes actually read (the indexed-read evidence)."""
+    out: List[str] = []
+    n_bytes = 0
+    try:
+        with open(path, "rb") as f:
+            for off in offsets:
+                f.seek(off)
+                raw = f.readline()
+                n_bytes += len(raw)
+                out.append(raw.decode("utf-8", errors="replace"))
+    except OSError:
+        return out, n_bytes
+    return out, n_bytes
+
+
+# --- Tail-sampling buffer ---------------------------------------------
+
+def _tail_register(trace_id: str) -> None:
+    flush: List[List[str]] = []
+    with _tail_lock:
+        if trace_id in _tail_pending:
+            return
+        while len(_tail_pending) >= _PENDING_MAX_TRACES:
+            # Oldest pending first: its edge presumably died; retain.
+            _oldest, lines = next(iter(_tail_pending.items()))
+            del _tail_pending[_oldest]
+            if lines:
+                flush.append(lines)
+        _tail_pending[trace_id] = []
+    for lines in flush:
+        _write_lines(lines)
+
+
+def _tail_route(lines_by_tid: List[Tuple[Optional[str], str]]) -> None:
+    """Write span lines, detouring those of tail-pending traces into
+    their buffer and suppressing those of recently dropped traces."""
+    direct: List[str] = []
+    overflow: List[str] = []
+    with _tail_lock:
+        for tid, line in lines_by_tid:
+            buf = _tail_pending.get(tid) if tid else None
+            if buf is not None:
+                if len(buf) >= _PENDING_MAX_SPANS:
+                    # A runaway trace stops buffering: flush what it
+                    # has, retain everything after (never drop spans
+                    # we can no longer hold the verdict open for).
+                    del _tail_pending[tid]
+                    overflow.extend(buf)
+                    overflow.append(line)
+                else:
+                    buf.append(line)
+            elif tid and tid in _tail_dropped:
+                continue
+            else:
+                direct.append(line)
+    if overflow:
+        _write_lines(overflow)
+    if direct:
+        _write_lines(direct)
+
+
+def complete(ctx: Optional[TraceContext], dur_s: float,
+             error: bool = False) -> None:
+    """The tail-sampling verdict, called by the minting edge when its
+    request finishes: error and slow-over-threshold traces always
+    flush to the store; fast/ok ones keep with the tail sample rate.
+    No-op for non-tail contexts (honored headers, head-sampled legacy
+    mode)."""
+    if ctx is None or not ctx.tail:
+        return
+    rate = tail_sample_rate()
+    with _tail_lock:
+        lines = _tail_pending.pop(ctx.trace_id, None)
+        if lines is None:
+            return  # already flushed (overflow) — retained
+        if error:
+            verdict = "kept_error"
+        elif dur_s * 1e3 >= tail_slow_ms():
+            verdict = "kept_slow"
+        elif rate is None or _tail_rng.random() < rate:
+            verdict = "kept_sampled"
+        else:
+            verdict = "dropped"
+            _tail_dropped[ctx.trace_id] = None
+            while len(_tail_dropped) > _DROPPED_REMEMBER:
+                _tail_dropped.pop(next(iter(_tail_dropped)))
+    if verdict != "dropped" and lines:
+        _write_lines(lines)
+    try:
+        from . import metrics
+
+        c = metrics.registry().counter(
+            "rafiki_tpu_trace_tail_total",
+            "Tail-sampling verdicts at trace completion (verdict="
+            "kept_error|kept_slow|kept_sampled|dropped)")
+        # rta: disable=RTA301 verdict is the fixed 4-value vocabulary above; process-global family, deliberately immortal
+        c.inc(verdict=verdict)
+    except Exception:
+        pass
+
+
+def _tail_flush_all() -> None:
+    with _tail_lock:
+        pending = list(_tail_pending.values())
+        _tail_pending.clear()
+    for lines in pending:
+        if lines:
+            _write_lines(lines)
+
+
+def exemplar_ok(ctx: TraceContext) -> bool:
+    """Whether a metric exemplar may reference this trace: a
+    tail-PENDING trace's verdict could still drop its spans, and a
+    dropped trace's exemplar would link to an empty timeline. Non-tail
+    contexts (honored headers, tail-off mode) and tail traces whose
+    verdict KEPT them qualify; pending/dropped ones don't — the
+    exemplar under-captures rather than dangles."""
+    if not ctx.tail:
+        return True
+    with _tail_lock:
+        return ctx.trace_id not in _tail_pending and \
+            ctx.trace_id not in _tail_dropped
+
+
+def seed_tail(seed: int) -> None:
+    """Deterministic tail-sampling decisions (tests / seeded bench)."""
+    global _tail_rng
+    _tail_rng = random.Random(seed)
+
+
+def reset_tail_for_tests() -> None:
+    with _tail_lock:
+        _tail_pending.clear()
+        _tail_dropped.clear()
 
 
 def record_event(name: str, service: str,
@@ -274,7 +685,7 @@ def record_event(name: str, service: str,
     which minted it)."""
     if _sink_path is None:
         return
-    lines = []
+    lines: List[Tuple[Optional[str], str]] = []
     for ctx in ctxs:
         if ctx is None:
             continue
@@ -289,14 +700,10 @@ def record_event(name: str, service: str,
         }
         if attrs:
             span["attrs"] = attrs
-        lines.append(json.dumps(span, separators=(",", ":")) + "\n")
+        lines.append((ctx.trace_id,
+                      json.dumps(span, separators=(",", ":")) + "\n"))
     if lines:
-        _write_lines(lines)
-        from . import metrics
-
-        metrics.registry().counter(
-            "rafiki_tpu_trace_spans_total",
-            "Span events recorded to the span log").inc(len(lines))
+        _tail_route(lines)
 
 
 class span:
@@ -332,34 +739,101 @@ class span:
 
 # --- Stitching (admin's GET /trace/<id>) ------------------------------
 
+def segment_paths(log_dir: str) -> List[str]:
+    """Store segments oldest-first: rolled generations ``.N`` .. ``.1``
+    then the active file (only the ones that exist)."""
+    path = span_log_path(log_dir)
+    out = [f"{path}.{k}"
+           for k in range(retain_segments(), 0, -1)
+           if os.path.exists(f"{path}.{k}")]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def _active_offsets(path: str, trace_id: str) -> Tuple[List[int], int]:
+    """The active segment's offsets for one trace via the incremental
+    scan cache; second value is the bytes scanned by THIS lookup (the
+    appended tail only, 0 on a warm repeat). The cache entry carries
+    the file's inode: a roll performed by ANOTHER process replaces the
+    active file (``os.replace`` + fresh create), and a size check
+    alone would miss it whenever the new file has already grown past
+    the cached scan position — stale offsets against new content would
+    silently truncate timelines."""
+    try:
+        st = os.stat(path)
+        size, ident = st.st_size, (st.st_ino, st.st_dev)
+    except OSError:
+        return [], 0
+    with _active_lock:
+        entry = _active_cache.get(path)
+        if entry is None or entry[0] > size or entry[2] != ident:
+            entry = [0, {}, ident]  # rolled/truncated/replaced: reset
+            _active_cache[path] = entry
+        scanned_from = entry[0]
+        if size > entry[0]:
+            fresh, pos = _scan_offsets(path, start=entry[0])
+            for tid, offs in fresh.items():
+                entry[1].setdefault(tid, []).extend(offs)
+            entry[0] = pos
+        offsets = list(entry[1].get(trace_id, ()))
+    return offsets, max(0, size - scanned_from)
+
+
 def collect_trace(log_dir: str, trace_id: str,
                   max_spans: int = 1000) -> Dict[str, Any]:
-    """Read ``<log_dir>/spans.jsonl`` (plus its rolled ``.1``
-    generation) and stitch every span of one trace into an ordered
-    timeline. The scan is substring-first (cheap reject) then
-    JSON-parse; a corrupt line is skipped, never fatal."""
+    """Stitch every span of one trace across the segmented store into
+    an ordered timeline. Frozen segments are INDEXED reads (sidecar
+    ``.idx`` built at roll time, rebuilt lazily if missing): a seek
+    and one readline per matching span, never a full-segment scan.
+    The active segment rides the incremental scan cache — only bytes
+    appended since the previous lookup are read. The per-segment
+    ``segments`` diagnostics (mode + bytes_read) are what the indexed-
+    read regression test pins. A corrupt line is skipped, never
+    fatal."""
     path = span_log_path(log_dir)
     spans: List[Dict[str, Any]] = []
-    for p in (path + ".1", path):
+    diags: List[Dict[str, Any]] = []
+    for p in segment_paths(log_dir):
         if len(spans) >= max_spans:
             break
-        try:
-            with open(p, "r", encoding="utf-8", errors="replace") as f:
-                for line in f:
-                    if trace_id not in line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    if rec.get("trace_id") == trace_id:
-                        spans.append(rec)
-                        if len(spans) >= max_spans:
-                            break
-        except OSError:
-            continue
+        if p == path:
+            offsets, scanned = _active_offsets(p, trace_id)
+            mode, overhead = "scan_tail", scanned
+            try:
+                _store_counter().inc(event="tail_scan")
+            except Exception:
+                pass
+        else:
+            index = _load_index(p)
+            if index is None:
+                try:
+                    index = _build_index(p)
+                    mode = "index_rebuilt"
+                except OSError:
+                    continue
+            else:
+                mode = "index"
+            try:
+                _store_counter().inc(event="index_read")
+            except Exception:
+                pass
+            offsets, overhead = list(index.get(trace_id, ())), 0
+        lines, n_bytes = _read_lines_at(p, offsets[:max_spans
+                                                   - len(spans)])
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("trace_id") == trace_id:
+                spans.append(rec)
+        diags.append({"segment": os.path.basename(p), "mode": mode,
+                      "n_spans": len(lines),
+                      "bytes_read": n_bytes + overhead})
     spans.sort(key=lambda s: (s.get("start_s", 0.0), s.get("name", "")))
     t0 = spans[0].get("start_s", 0.0) if spans else 0.0
     for s in spans:
         s["offset_ms"] = round((s.get("start_s", t0) - t0) * 1e3, 3)
-    return {"trace_id": trace_id, "n_spans": len(spans), "spans": spans}
+    return {"trace_id": trace_id, "n_spans": len(spans),
+            "spans": spans, "segments": diags}

@@ -35,6 +35,7 @@ from ..cache import decode_payload
 from ..config import NodeConfig, _parse_bool
 from ..constants import ServiceStatus
 from ..observe import ServingStats, trace
+from ..observe import attribution as _attr
 from ..store import MetaStore
 from ..utils.service import JsonHttpServer
 from .batcher import Backpressure, MicroBatcher
@@ -109,6 +110,10 @@ class PredictorService:
         self.client_header = (client_header
                               if client_header is not None else
                               _env_knob("serving_client_header", ""))
+        # Attribution ledger (construction-time snapshot, r11
+        # discipline): off = no tenant hashing, no account calls
+        # beyond a None check inside the ledger.
+        self._attribution = _attr.enabled()
         # Batcher-OFF fairness (the direct one-scatter-per-request
         # path has no admission queue): the same client_share caps one
         # client key's IN-FLIGHT queries instead, against the same
@@ -257,7 +262,8 @@ class PredictorService:
                      "epoch": self.edge_cache.invalidate()}
 
     def _run_queries(self, encoded_queries,
-                     client: Optional[str] = None) -> list:
+                     client: Optional[str] = None,
+                     tenant: Optional[str] = None) -> list:
         """One request's queries → ensembled predictions. With the edge
         cache enabled, each query is first resolved against it: hits
         are answered without touching the batcher/bus, concurrent
@@ -265,8 +271,9 @@ class PredictorService:
         genuine misses dispatch. Disabled cache = one attribute check,
         straight to dispatch."""
         if self.edge_cache is None:
-            return self._dispatch_queries(encoded_queries, client)
-        return self._run_cached(encoded_queries, client)
+            return self._dispatch_queries(encoded_queries, client,
+                                          tenant=tenant)
+        return self._run_cached(encoded_queries, client, tenant=tenant)
 
     def _handler_timeout(self) -> float:
         """Bound a handler's wait by the worst honest path: worker
@@ -277,7 +284,8 @@ class PredictorService:
                 + self.predictor.gather_timeout + 60.0)
 
     def _run_cached(self, encoded_queries,
-                    client: Optional[str] = None) -> list:
+                    client: Optional[str] = None,
+                    tenant: Optional[str] = None) -> list:
         import time
 
         cache = self.edge_cache
@@ -310,7 +318,8 @@ class PredictorService:
         if misses:
             try:
                 sub = self._dispatch_queries(
-                    [encoded_queries[i] for i, _, _ in misses], client)
+                    [encoded_queries[i] for i, _, _ in misses], client,
+                    tenant=tenant)
             except BaseException as e:
                 for _, key, flight in misses:
                     cache.fail(key, e, flight=flight)
@@ -354,7 +363,8 @@ class PredictorService:
         return results
 
     def _dispatch_queries(self, encoded_queries,
-                          client: Optional[str] = None) -> list:
+                          client: Optional[str] = None,
+                          tenant: Optional[str] = None) -> list:
         """Cache-miss path: through the shared micro-batcher when
         enabled (frames stay wire-encoded all the way to the bus — no
         decode/re-encode on the hot path)."""
@@ -363,7 +373,7 @@ class PredictorService:
         if self.batcher is not None:
             return self.batcher.submit(encoded_queries,
                                        timeout=self._handler_timeout(),
-                                       client=client)
+                                       client=client, tenant=tenant)
         n = len(encoded_queries)
         if client is not None and self._direct_cap:
             with self._direct_lock:
@@ -379,7 +389,8 @@ class PredictorService:
         try:
             self.stats.admitted(n)
             return self.predictor.predict(
-                [decode_payload(q) for q in encoded_queries])
+                [decode_payload(q) for q in encoded_queries],
+                tenants=[(tenant, n)] if tenant else None)
         finally:
             if client is not None and self._direct_cap:
                 with self._direct_lock:
@@ -394,16 +405,29 @@ class PredictorService:
             return 400, {"error": "missing JSON body"}
         client = (ctx.headers.get(self.client_header)
                   if self.client_header else None)
+        # Attribution: the hashed tenant key (never the raw header
+        # value) for the per-tenant rollup and the bus-envelope carry.
+        # The rollup counts requests actually SERVED — after the run,
+        # so a malformed-body or 100%-throttled (429) hammer can
+        # neither inflate a tenant's request count nor churn real
+        # tenants out of the LRU while serving nothing.
+        tenant = _attr.tenant_key(client) if self._attribution else None
         try:
             if "queries" in body:
                 preds = self._run_queries(body["queries"],
-                                          client=client)
+                                          client=client, tenant=tenant)
+                if tenant:
+                    _attr.account_admitted(tenant)
                 return 200, {"predictions": preds}
             if "query" in body:
                 preds = self._run_queries([body["query"]],
-                                          client=client)
+                                          client=client, tenant=tenant)
+                if tenant:
+                    _attr.account_admitted(tenant)
                 return 200, {"prediction": preds[0]}
         except Backpressure as e:
+            if self._attribution:
+                _attr.account_rejected(self.stats.service, e.reason)
             return (429,
                     {"error": str(e), "queue_depth": e.depth,
                      "queue_cap": e.cap, "reason": e.reason,

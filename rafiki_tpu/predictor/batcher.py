@@ -81,10 +81,11 @@ class _Request:
     """One caller's slice of a super-batch."""
 
     __slots__ = ("queries", "event", "result", "error", "trace",
-                 "client")
+                 "client", "tenant", "t_admit")
 
     def __init__(self, queries: List[Any],
-                 client: Optional[str] = None):
+                 client: Optional[str] = None,
+                 tenant: Optional[str] = None):
         self.queries = queries
         self.event = threading.Event()
         self.result: Optional[List[Any]] = None
@@ -94,6 +95,12 @@ class _Request:
         # carries it across the thread hop into the bus envelope.
         self.trace = trace.current()
         self.client = client
+        # Attribution: the hashed tenant key (None when the ledger is
+        # off / the request carried no client header) and the
+        # admission time — dispatch-minus-admit is the queue wait the
+        # ledger charges per bin.
+        self.tenant = tenant
+        self.t_admit = time.monotonic()
 
     def resolve(self, result: List[Any]) -> None:
         self.result = result
@@ -225,11 +232,14 @@ class MicroBatcher:
 
     def submit(self, queries: List[Any],
                timeout: Optional[float] = None,
-               client: Optional[str] = None) -> List[Any]:
+               client: Optional[str] = None,
+               tenant: Optional[str] = None) -> List[Any]:
         """Enqueue one request's queries; block until its slice of the
         super-batch results is ready. Raises :class:`Backpressure` when
         the admission queue is full — or, with fairness on, when
-        ``client``'s share of it is (the caller maps it to HTTP 429)."""
+        ``client``'s share of it is (the caller maps it to HTTP 429).
+        ``tenant`` is the hashed attribution key riding into the bus
+        envelope (None = unattributed)."""
         # rta: disable=RTA101 unlocked fast-path peek; start() re-checks under _cond
         if not self._started:
             self.start()
@@ -238,7 +248,7 @@ class MicroBatcher:
             return []
         if self._client_cap == 0:
             client = None
-        req = _Request(queries, client=client)
+        req = _Request(queries, client=client, tenant=tenant)
         with self._cond:
             # Checked under the lock: a request admitted after stop()'s
             # queue drain would sit in a queue no thread reads, blocking
@@ -384,19 +394,30 @@ class MicroBatcher:
                         req.fail(RuntimeError("micro-batcher stopped"))
                     return
             self._top_up(batch)
-            fill_s = time.monotonic() - t0
+            now = time.monotonic()
+            fill_s = now - t0
             flat: List[Any] = []
             ctxs: List[Any] = []
+            tenants: dict = {}
+            queue_wait_s = 0.0
             for req in batch:
                 flat.extend(req.queries)
                 if req.trace is not None:
                     ctxs.append(req.trace)
+                # Summed per-request admission wait — the queue-time
+                # signal the attribution ledger charges per bin.
+                queue_wait_s += max(0.0, now - req.t_admit)
+                if req.tenant:
+                    tenants[req.tenant] = (tenants.get(req.tenant, 0)
+                                           + len(req.queries))
             t1 = time.monotonic()
             wall = time.time()
             try:
                 finisher = self.predictor.predict_submit(
                     flat, pre_encoded=self.pre_encoded,
-                    trace_ctxs=ctxs)
+                    trace_ctxs=ctxs,
+                    tenants=sorted(tenants.items()) or None,
+                    queue_wait_s=queue_wait_s)
             except BaseException as e:  # noqa: BLE001 - forwarded to callers
                 self._inflight_sem.release()
                 for req in batch:

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..bus import BaseBus
 from ..cache import WIRE_NDBATCH, Cache, PackedBatch
+from ..observe import attribution as _attr
 from ..observe import metrics as _metrics
 from ..observe import wire as _wire_obs
 
@@ -339,15 +340,25 @@ class Predictor:
                 "Replicas penalized out of the shard plan after a "
                 "missed deadline (quarantine backs off exponentially "
                 "per consecutive strike)")
+        # Attribution ledger owner (no-op when the ledger is off):
+        # this frontend's per-bin series live exactly as long as it
+        # does — close() drops them, once (a double stop must not
+        # double-decrement the owner refcount).
+        self._attr_closed = False
+        _attr.open_owner()
 
     def close(self) -> None:
         """Drop this predictor's metric series (per-instance ``service``
         label; a resident runner deploying/stopping frontends would
-        otherwise grow the registry forever)."""
+        otherwise grow the registry forever) — the attribution ledger's
+        per-bin frontend series included."""
         for m in (self._m_shards, self._m_resubmits, self._m_replica,
                   self._m_quarantines, self._m_tier, self._m_avoided):
             if m is not None:
                 m.remove(service=self.service)
+        if not self._attr_closed:
+            self._attr_closed = True
+            _attr.close_service(self.service)
 
     def workers(self) -> List[str]:
         return self.cache.running_workers(self.inference_job_id)
@@ -655,6 +666,8 @@ class Predictor:
     def predict_submit(self, queries: List[Any], *,
                        pre_encoded: bool = False,
                        trace_ctxs: Optional[List[Any]] = None,
+                       tenants: Optional[List[Any]] = None,
+                       queue_wait_s: float = 0.0,
                        ) -> Callable[[], List[Optional[Any]]]:
         """Scatter a batch of queries NOW; returns a finisher that
         gathers + ensembles when called.
@@ -688,7 +701,11 @@ class Predictor:
         round-trip on the hot path. ``trace_ctxs`` carries the coalesced
         requests' trace contexts into the bus envelope (the
         micro-batcher's scatter thread has no ambient context; the
-        direct path falls back to the calling thread's).
+        direct path falls back to the calling thread's). ``tenants``
+        (``[(tenant_hash, n_queries), ...]``) and ``queue_wait_s``
+        (admission wait the batch accrued) feed the attribution ledger
+        and the ``_tenant`` envelope carry — both no-ops when the
+        ledger is off.
         """
         n = len(queries)
         if not n:
@@ -703,12 +720,16 @@ class Predictor:
             best = self._best_bin(groups)
             if best is not None:
                 return self._submit_tiered(n, wire, groups, rr, lat,
-                                           best, trace_ctxs)
+                                           best, trace_ctxs,
+                                           tenants=tenants,
+                                           queue_wait_s=queue_wait_s)
             # No best-bin basis (a serving worker predates score
             # registration): the whole batch fans out in full.
             self._count_tier("full", n)
         plan = self._plan_for(n, groups, rr, lat)
-        batch_id = self._scatter(plan, wire, trace_ctxs)
+        batch_id = self._scatter(plan, wire, trace_ctxs,
+                                 tenants=tenants,
+                                 queue_wait_s=queue_wait_s)
 
         def finish() -> List[Optional[Any]]:
             self._gather_shards(batch_id, plan, groups, wire,
@@ -734,12 +755,17 @@ class Predictor:
 
     def _scatter(self, plan: List[_Shard], wire: _WirePayload,
                  trace_ctxs: Optional[List[Any]],
-                 batch_id: Optional[str] = None) -> str:
+                 batch_id: Optional[str] = None,
+                 tenants: Optional[List[Any]] = None,
+                 queue_wait_s: float = 0.0) -> str:
         """Stamp + send one shard plan (one ``push_many`` round-trip);
         shared by the full and tiered submit paths. Shards bound for
         packed-capable workers carry the contiguous ``batch`` frame;
         the rest get per-query slices — one plan may mix both (the
-        mixed-fleet / rolling-promote case)."""
+        mixed-fleet / rolling-promote case). The attribution ledger
+        (no-op when off) accounts the plan's per-bin query counts here
+        — the one place every scatter flavor funnels through — plus
+        the super-batch's admission wait and the tenant carry."""
         import time
 
         now = time.monotonic()
@@ -749,9 +775,15 @@ class Predictor:
         batch_id = self.cache.send_query_shards(
             [s.wire() for s in plan], enc,
             batch_id=batch_id, trace_ctxs=trace_ctxs,
-            packed=packed, packed_ok=wire.capable)
+            packed=packed, packed_ok=wire.capable,
+            tenants=tenants)
         if self._m_shards is not None:
             self._m_shards.inc(len(plan), service=self.service)
+        bin_queries: Dict[str, int] = {}
+        for s in plan:
+            bin_queries[s.bin] = bin_queries.get(s.bin, 0) + s.count
+        _attr.account_scatter(self.service, bin_queries,
+                              queue_wait_s=queue_wait_s)
         return batch_id
 
     # --- Confidence-tiered serving (cheap-first, escalate on doubt) ---
@@ -775,6 +807,8 @@ class Predictor:
                        groups: Dict[str, List[str]], rr: int,
                        lat: Dict[str, float], best: str,
                        trace_ctxs: Optional[List[Any]],
+                       tenants: Optional[List[Any]] = None,
+                       queue_wait_s: float = 0.0,
                        ) -> Callable[[], List[Optional[Any]]]:
         """Cheap-first scatter: phase 1 covers only the best bin; the
         finisher escalates sub-threshold queries to the other bins as
@@ -786,7 +820,9 @@ class Predictor:
 
         best_groups = {best: groups[best]}
         plan1 = self._plan_for(n, best_groups, rr, lat)
-        batch1 = self._scatter(plan1, wire, trace_ctxs)
+        batch1 = self._scatter(plan1, wire, trace_ctxs,
+                               tenants=tenants,
+                               queue_wait_s=queue_wait_s)
         threshold = self.tier_threshold
 
         def finish() -> List[Optional[Any]]:
@@ -999,6 +1035,9 @@ class Predictor:
         return results
 
     def predict(self, queries: List[Any], *,
-                pre_encoded: bool = False) -> List[Optional[Any]]:
+                pre_encoded: bool = False,
+                tenants: Optional[List[Any]] = None,
+                ) -> List[Optional[Any]]:
         """Scatter-gather-ensemble a batch of queries (blocking)."""
-        return self.predict_submit(queries, pre_encoded=pre_encoded)()
+        return self.predict_submit(queries, pre_encoded=pre_encoded,
+                                   tenants=tenants)()

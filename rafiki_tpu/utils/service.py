@@ -91,7 +91,21 @@ def _compile(path: str) -> re.Pattern:
 
 def metrics_route(params, body, ctx):
     """The shared ``GET /metrics`` handler: the whole process registry
-    in Prometheus text exposition format."""
+    in Prometheus text exposition format. Exemplar annotations are
+    emitted ONLY on an explicit ``?exemplars=1`` request (the
+    dashboard's debug view and humans): exemplar syntax is not part of
+    the classic 0.0.4 format, and the registry's exposition is not
+    strict OpenMetrics either (counter families keep their ``_total``
+    names), so the opt-in must be something no scrape config sends by
+    accident — stock Prometheus *negotiates* OpenMetrics via Accept on
+    every scrape, which is exactly why content-type sniffing would be
+    wrong here. Every default scrape gets clean classic text whatever
+    ``RAFIKI_TPU_METRICS_EXEMPLARS`` says."""
+    if metrics.exemplars_enabled() and \
+            ctx.query_one("exemplars") in ("1", "true"):
+        return 200, RawResponse(
+            "text/plain; version=0.0.4; charset=utf-8",
+            metrics.registry().expose(exemplars=True))
     return 200, RawResponse("text/plain; version=0.0.4; charset=utf-8",
                             metrics.registry().expose())
 
@@ -238,21 +252,33 @@ class JsonHttpServer:
                         status, obj = 500, {
                             "error": f"{type(e).__name__}: {e}"}
                     dur = time.monotonic() - t0
-                    if outer._observe:
-                        # rta: disable=RTA301 route patterns are a fixed table; per-instance service= series are removed by their owners (predictor/app.py); the admin's live for the process
-                        outer._http_hist.observe(dur, service=name,
-                                                 route=route)
-                        # rta: disable=RTA301 code is a bounded HTTP status vocabulary on the same removable series
-                        outer._http_count.inc(service=name, route=route,
-                                              code=str(status))
                     if tctx is not None:
                         trace.record_event(
                             f"http {method} {route}", name, [tctx],
                             wall, dur, attrs={"status": status},
                             child=False)
+                        # Tail-sampling verdict: this edge minted the
+                        # trace, so its outcome (status + duration)
+                        # decides retention — errors and slow requests
+                        # always keep their spans, fast ones sample.
+                        trace.complete(tctx, dur,
+                                       error=status >= 500)
                         headers = dict(headers or {})
                         headers.setdefault(trace.TRACE_HEADER,
                                            tctx.header_value())
+                    if outer._observe:
+                        # Observed INSIDE the request's trace context
+                        # (the exemplar a bucket remembers reads the
+                        # ambient context at observe time) and AFTER
+                        # the tail verdict above — an exemplar must
+                        # only reference a trace whose spans were
+                        # actually retained.
+                        with trace.use(tctx):
+                            outer._http_hist.observe(dur, service=name,
+                                                     route=route)
+                        # rta: disable=RTA301 route patterns + status codes are fixed vocabularies; per-instance service= series are removed by their owners (predictor/app.py); the admin's live for the process
+                        outer._http_count.inc(service=name, route=route,
+                                              code=str(status))
                     self._reply(status, obj, headers)
                     return
                 if outer._observe:

@@ -24,9 +24,11 @@ import numpy as np
 from .. import faults
 from ..bus import BaseBus, BusOpError
 from ..cache import DRAIN_KEY as _CACHE_DRAIN_KEY
+from ..cache import PROFILE_KEY as _CACHE_PROFILE_KEY
 from ..cache import RESTACK_KEY as _CACHE_RESTACK_KEY
 from ..cache import WIRE_NDBATCH, Cache
 from ..constants import ServiceStatus
+from ..observe import attribution as _attr
 from ..observe import trace
 from ..observe import wire as _wire
 from ..parallel.chips import ChipGroup
@@ -134,6 +136,11 @@ class _PackedEnsemble:
         self.models = models
         self.stacked = stacked
         self.last_weight = len(models)
+        # Dispatch-variant breakdown for the attribution ledger:
+        # "stacked" (one vmapped program served the burst), "fallback"
+        # (stacked-capable worker served per-member), or "members"
+        # (plain packed ensemble — no stacked group formed).
+        self.last_mode = "members"
 
     def _stacked_usable(self) -> bool:
         return self.stacked is not None and self.stacked.n_valid > 0
@@ -144,8 +151,11 @@ class _PackedEnsemble:
         ensemble (no stacked group formed / knob off) records nothing
         — the off side must expose zero stacked series."""
         if self.stacked is not None:
+            self.last_mode = "fallback"
             _wire.count_stacked_dispatch("fallback", n_dispatches)
             _wire.observe_dispatches_per_query(n_dispatches, n_queries)
+        else:
+            self.last_mode = "members"
 
     def predict_submit(self, queries: list):
         if self._stacked_usable():
@@ -155,6 +165,7 @@ class _PackedEnsemble:
                 _log.exception("stacked dispatch failed; serving this "
                                "burst per-member")
             else:
+                self.last_mode = "stacked"
                 _wire.count_stacked_dispatch("stacked", len(handles))
                 _wire.observe_dispatches_per_query(len(handles),
                                                    len(queries))
@@ -206,6 +217,7 @@ class _PackedEnsemble:
                 _log.exception("stacked staged dispatch failed; "
                                "serving this burst per-member")
             else:
+                self.last_mode = "stacked"
                 _wire.count_stacked_dispatch("stacked", 1)
                 _wire.observe_dispatches_per_query(1, n)
                 return self._finish_members(
@@ -406,6 +418,16 @@ class InferenceWorker:
         self._thread: Optional[threading.Thread] = None
         self._model: Optional[Any] = None
         self._bin_score: Optional[float] = None  # set by _load_model
+        # On-demand device profiling (__profile__ control frame): the
+        # active bounded session, stopped by the serve loop at its
+        # deadline — None almost always.
+        self._profile: Optional[Any] = None
+        # Attribution-owner close must be idempotent: the clean-exit
+        # path closes it, and a meta-store failure right after would
+        # re-enter through the generic crash handler — a double
+        # decrement would clear the process tenant rollup out from
+        # under a still-serving sibling owner.
+        self._attr_closed = False
         # None when the fault plane is disabled (construction-time):
         # the dispatch path then pays one attribute check per burst.
         self._fault = faults.site_hook("worker")
@@ -550,6 +572,10 @@ class InferenceWorker:
             self.cache.register_worker(self.inference_job_id,
                                        self.service_id,
                                        info=self._reg_info)
+            # Attribution ledger owner (no-op when the ledger is off):
+            # this worker's (job, bin) series exist only while it
+            # serves; close_worker on the way out drops them.
+            _attr.open_owner()
         except Exception:
             _log.exception("inference worker %s failed to start",
                            self.service_id)
@@ -617,6 +643,16 @@ class InferenceWorker:
                     if restacks:
                         items = [it for it in items
                                  if _CACHE_RESTACK_KEY not in it]
+                    # On-demand profiling markers: start a bounded
+                    # jax.profiler session between bursts; the expiry
+                    # check below stops it — serving never pauses.
+                    profiles = [it[_CACHE_PROFILE_KEY] for it in items
+                                if _CACHE_PROFILE_KEY in it]
+                    if profiles:
+                        items = [it for it in items
+                                 if _CACHE_PROFILE_KEY not in it]
+                        for p in profiles:
+                            self._start_profile(p)
                     handle = (self._dispatch_batch(items) if items
                               else None)
                     for r in restacks:
@@ -629,6 +665,9 @@ class InferenceWorker:
                         self._complete_batch(*pending)
                     pending = handle
                     consecutive_op_errors = 0
+                    if self._profile is not None and \
+                            self._profile.expired(_time.monotonic()):
+                        self._stop_profile()
                     if draining:
                         _log.info("inference worker %s draining: "
                                   "served the queue, exiting",
@@ -661,6 +700,8 @@ class InferenceWorker:
                         pass  # broker still down; retry next iteration
             if pending is not None:
                 self._complete_batch(*pending)
+            self._stop_profile()
+            self._close_attr_owner()
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.STOPPED)
         except faults.InjectedCrash:
@@ -669,13 +710,22 @@ class InferenceWorker:
             # registration stays stale, exactly the wreckage a real
             # hard kill leaves, so the supervise sweep (dead thread ->
             # ERRORED -> respawn) and the Predictor's quarantine are
-            # what recovery actually exercises.
+            # what recovery actually exercises. PROCESS-LOCAL
+            # resources are different: a real kill takes the profiler
+            # lock and the ledger owner slot with the process, but a
+            # thread-level crash in a resident runner would leak them
+            # for the process's life (every later trial trace blocked,
+            # the tenant rollup never cleared) — release those.
+            self._stop_profile()
+            self._close_attr_owner()
             _log.error("inference worker %s: injected crash; dying "
                        "hard (row left RUNNING, registration stale)",
                        self.service_id)
             raise
         except Exception:
             _log.exception("inference worker %s crashed", self.service_id)
+            self._stop_profile()
+            self._close_attr_owner()
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.ERRORED)
             self._unregister_best_effort()
@@ -715,8 +765,13 @@ class InferenceWorker:
                 "old member set keeps serving", self.service_id,
                 old_tid, new_tid)
             return
+        old_bin = self.trial_id
         tids[tids.index(old_tid)] = new_tid
         self.trial_id = ",".join(tids)
+        # The old bin label's ledger series must not outlive the swap
+        # (each promotion would otherwise leak one (job, bin) label
+        # set per family, forever, in a resident runner).
+        _attr.drop_worker_bin(self.inference_job_id, old_bin)
         scores = [s for s in (self._trial_score(t) for t in tids)
                   if s is not None]
         self._bin_score = max(scores) if scores else None
@@ -736,6 +791,48 @@ class InferenceWorker:
         _log.info("inference worker %s restacked %s -> %s (bin now "
                   "%s)", self.service_id, old_tid, new_tid,
                   self.trial_id)
+
+    def _start_profile(self, req: Any) -> None:
+        """Apply one ``__profile__`` control frame: begin a bounded
+        on-demand ``jax.profiler`` session (skipped — never fatal —
+        when the profiler is busy, the request is malformed, or one is
+        already running on this worker)."""
+        out_dir = (req or {}).get("dir") if isinstance(req, dict) \
+            else None
+        if not out_dir:
+            _log.warning("inference worker %s: malformed profile "
+                         "request %r; ignoring", self.service_id, req)
+            return
+        if self._profile is not None:
+            _log.info("inference worker %s: profile session already "
+                      "active; request for %s skipped",
+                      self.service_id, out_dir)
+            return
+        try:
+            duration = float((req or {}).get("duration_s", 5.0) or 5.0)
+        except (TypeError, ValueError):
+            duration = 5.0
+        try:
+            from ..observe import profiling
+
+            self._profile = profiling.start_device_profile(out_dir,
+                                                           duration)
+        except Exception:
+            _log.exception("inference worker %s: profile session "
+                           "start failed", self.service_id)
+
+    def _close_attr_owner(self) -> None:
+        if not self._attr_closed:
+            self._attr_closed = True
+            _attr.close_worker(self.inference_job_id, self.trial_id)
+
+    def _stop_profile(self) -> None:
+        if self._profile is not None:
+            try:
+                self._profile.stop()
+            except Exception:
+                _log.exception("profile session stop failed")
+            self._profile = None
 
     def _trial_score(self, tid: str) -> Optional[float]:
         trial = self.meta.get_trial(tid)
@@ -778,6 +875,10 @@ class InferenceWorker:
             # calls, so n= targets an exact burst.
             self._fault(op="predict")
         trace_ctxs = trace.extract_frames(items)
+        # Tenant envelope (attribution ledger): popped whether the
+        # ledger is on or not — the key must not leak into decode
+        # paths — and merged across the burst's frames.
+        tenants = _attr.extract_frames_tenants(items)
         # Corrupt packed frames (pop_queries left batch=None +
         # batch_error) are answered IMMEDIATELY with per-query error
         # dicts — a bad producer poisons its own frame, never the
@@ -796,6 +897,7 @@ class InferenceWorker:
         finisher = None
         spans: list = []  # (item, start, count, is_batch)
         n = 0
+        attr_bucket = attr_dtype = None
         arrays = [it["batch"] for it in good
                   if isinstance(it.get("batch"), np.ndarray)]
         if arrays and len(arrays) == len(good):
@@ -808,6 +910,7 @@ class InferenceWorker:
                 if bucket_fn is not None:
                     bucket = bucket_fn(total, first.dtype)
             if bucket is not None:
+                attr_bucket, attr_dtype = bucket, str(first.dtype)
                 buf = self._stager.buffer(bucket, first.shape[1:],
                                           first.dtype)
                 start = 0
@@ -855,11 +958,20 @@ class InferenceWorker:
                                    "of %d", n)
                     err = {"error": f"{type(e).__name__}: {e}"}
                     finisher = lambda k=n: [err] * k  # noqa: E731
+        # The dispatch MODE and the serving BIN are captured here, not
+        # at completion: with pipelining on, burst N+1 is dispatched
+        # (and may flip last_mode) before burst N's _complete_batch
+        # runs, and a same-poll restack rewrites trial_id between this
+        # burst's dispatch (old members served it) and its completion.
         return (finisher, spans, n, trace_ctxs,
-                (_time.time(), _time.monotonic()))
+                (_time.time(), _time.monotonic()),
+                {"tenants": tenants, "bucket": attr_bucket,
+                 "dtype": attr_dtype, "bin": self.trial_id,
+                 "mode": getattr(self._model, "last_mode", "single")})
 
     def _complete_batch(self, finisher, spans: list, n: int,
-                        trace_ctxs: list = (), t0=None) -> None:
+                        trace_ctxs: list = (), t0=None,
+                        attr: Optional[dict] = None) -> None:
         import time as _time
 
         try:
@@ -879,6 +991,21 @@ class InferenceWorker:
         weight = int(getattr(self._model, "last_weight", 1))
         if self._quant_active:
             _wire.count_quant(n, self._quant_req)
+        if n:
+            # Attribution ledger (no-op when off): this burst's device
+            # time lands on the worker's (job, bin) with the dispatch-
+            # variant breakdown, and is prorated over the tenant mix
+            # the burst's frames carried.
+            attr = attr or {}
+            _attr.account_burst(
+                self.inference_job_id, attr.get("bin", self.trial_id),
+                n, burst_s,
+                bucket=attr.get("bucket"), dtype=attr.get("dtype"),
+                quant=self._quant_req if self._quant_active else "",
+                mode=attr.get("mode", "single"))
+            tenants = attr.get("tenants")
+            if tenants:
+                _attr.account_tenant_device(tenants, burst_s, n)
         # Per-query confidence (softmax margin; None for sk-style
         # outputs) rides batch replies for the Predictor's tiered
         # escalation — computed ONLY when tiering is on (see

@@ -1,5 +1,7 @@
 """True positive for RTA3xx: per-instance labeled series with no
-.remove() anywhere in the module — the r7 leak class verbatim."""
+.remove() anywhere in the module — the r7 leak class verbatim, plus
+the r17 bin/tenant-ledger variant (a hashed-key label is exactly as
+unbounded as a service id when the module never removes it)."""
 
 from rafiki_tpu.observe import metrics
 
@@ -15,3 +17,24 @@ class LeakyStats:
 
     def stop(self):
         pass  # no .remove(service=...): series outlive every instance
+
+
+class LeakyTenantLedger:
+    """The r17 attribution shape done WRONG: per-tenant (hashed client
+    key) series with no LRU eviction remove and no close-path remove —
+    a rotating-key client grows the registry without bound. The
+    ``os.remove`` below must NOT read as series cleanup (a
+    positional-arg ``.remove(x)`` is never the metric API)."""
+
+    def __init__(self):
+        self._tenant = metrics.registry().counter(
+            "rafiki_tpu_serving_tenant_requests_total")
+
+    def account(self, tenant_hash, bin_id):
+        self._tenant.inc(tenant=tenant_hash)  # <- RTA301
+        self._tenant.inc(bin=bin_id)  # <- RTA301
+
+    def cleanup_files(self, path):
+        import os
+
+        os.remove(path)  # positional remove: not a splat remove

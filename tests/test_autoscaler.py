@@ -119,6 +119,110 @@ def test_policy_down_never_below_one_replica():
                     now=0.0) == []
 
 
+def test_policy_per_bin_signals_target_hot_bin_up_cold_bin_down():
+    """The r17 attribution carry (ISSUE r14 follow-on closed): with
+    per-bin qps present, a scale-up lands on the HOTTEST bin per
+    replica even when another bin has fewer replicas, and a scale-down
+    drains the COLDEST bin even when another is more replicated."""
+    from rafiki_tpu.admin.autoscaler import BinSignals
+
+    p = _policy(up_cooldown_s=0.0, down_cooldown_s=0.0)
+    # Up: "cold" has fewer replicas (the legacy pick); "hot" carries
+    # the load — per-bin signals must redirect the capacity.
+    sig = JobSignals(backpressure_delta=3, queue_cap=100,
+                     bins={"hot": BinSignals(qps=100.0),
+                           "cold": BinSignals(qps=1.0)})
+    out = p.decide(sig, _replicas(hot=2, cold=1), JobState(), now=0.0)
+    assert [(d.action, d.bin) for d in out] == [("scale_up", "hot")]
+    # An unmeasured bin ranks below any measured one.
+    sig2 = JobSignals(backpressure_delta=3, queue_cap=100,
+                      bins={"hot": BinSignals(qps=5.0)})
+    out = p.decide(sig2, _replicas(hot=1, mystery=1), JobState(),
+                   now=0.0)
+    assert out[0].bin == "hot"
+    # Down: "hot" is MORE replicated (the legacy victim); the cold bin
+    # drains instead.
+    idle = JobSignals(queue_depth=0, queue_cap=100,
+                      bins={"hot": BinSignals(qps=100.0),
+                            "cold": BinSignals(qps=0.5)})
+    out = p.decide(idle, _replicas(hot=3, cold=2), JobState(), now=0.0)
+    assert [(d.action, d.bin) for d in out] == [("scale_down", "cold")]
+    # An UNMEASURED bin (no ledger rows — e.g. a tiered sibling that
+    # never sees escalations) ranks COLDEST for the drain: it would
+    # otherwise be protected while the only serving bin lost replicas.
+    out = p.decide(idle, _replicas(hot=2, mystery=2), JobState(),
+                   now=0.0)
+    assert [(d.action, d.bin) for d in out] == [("scale_down",
+                                                 "mystery")]
+    # Never below one replica, per-bin signals or not.
+    out = p.decide(idle, _replicas(hot=1, cold=1), JobState(), now=0.0)
+    assert out == []
+
+
+def test_policy_per_bin_fallback_without_ledger():
+    """Old workers / attribution off: ``bins`` is None and the legacy
+    ordering stands — fewest-replicas-first up, most-replicated down."""
+    p = _policy(up_cooldown_s=0.0, down_cooldown_s=0.0)
+    sig = JobSignals(backpressure_delta=1, queue_cap=100)
+    assert sig.bins is None and sig.bin_signal("a") is None
+    out = p.decide(sig, _replicas(a=2, b=1), JobState(), now=0.0)
+    assert out[0].bin == "b"
+    idle = JobSignals(queue_depth=0, queue_cap=100)
+    out = p.decide(idle, _replicas(a=3, b=2), JobState(), now=0.0)
+    assert [(d.action, d.bin) for d in out] == [("scale_down", "a")]
+
+
+def test_signals_fold_per_bin_ledger_rates(monkeypatch):
+    """The scrape half: serving_bin_* families in the exposition fold
+    into per-bin qps / queue-rate EWMAs keyed by the ledger's bin
+    label; a bin that disappears (promotion churn) drops its EWMA."""
+    scaler = Autoscaler.__new__(Autoscaler)  # scrape logic only
+
+    stats = {"service": "svc1", "http_service": "http1",
+             "knobs": {"queue_cap": 100}, "microbatch": True}
+
+    def expo(binq, binw):
+        lines = []
+        for b, v in binq.items():
+            lines.append('rafiki_tpu_serving_bin_queries_total'
+                         f'{{service="svc1",bin="{b}"}} {v}')
+        for b, v in binw.items():
+            lines.append('rafiki_tpu_serving_bin_queue_seconds_total'
+                         f'{{service="svc1",bin="{b}"}} {v}')
+        lines.append('rafiki_tpu_serving_requests_total'
+                     '{service="svc1"} 10')
+        lines.append('rafiki_tpu_serving_rejected_total'
+                     '{service="svc1"} 0')
+        lines.append('rafiki_tpu_serving_queue_depth_queries'
+                     '{service="svc1"} 0')
+        return "\n".join(lines) + "\n"
+
+    feed = {"text": expo({"binA": 0, "binB": 0},
+                         {"binA": 0.0, "binB": 0.0})}
+    monkeypatch.setattr(
+        Autoscaler, "_scrape",
+        lambda self, host, path: stats if path == "/stats"
+        else feed["text"])
+    job = {"predictor_host": "x:1"}
+    state = JobState()
+    assert scaler._signals(job, state, now=0.0) is None  # basis sweep
+    feed["text"] = expo({"binA": 50, "binB": 5},
+                        {"binA": 2.0, "binB": 0.1})
+    sig = scaler._signals(job, state, now=10.0)
+    assert sig is not None and sig.bins is not None
+    assert sig.bins["binA"].qps == pytest.approx(5.0)
+    assert sig.bins["binB"].qps == pytest.approx(0.5)
+    assert sig.bins["binA"].queue_rate == pytest.approx(0.2)
+    assert sig.bin_signal("binAxxxxxxxxxLONGID") is None
+    # bin label matching truncates like the ledger does
+    assert sig.bin_signal("binA") is sig.bins["binA"]
+    # churn: binB vanishes -> its EWMA is dropped, binA continues
+    feed["text"] = expo({"binA": 100}, {"binA": 2.5})
+    sig = scaler._signals(job, state, now=20.0)
+    assert "binB" not in state.bin_qps_ewma
+    assert sig.bins is not None and "binB" not in sig.bins
+
+
 def test_from_env_builds_knobs(monkeypatch):
     monkeypatch.setenv("RAFIKI_TPU_AUTOSCALE_MAX_REPLICAS", "7")
     monkeypatch.setenv("RAFIKI_TPU_AUTOSCALE_QUEUE_HIGH", "0.5")

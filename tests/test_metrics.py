@@ -366,3 +366,176 @@ def test_bus_tcp_client_ops_instrumented():
     finally:
         client.close()
         server.stop()
+
+
+# --- Exemplars + exposition hardening (ISSUE r17) ---
+
+def _expose_parse(reg):
+    return parse_exposition(reg.expose())
+
+
+def test_parse_exposition_escaped_label_values_roundtrip():
+    """Label values containing ", \\n and \\\\ survive expose -> parse
+    exactly (the backslash-run escape scan; a value ENDING in a
+    backslash is the case a single-char look-behind gets wrong)."""
+    reg = MetricsRegistry()
+    c = reg.counter("rafiki_tpu_node_escapes_total")
+    values = ['plain', 'has"quote', 'new\nline', 'back\\slash',
+              'trailing\\', 'mix\\"both\\', 'a,b{c}d']
+    for i, v in enumerate(values):
+        c.inc(i + 1, tricky=v)
+    parsed = _expose_parse(reg)["rafiki_tpu_node_escapes_total"]
+    got = {labels["tricky"]: v for labels, v in parsed}
+    assert got == {v: float(i + 1) for i, v in enumerate(values)}
+
+
+def test_parse_exposition_tolerates_exemplar_annotations():
+    from rafiki_tpu.observe.metrics import strip_exemplar
+
+    text = (
+        'rafiki_tpu_http_request_seconds_bucket{le="0.25"} 41 '
+        '# {trace_id="9f31aa"} 0.187 1754300000.0\n'
+        'rafiki_tpu_http_request_seconds_bucket{le="+Inf"} 42 '
+        '# {trace_id="9f31aa"} 3.0\n'
+        'rafiki_tpu_http_request_seconds_count 42\n'
+        # a # INSIDE a quoted value is data, not an annotation
+        'rafiki_tpu_node_odd_total{v="a # b"} 7\n')
+    out = parse_exposition(text)
+    buckets = out["rafiki_tpu_http_request_seconds_bucket"]
+    assert [v for _, v in buckets] == [41.0, 42.0]
+    assert out["rafiki_tpu_node_odd_total"][0][0]["v"] == "a # b"
+    assert strip_exemplar('x{v="a # b"} 7') == 'x{v="a # b"} 7'
+
+
+def test_histogram_exemplars_record_expose_and_api(monkeypatch):
+    from rafiki_tpu.observe import metrics as m
+    from rafiki_tpu.observe import trace
+
+    monkeypatch.setenv(m.EXEMPLARS_ENV, "1")
+    m.reset_exemplars_for_tests()
+    try:
+        reg = MetricsRegistry()
+        h = reg.histogram("rafiki_tpu_http_request_seconds")
+        tid = "ab" * 16
+        with trace.use(trace.TraceContext(tid)):
+            h.observe(0.003, service="svc", route="/predict")
+            h.observe(20.0, service="svc", route="/predict")  # +Inf
+        h.observe(0.003, service="svc", route="/other")  # untraced
+        ex = h.exemplars(service="svc", route="/predict")
+        assert ex["0.005"]["trace_id"] == tid
+        assert ex["+Inf"]["trace_id"] == tid
+        assert ex["0.005"]["value"] == 0.003
+        assert h.exemplars(service="svc", route="/other") == {}
+        # Annotations live ONLY in the negotiated OpenMetrics
+        # exposition; the classic 0.0.4 text stays clean (a stock
+        # Prometheus parser would reject annotated lines).
+        text = reg.expose(exemplars=True)
+        assert f'# {{trace_id="{tid}"}} 0.003' in text
+        assert "trace_id" not in reg.expose()
+        # the annotated exposition still parses (bucket values intact)
+        parsed = parse_exposition(text)
+        buckets = parsed["rafiki_tpu_http_request_seconds_bucket"]
+        by_le = {la["le"]: v for la, v in buckets
+                 if la.get("route") == "/predict"}
+        assert by_le["+Inf"] == 2.0
+        # remove() clears the exemplars with the series
+        h.remove(service="svc")
+        assert h.exemplars(service="svc", route="/predict") == {}
+        assert "trace_id" not in reg.expose(exemplars=True)
+    finally:
+        m.reset_exemplars_for_tests()
+
+
+def test_metrics_route_exemplars_are_explicit_opt_in(monkeypatch):
+    """GET /metrics stays clean classic 0.0.4 text for every scrape —
+    including one that NEGOTIATES OpenMetrics via Accept, which stock
+    Prometheus does by default — even with exemplars ON; only the
+    explicit ?exemplars=1 debug view is annotated."""
+    from rafiki_tpu.observe import metrics as m
+    from rafiki_tpu.observe import trace
+    from rafiki_tpu.utils.service import JsonHttpServer
+
+    monkeypatch.setenv(m.EXEMPLARS_ENV, "1")
+    m.reset_exemplars_for_tests()
+    server = JsonHttpServer([], host="127.0.0.1",
+                            name="exemplar-svc").start()
+    try:
+        tid = "ef" * 16
+        with trace.use(trace.TraceContext(tid)):
+            registry().histogram(
+                "rafiki_tpu_http_request_seconds").observe(
+                    0.004, service="exemplar-svc", route="/x")
+        base = f"http://127.0.0.1:{server.port}/metrics"
+        classic = requests.get(base, timeout=10)
+        assert "version=0.0.4" in classic.headers["Content-Type"]
+        assert " # {" not in classic.text
+        # a stock-Prometheus-style Accept must NOT flip the format
+        neg = requests.get(base, timeout=10, headers={
+            "Accept": "application/openmetrics-text; version=1.0.0"})
+        assert "version=0.0.4" in neg.headers["Content-Type"]
+        assert " # {" not in neg.text
+        annotated = requests.get(base + "?exemplars=1", timeout=10)
+        assert f'# {{trace_id="{tid}"}}' in annotated.text
+        assert parse_exposition(annotated.text)  # still parses
+    finally:
+        server.stop()
+        registry().find("rafiki_tpu_http_request_seconds").remove(
+            service="exemplar-svc")
+        m.reset_exemplars_for_tests()
+
+
+def test_exemplars_disabled_by_default(monkeypatch):
+    from rafiki_tpu.observe import metrics as m
+    from rafiki_tpu.observe import trace
+
+    monkeypatch.delenv(m.EXEMPLARS_ENV, raising=False)
+    m.reset_exemplars_for_tests()
+    try:
+        reg = MetricsRegistry()
+        h = reg.histogram("rafiki_tpu_http_request_seconds")
+        with trace.use(trace.TraceContext("cd" * 16)):
+            h.observe(0.003, service="svc")
+        assert h.exemplars(service="svc") == {}
+        assert " # {" not in reg.expose()
+    finally:
+        m.reset_exemplars_for_tests()
+
+
+def test_exemplars_skip_tail_dropped_traces(tmp_path, monkeypatch):
+    """An exemplar must never link a trace whose tail verdict dropped
+    its spans (the link would resolve to an empty timeline): pending
+    and dropped tail traces are skipped, kept ones qualify."""
+    from rafiki_tpu.observe import metrics as m
+    from rafiki_tpu.observe import trace
+
+    monkeypatch.setenv(m.EXEMPLARS_ENV, "1")
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    monkeypatch.setenv(trace.TRACE_TAIL_SLOW_MS_ENV, "100")
+    m.reset_exemplars_for_tests()
+    trace.reset_tail_for_tests()
+    trace.configure(str(tmp_path))
+    try:
+        reg = MetricsRegistry()
+        h = reg.histogram("rafiki_tpu_http_request_seconds")
+        # Pending: no verdict yet -> no exemplar.
+        ctx = trace.start_trace(None)
+        assert ctx is not None and ctx.tail
+        with trace.use(ctx):
+            h.observe(0.001, service="s")
+        assert h.exemplars(service="s") == {}
+        # Dropped: still no exemplar.
+        trace.complete(ctx, 0.001, error=False)
+        with trace.use(ctx):
+            h.observe(0.001, service="s")
+        assert h.exemplars(service="s") == {}
+        # Kept (slow): exemplar recorded.
+        kept = trace.start_trace(None)
+        trace.complete(kept, 0.5, error=False)
+        with trace.use(kept):
+            h.observe(0.5, service="s")
+        ex = h.exemplars(service="s")
+        assert any(v["trace_id"] == kept.trace_id for v in ex.values())
+    finally:
+        trace.configure(None)
+        trace.reset_tail_for_tests()
+        m.reset_exemplars_for_tests()

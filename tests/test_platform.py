@@ -90,6 +90,39 @@ def test_full_automl_job_and_serving(platform, synth_image_data):
                    for i, p in enumerate(preds)])
     assert acc > 0.3  # ensembled learnable-synth accuracy
 
+    # --- On-demand device profiling (r17): the admin path queues a
+    # __profile__ control frame on a LIVE worker; the artifact appears
+    # and serving is undisturbed — every request during the session is
+    # answered (counter-proven against the frontend's own stats).
+    import os
+
+    before = requests.get(f"http://{host}/stats",
+                          timeout=30).json()["requests"]
+    out = platform.admin.profile_inference_job(inf["id"],
+                                               duration_s=1.0)
+    assert out["service_id"] and out["profile_dir"]
+    for _ in range(4):  # traffic INSIDE and after the session window
+        resp = requests.post(
+            f"http://{host}/predict",
+            json={"queries": [encode_payload(val.images[0])]},
+            timeout=120)
+        assert resp.status_code == 200, resp.text
+        time.sleep(0.4)
+    after = requests.get(f"http://{host}/stats",
+                         timeout=30).json()["requests"]
+    assert after - before == 4  # nothing rejected, nothing stalled
+    deadline = time.monotonic() + 20
+    files = []
+    while time.monotonic() < deadline and not files:
+        files = [os.path.join(r, f)
+                 for r, _, fs in os.walk(out["profile_dir"])
+                 for f in fs]
+        time.sleep(0.2)
+    assert files, "profile session produced no artifact"
+    # a bogus duration clamps instead of erroring; a stopped job 400s
+    with pytest.raises(ValueError):
+        platform.admin.profile_inference_job("nope", duration_s=1.0)
+
     platform.admin.stop_inference_job(inf["id"])
     assert platform.admin.get_inference_job(inf["id"])["status"] == "STOPPED"
     # all chips free again

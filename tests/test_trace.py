@@ -175,6 +175,242 @@ def test_collect_skips_corrupt_lines(span_sink):
     assert out["n_spans"] == 1 and out["spans"][0]["name"] == "ok"
 
 
+def _spam(ctx, n, name="spam"):
+    for _ in range(n):
+        trace.record_event(name, "s", [ctx], 1.0, 0.001)
+
+
+def test_multi_segment_store_indexed_read(span_sink, monkeypatch):
+    """ISSUE r17 acceptance: a multi-segment store serves
+    GET /trace/<id> via the sidecar index — frozen segments are seek+
+    readline at indexed offsets, never a full-file scan — including a
+    trace whose spans straddle a segment roll."""
+    monkeypatch.setenv(trace.TRACE_MAX_MB_ENV, str(1 / 1024))  # 1 KiB
+    monkeypatch.setenv(trace.TRACE_RETAIN_SEGMENTS_ENV, "3")
+    straddle = trace.TraceContext("ab" * 16)
+    filler = trace.TraceContext("cd" * 16)
+    # One straddle span early, spam until at least two rolls happened,
+    # one straddle span late: its spans now live in a frozen segment
+    # AND the active file.
+    trace.record_event("first", "s", [straddle], 1.0, 0.001)
+    path = trace.span_log_path(span_sink)
+    for _ in range(100):
+        _spam(filler, 5)
+        if os.path.exists(path + ".2"):
+            break
+    assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+    trace.record_event("last", "s", [straddle], 2.0, 0.001)
+    # Roll-time sidecar indexes exist for the frozen generations.
+    assert os.path.exists(trace.index_path(path + ".1"))
+    out = trace.collect_trace(span_sink, straddle.trace_id)
+    names = {s["name"] for s in out["spans"]}
+    assert "first" in names and "last" in names
+    # The read-path evidence: every frozen segment was an INDEXED
+    # read, and the bytes it cost are the matching lines only — far
+    # below the segment size (the no-full-scan pin).
+    frozen = [d for d in out["segments"]
+              if d["segment"] != trace.SPAN_FILE]
+    assert frozen, out["segments"]
+    for diag in frozen:
+        assert diag["mode"] == "index", out["segments"]
+        seg = os.path.join(span_sink, diag["segment"])
+        if diag["n_spans"] == 0:
+            assert diag["bytes_read"] == 0, diag
+        else:
+            assert diag["bytes_read"] < os.path.getsize(seg) / 2, diag
+    # The filler trace is found through the same index path.
+    assert trace.collect_trace(span_sink,
+                               filler.trace_id)["n_spans"] > 0
+    # Warm repeat on the ACTIVE segment scans zero new bytes (the
+    # incremental cache only ever reads the appended tail).
+    again = trace.collect_trace(span_sink, straddle.trace_id)
+    active = [d for d in again["segments"]
+              if d["segment"] == trace.SPAN_FILE]
+    assert active and active[0]["mode"] == "scan_tail"
+    span_bytes = sum(d["n_spans"] for d in again["segments"])
+    assert span_bytes  # sanity: the trace is still found
+
+
+def test_index_rebuilt_when_sidecar_missing(span_sink, monkeypatch):
+    """A frozen segment whose .idx vanished (partial copy, manual
+    cleanup) is re-indexed lazily — and the rebuilt sidecar persists
+    for the next reader."""
+    monkeypatch.setenv(trace.TRACE_MAX_MB_ENV, str(1 / 1024))
+    ctx = trace.TraceContext("ee" * 16)
+    _spam(ctx, 20)
+    path = trace.span_log_path(span_sink)
+    assert os.path.exists(path + ".1")
+    os.remove(trace.index_path(path + ".1"))
+    out = trace.collect_trace(span_sink, ctx.trace_id)
+    assert out["n_spans"] > 0
+    modes = {d["segment"]: d["mode"] for d in out["segments"]}
+    assert modes.get(trace.SPAN_FILE + ".1") == "index_rebuilt"
+    assert os.path.exists(trace.index_path(path + ".1"))
+    out2 = trace.collect_trace(span_sink, ctx.trace_id)
+    modes2 = {d["segment"]: d["mode"] for d in out2["segments"]}
+    assert modes2.get(trace.SPAN_FILE + ".1") == "index"
+
+
+def test_retention_bounds_segments_and_bytes(span_sink, monkeypatch):
+    """The generation chain is bounded by BOTH knobs: at most
+    RETAIN_SEGMENTS rolled files, and oldest generations are deleted
+    when the rolled chain exceeds RETAIN_MB (the newest rolled segment
+    always survives)."""
+    monkeypatch.setenv(trace.TRACE_MAX_MB_ENV, str(1 / 1024))
+    monkeypatch.setenv(trace.TRACE_RETAIN_SEGMENTS_ENV, "2")
+    ctx = trace.TraceContext("aa" * 16)
+    path = trace.span_log_path(span_sink)
+    _spam(ctx, 200)
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")  # count bound enforced
+    # Byte budget below one segment: only .1 survives the next roll.
+    monkeypatch.setenv(trace.TRACE_RETAIN_MB_ENV, str(0.5 / 1024))
+    _spam(ctx, 40)
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2"), "byte budget not enforced"
+
+
+def test_tail_sampling_verdicts(span_sink, monkeypatch):
+    """Error and slow traces always persist; fast ones drop at
+    sample=0 — and a straggler span arriving after the drop verdict is
+    suppressed, not resurrected as an orphan."""
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    monkeypatch.setenv(trace.TRACE_TAIL_SLOW_MS_ENV, "100")
+    trace.reset_tail_for_tests()
+    try:
+        # Error outcome: buffered spans flush.
+        err = trace.start_trace(None)
+        assert err is not None and err.tail
+        trace.record_event("edge", "svc", [err], 1.0, 0.01, child=False)
+        assert trace.collect_trace(span_sink,
+                                   err.trace_id)["n_spans"] == 0
+        trace.complete(err, 0.01, error=True)
+        assert trace.collect_trace(span_sink,
+                                   err.trace_id)["n_spans"] == 1
+        # Slow outcome: kept despite sample=0.
+        slow = trace.start_trace(None)
+        trace.record_event("edge", "svc", [slow], 1.0, 0.2, child=False)
+        trace.complete(slow, 0.2, error=False)
+        assert trace.collect_trace(span_sink,
+                                   slow.trace_id)["n_spans"] == 1
+        # Fast + ok at sample 0: dropped, late spans suppressed.
+        fast = trace.start_trace(None)
+        trace.record_event("edge", "svc", [fast], 1.0, 0.001,
+                           child=False)
+        trace.complete(fast, 0.001, error=False)
+        assert trace.collect_trace(span_sink,
+                                   fast.trace_id)["n_spans"] == 0
+        trace.record_event("late.worker", "w", [fast], 1.1, 0.001)
+        assert trace.collect_trace(span_sink,
+                                   fast.trace_id)["n_spans"] == 0
+        # An honored X-Trace-Id bypasses tail sampling entirely.
+        honored = trace.start_trace("ff" * 16)
+        assert honored is not None and not honored.tail
+        trace.record_event("edge", "svc", [honored], 1.0, 0.001,
+                           child=False)
+        assert trace.collect_trace(span_sink,
+                                   "ff" * 16)["n_spans"] == 1
+    finally:
+        trace.reset_tail_for_tests()
+
+
+def test_tail_sampling_seeded_rate(span_sink, monkeypatch):
+    """Fast traces keep at exactly the seeded RNG's decision sequence
+    for the configured rate — 100% of error/slow traces survive a
+    seeded mixed workload while fast ones sample (the r17 acceptance
+    shape)."""
+    import random as _random
+
+    rate = 0.3
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, str(rate))
+    monkeypatch.setenv(trace.TRACE_TAIL_SLOW_MS_ENV, "50")
+    trace.reset_tail_for_tests()
+    trace.seed_tail(42)
+    try:
+        kept_fast = 0
+        n_fast = 0
+        rng = _random.Random(42)  # mirror of the module's seeded rng
+        expected_kept = 0
+        for i in range(60):
+            ctx = trace.start_trace(None)
+            assert ctx is not None
+            trace.record_event("edge", "svc", [ctx], 1.0, 0.001,
+                               child=False)
+            if i % 5 == 0:   # error: must survive
+                trace.complete(ctx, 0.001, error=True)
+                assert trace.collect_trace(
+                    span_sink, ctx.trace_id)["n_spans"] == 1
+            elif i % 5 == 1:  # slow: must survive
+                trace.complete(ctx, 0.5, error=False)
+                assert trace.collect_trace(
+                    span_sink, ctx.trace_id)["n_spans"] == 1
+            else:            # fast: seeded coin
+                n_fast += 1
+                if rng.random() < rate:
+                    expected_kept += 1
+                trace.complete(ctx, 0.001, error=False)
+                kept_fast += trace.collect_trace(
+                    span_sink, ctx.trace_id)["n_spans"]
+        assert kept_fast == expected_kept
+        assert 0 < kept_fast < n_fast  # genuinely sampling
+    finally:
+        trace.reset_tail_for_tests()
+
+
+def test_tail_pending_overflow_flushes(span_sink, monkeypatch):
+    """A pending trace overflowing the per-trace span cap (an edge
+    that never completes) flushes to the store — retain on doubt,
+    never silent loss."""
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    trace.reset_tail_for_tests()
+    try:
+        ctx = trace.start_trace(None)
+        for _ in range(trace._PENDING_MAX_SPANS + 5):
+            trace.record_event("s", "svc", [ctx], 1.0, 0.001)
+        out = trace.collect_trace(span_sink, ctx.trace_id)
+        assert out["n_spans"] > trace._PENDING_MAX_SPANS
+        # Completion after the overflow is a no-op (already flushed).
+        trace.complete(ctx, 0.001, error=False)
+        assert trace.collect_trace(span_sink,
+                                   ctx.trace_id)["n_spans"] > 0
+    finally:
+        trace.reset_tail_for_tests()
+
+
+def test_tail_sampling_at_http_edge(span_sink, monkeypatch):
+    """The JsonHttpServer edge delivers the verdict: a 5xx response
+    keeps its trace's spans, a fast 200 at sample=0 drops them."""
+    from rafiki_tpu.utils.service import JsonHttpServer
+
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    monkeypatch.setenv(trace.TRACE_TAIL_SLOW_MS_ENV, "60000")
+    trace.reset_tail_for_tests()
+
+    def ok(params, body, ctx):
+        return 200, {"ok": True}
+
+    def boom(params, body, ctx):
+        raise RuntimeError("kaput")
+
+    server = JsonHttpServer([("GET", "/ok", ok), ("GET", "/boom", boom)],
+                            host="127.0.0.1", name="tail-svc").start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        r_ok = requests.get(base + "/ok", timeout=10)
+        tid_ok = r_ok.headers["X-Trace-Id"].split("-")[0]
+        r_boom = requests.get(base + "/boom", timeout=10)
+        assert r_boom.status_code == 500
+        tid_boom = r_boom.headers["X-Trace-Id"].split("-")[0]
+        assert trace.collect_trace(span_sink, tid_ok)["n_spans"] == 0
+        out = trace.collect_trace(span_sink, tid_boom)
+        assert out["n_spans"] == 1
+        assert out["spans"][0]["attrs"]["status"] == 500
+    finally:
+        server.stop()
+        trace.reset_tail_for_tests()
+
+
 def test_span_log_rotates_at_size_cap(span_sink, monkeypatch):
     """The sink rolls spans.jsonl to one .1 generation at the size cap
     (a client forcing X-Trace-Id must not be able to fill the disk),
