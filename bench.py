@@ -33,6 +33,16 @@ time-to-full-recovery per injure->recover cycle, plus the
 injection-site hot-path A/B (fault plane disabled vs armed-empty).
 Excluded from the sweep: it injures its own stack.
 
+``--config slo``: the SLO plane's alert loop closed end to end
+(docs/observability.md "SLOs & alerting") — chaos-injected worker
+latency (``worker.slow``) drives a latency objective healthy ->
+burning -> firing -> an SLO-triggered autoscale scale-up -> resolved
+after the fault clears, with the alert ring, budget-gauge deltas and
+the OFF side's zero-``rafiki_tpu_slo_*``-series gate recorded.
+Excluded from the sweep: it injures its own stack. Needs >= 2
+devices (the scale-up replica lands on the free chip); on a 1-device
+accelerator box run the CPU mesh via JAX_PLATFORMS=cpu.
+
 The reference publishes no numbers (BASELINE.md): the first recorded run
 of each config on TPU establishes its baseline; the BASELINES table
 below holds those recorded figures per platform channel; update them
@@ -2626,6 +2636,273 @@ def main_autoscale() -> dict:
         off_new_series=off["autoscale_series"])
 
 
+def main_slo() -> dict:
+    """Config[slo]: the SLO plane's judgment + actuation loop, closed
+    (docs/observability.md "SLOs & alerting"). Not a sweep member —
+    like chaos it injures its own stack.
+
+    OFF side FIRST (the zero-series gate): a platform WITHOUT
+    ``RAFIKI_TPU_SLO_RULES`` serves real traffic and runs a supervise
+    sweep — asserted to hold no engine, restart nothing, and expose
+    ZERO ``rafiki_tpu_slo_*`` series (the process registry cannot have
+    been fed by the later ON side).
+
+    ON side: a 1-bin trained ensemble on a 2-chip node with a
+    ``p95<250ms`` latency objective (fast/slow burn windows 2 s / 4 s,
+    burn threshold 2, for 0.5 s, resolve 3 s) and the autoscaler armed
+    with its QUEUE thresholds made untriggerable — a scale-up can only
+    come from SLO pressure. Supervise sweeps are driven manually so
+    the phase boundaries are deterministic: healthy ticks (state ok,
+    budget untouched), then ``worker.slow:p=1,ms=600`` makes every
+    burst breach -> pending -> firing (the alert ring carries the
+    transitions; the budget gauge drops), the firing alert drives
+    >= 1 ``scale_up:slo_firing`` autoscale action onto the free chip,
+    then the plan clears and the fast window's recovery resolves the
+    alert. Judged on the ring + counters, not throughput.
+    """
+    import tempfile
+
+    import requests
+
+    from rafiki_tpu import faults
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.config import NodeConfig
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.observe.metrics import registry
+    from rafiki_tpu.platform import LocalPlatform
+
+    slo_families = ("rafiki_tpu_slo_budget_remaining_ratio",
+                    "rafiki_tpu_slo_burn_rate",
+                    "rafiki_tpu_slo_alerts_total")
+
+    def slo_series_count() -> int:
+        return sum(len(m.samples()) for m in
+                   (registry().find(n) for n in slo_families)
+                   if m is not None)
+
+    rules = ("predict-p95:p95<250ms,window=60,fast=2,slow=4,burn=2,"
+             "for=0.5,resolve=3")
+    on_env = {
+        NodeConfig.env_name("slo_rules"): rules,
+        "RAFIKI_TPU_AUTOSCALE": "1",
+        # Queue thresholds untriggerable: the ONLY scale-up pressure
+        # left is the firing SLO (reason slo_firing, asserted below).
+        NodeConfig.env_name("autoscale_queue_high"): "1.0",
+        NodeConfig.env_name("autoscale_queue_low"): "0.0",
+        NodeConfig.env_name("autoscale_up_cooldown_s"): "1.0",
+        NodeConfig.env_name("autoscale_down_cooldown_s"): "3600",
+        NodeConfig.env_name("autoscale_mfu_floor"): "0",
+        NodeConfig.env_name("autoscale_max_replicas"): "2",
+    }
+
+    def build_stack(plat):
+        admin = plat.admin
+        u = admin.create_user("slo@x.c", "pw",
+                              UserType.MODEL_DEVELOPER)
+        mdl = admin.create_model(
+            u["id"], "ff-slo", TaskType.IMAGE_CLASSIFICATION,
+            "rafiki_tpu.models.feedforward:JaxFeedForward")
+        job = admin.create_train_job(
+            u["id"], "slo", TaskType.IMAGE_CLASSIFICATION,
+            [mdl["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 2},
+            build_stack.train_path, build_stack.val_path)
+        assert admin.wait_until_train_job_done(job["id"], timeout=1200)
+        inf = admin.create_inference_job(u["id"], job["id"],
+                                         max_models=1)
+        cache = Cache(plat.bus)
+        deadline = time.time() + 600
+        while not cache.running_workers(inf["id"]) and \
+                time.time() < deadline:
+            time.sleep(0.5)
+        assert cache.running_workers(inf["id"])
+        host = plat.admin.get_inference_job(inf["id"])["predictor_host"]
+        val = load_image_dataset(build_stack.val_path)
+        batch = [encode_payload(val.images[i]) for i in range(4)]
+        return inf, f"http://{host}/predict", batch
+
+    def tick(url, batch, plat, n_posts=3):
+        for _ in range(n_posts):
+            requests.post(url, json={"queries": batch},
+                          timeout=300).raise_for_status()
+        plat.services.supervise()
+
+    record: dict = {}
+    prior = {k: os.environ.get(k) for k in on_env}
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            build_stack.train_path, build_stack.val_path = \
+                make_synthetic_image_dataset_compat(tmp, n_train=2048,
+                                                    n_val=256)
+            # --- OFF side (runs FIRST: the zero-series gate) ---------
+            for k in on_env:
+                os.environ.pop(k, None)
+            plat = LocalPlatform(workdir=f"{tmp}/off", http=True,
+                                 supervise_interval=0, n_chips=2)
+            try:
+                inf, url, batch = build_stack(plat)
+                tick(url, batch, plat)
+                assert plat.slo_engine is None
+                assert plat.services.slo_engine is None
+                assert plat.services.supervise() == []
+                record["off_slo_series"] = slo_series_count()
+                assert record["off_slo_series"] == 0
+                plat.admin.stop_inference_job(inf["id"])
+            finally:
+                plat.shutdown()
+
+            # --- ON side ---------------------------------------------
+            os.environ.update(on_env)
+            # Fault hooks resolve at CONSTRUCTION (r11): the stack must
+            # build with the plane armed-quiet so the mid-run set_plan
+            # swap can actually injure the live workers.
+            faults.set_plan("")
+            plat = LocalPlatform(workdir=f"{tmp}/on", http=True,
+                                 supervise_interval=0, n_chips=2)
+            try:
+                assert plat.slo_engine is not None
+                eng = plat.slo_engine
+                inf, url, batch = build_stack(plat)
+
+                def inst_state() -> str:
+                    snap = eng.snapshot()["objectives"][0]
+                    insts = snap["instances"]
+                    return insts[0]["state"] if insts else "no-data"
+
+                def budget() -> float:
+                    snap = eng.snapshot()["objectives"][0]
+                    insts = snap["instances"]
+                    return insts[0]["budget_remaining"] if insts \
+                        else 1.0
+
+                # Healthy phase: basis + clean sweeps. The FIRST
+                # served request's cold-start latency can legitimately
+                # breach the objective (that is the plane working, not
+                # a bug) — keep serving fast traffic until the
+                # instance settles ok (the fast window ages the blip
+                # out) instead of asserting the very first reading.
+                deadline = time.monotonic() + 90
+                while True:
+                    tick(url, batch, plat)
+                    if inst_state() == "ok" and eng.epoch > 3:
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"SLO never settled healthy: "
+                            f"{eng.snapshot()}")
+                    time.sleep(0.2)
+                record["budget_healthy"] = budget()
+
+                # Injury: every worker dispatch sleeps 600 ms — every
+                # /predict breaches the 250 ms threshold.
+                faults.set_plan("worker.slow:p=1,ms=600")
+                t_injured = time.monotonic()
+                deadline = time.monotonic() + 90
+                while inst_state() != "firing":
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"SLO never fired: {eng.snapshot()}")
+                    tick(url, batch, plat)
+                    time.sleep(0.1)
+                record["time_to_fire_s"] = round(
+                    time.monotonic() - t_injured, 2)
+                record["budget_firing"] = budget()
+                # <= not <: a cold-start breach inside the 60 s budget
+                # window may have floored the healthy-phase gauge to 0
+                # already (the state machine, not the floor-clamped
+                # gauge, is the healthy/firing evidence).
+                assert record["budget_firing"] <= \
+                    record["budget_healthy"]
+
+                # The firing alert is scale-up pressure: keep sweeping
+                # until the autoscaler acts (reason slo_firing; the
+                # free second chip absorbs the replica).
+                deadline = time.monotonic() + 60
+
+                def slo_scale_ups() -> int:
+                    c = registry().find(
+                        "rafiki_tpu_autoscale_actions_total")
+                    return int(c.value(action="scale_up",
+                                       reason="slo_firing")) \
+                        if c is not None else 0
+
+                while slo_scale_ups() < 1:
+                    if time.monotonic() > deadline:
+                        snap = plat.admin.get_autoscale()
+                        raise RuntimeError(
+                            f"no SLO-triggered scale-up: {snap}")
+                    tick(url, batch, plat)
+                    time.sleep(0.1)
+                record["slo_scale_up_actions"] = slo_scale_ups()
+                record["replicas_after_scale_up"] = len(
+                    plat.services.active_inference_workers(inf["id"]))
+                # The action must have ACTUATED — a launched replica
+                # that immediately dies (e.g. a chip index past the
+                # real device count: on CPU run with
+                # XLA_FLAGS=--xla_force_host_platform_device_count=8,
+                # like multitenant) would make this evidence hollow.
+                assert record["replicas_after_scale_up"] >= 2, record
+                record["autoscale_decisions"] = [
+                    {k: d.get(k) for k in
+                     ("epoch", "action", "reason", "bin", "target",
+                      "applied", "error", "service_id")
+                     if k in d}
+                    for d in plat.admin.get_autoscale()["decisions"]
+                    [:8]]
+
+                # Recovery: clear the plan; the fast window drains and
+                # the alert resolves after resolve_s of quiet.
+                faults.set_plan(None)
+                t_cleared = time.monotonic()
+                deadline = time.monotonic() + 90
+                while inst_state() != "ok":
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"SLO never resolved: {eng.snapshot()}")
+                    tick(url, batch, plat)
+                    time.sleep(0.2)
+                record["time_to_resolve_s"] = round(
+                    time.monotonic() - t_cleared, 2)
+                record["budget_resolved"] = budget()
+
+                alerts = plat.admin.get_alerts()["alerts"]
+                record["alert_ring"] = [
+                    {k: a.get(k) for k in
+                     ("transition", "burn_fast", "burn_slow",
+                      "budget_remaining")}
+                    for a in alerts[::-1]]  # oldest first
+                transitions = [a["transition"] for a in alerts[::-1]]
+                assert "firing" in transitions and \
+                    "resolved" in transitions, transitions
+                c = registry().find("rafiki_tpu_slo_alerts_total")
+                record["alerts_total"] = {
+                    lab["state"]: int(v) for lab, v in c.samples()}
+                plat.admin.stop_inference_job(inf["id"])
+            finally:
+                plat.shutdown()
+    finally:
+        faults.set_plan(None)
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    return _emit(
+        "slo_time_to_fire_s", record["time_to_fire_s"], "seconds",
+        rules=rules,
+        time_to_resolve_s=record["time_to_resolve_s"],
+        budget_healthy=record["budget_healthy"],
+        budget_firing=record["budget_firing"],
+        budget_resolved=record["budget_resolved"],
+        slo_scale_up_actions=record["slo_scale_up_actions"],
+        replicas_after_scale_up=record["replicas_after_scale_up"],
+        autoscale_decisions=record.get("autoscale_decisions", []),
+        alerts_total=record["alerts_total"],
+        alert_ring=record["alert_ring"],
+        off_slo_series=record["off_slo_series"])
+
+
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
                                         image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
@@ -2664,6 +2941,10 @@ _CONFIGS = {
     # capacity); judged on counter deltas, not a throughput figure.
     "autoscale": (main_autoscale, "autoscale_backpressure_avoided",
                   "rejections"),
+    # Not in _SWEEP_ORDER: the SLO config chaos-injures its own stack
+    # to drive a latency objective healthy -> firing -> resolved;
+    # judged on the alert ring + the SLO-triggered autoscale action.
+    "slo": (main_slo, "slo_time_to_fire_s", "seconds"),
 }
 
 
@@ -2802,12 +3083,17 @@ def _main_cli() -> None:
         # workers at exclusive placement = ZERO free chips, so the
         # FIRST starved scale-up preempts the idle donor (the judged
         # causal chain, with minimal mid-ramp compile churn).
+        # slo needs the 2-chip node's SECOND chip actually backed by a
+        # device: the SLO-triggered scale-up's replica lands there, and
+        # on a 1-device box its mesh build would die on a chip index
+        # past the real device count (hollow evidence).
         ensure_platform(n_virtual_devices=(
             args.devices if args.devices
             else (4 if _WORKLOAD else 2)
             if args.config == "serving-concurrent"
             else 3 if args.config == "chaos"
-            else 4 if args.config == "autoscale" else None))
+            else 4 if args.config == "autoscale"
+            else 2 if args.config == "slo" else None))
         import jax
 
         platform = jax.default_backend()

@@ -833,6 +833,24 @@ class Admin:
             return {"enabled": False}
         return scaler.snapshot()
 
+    def get_slo(self) -> Dict[str, Any]:
+        """The SLO engine's objective/instance snapshot (the
+        ``GET /slo`` body; docs/observability.md "SLOs & alerting").
+        Disabled nodes answer ``enabled: false`` — the dashboard
+        renders the panel only when the plane is armed."""
+        engine = getattr(self.services, "slo_engine", None)
+        if engine is None:
+            return {"enabled": False}
+        return engine.snapshot()
+
+    def get_alerts(self) -> Dict[str, Any]:
+        """The SLO engine's alert-transition ring (``GET /alerts``),
+        newest first; ``enabled: false`` on unarmed nodes."""
+        engine = getattr(self.services, "slo_engine", None)
+        if engine is None:
+            return {"enabled": False}
+        return engine.alerts_snapshot()
+
     def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
         return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
 

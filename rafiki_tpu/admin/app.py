@@ -33,6 +33,14 @@ route-compatible so reference quickstart scripts port 1:1:
                                      replica targets (``enabled: false``
                                      on nodes without the control loop;
                                      see docs/autoscaling.md)
+- ``GET  /slo``                      SLO objectives with live burn
+                                     rates / error budgets per instance
+                                     (``enabled: false`` when no
+                                     ``RAFIKI_TPU_SLO_RULES``; see
+                                     docs/observability.md)
+- ``GET  /alerts``                   burn-rate alert transition ring
+                                     (newest first) + currently firing
+                                     objectives
 - ``GET  /trial_phases``             trial-lifecycle phase breakdown +
                                      residency-cache counters (resident
                                      workers only; see docs/training.md)
@@ -92,6 +100,8 @@ class AdminApp:
             ("GET", "/status", self._status),
             ("GET", "/trial_phases", self._trial_phases),
             ("GET", "/autoscale", self._autoscale),
+            ("GET", "/slo", self._slo),
+            ("GET", "/alerts", self._alerts),
             ("POST", "/datasets", self._create_dataset),
             ("GET", "/datasets", self._list_datasets),
             ("GET", "/services", self._list_services),
@@ -252,6 +262,14 @@ class AdminApp:
     def _autoscale(self, params, body, ctx):
         self._auth(ctx)
         return 200, self.admin.get_autoscale()
+
+    def _slo(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_slo()
+
+    def _alerts(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_alerts()
 
     def _create_dataset(self, params, body, ctx):
         claims = self._auth(ctx, *_WRITE_TYPES)

@@ -110,6 +110,12 @@ class JobSignals:
     # attribution ledger — pre-r17 workers / attribution off — the
     # per-job fallback). Keyed by the ledger's truncated bin label.
     bins: Optional[Dict[str, BinSignals]] = None
+    # A FIRING latency-SLO alert for this job (admin/slo_engine.py):
+    # None = none firing; "" = job/tenant-scoped alert (any bin may
+    # take the capacity); a bin label = the violating bin, which the
+    # scale-up targets first. Prioritized over every queue signal —
+    # "scale to the SLO, not the queue" (docs/autoscaling.md).
+    slo_firing: Optional[str] = None
 
     @property
     def queue_frac(self) -> float:
@@ -174,6 +180,11 @@ class AutoscalePolicy:
     def classify(self, sig: JobSignals) -> Tuple[str, str]:
         """``(regime, reason)``: regime is "up", "down" or "hold"."""
         k = self.knobs
+        if sig.slo_firing is not None:
+            # A firing latency SLO outranks every queue signal: the
+            # queue can read idle while tail latency burns the error
+            # budget (slow replicas drain a short queue slowly).
+            return "up", "slo_firing"
         if sig.backpressure_delta > 0:
             return "up", "backpressure"
         if sig.queue_frac >= k.queue_high:
@@ -224,6 +235,12 @@ class AutoscalePolicy:
                 # fewest-replicas-first, bin id as the deterministic
                 # tie break.
                 order = sorted(replicas, key=lambda b: (replicas[b], b))
+            if reason == "slo_firing" and sig.slo_firing:
+                # A bin-scoped alert names its victim: the violating
+                # bin takes the capacity first (stable sort keeps the
+                # load/replica order among the rest).
+                order.sort(key=lambda b: 0 if str(b)[:12]
+                           == sig.slo_firing else 1)
             budget = k.step
             for b in order:
                 if budget == 0:
@@ -346,10 +363,14 @@ class Autoscaler:
 
     # --- The sweep -----------------------------------------------------
 
-    def sweep(self) -> List[Dict[str, Any]]:
+    def sweep(self, scrapes=None) -> List[Dict[str, Any]]:
         """One control pass; returns the decisions recorded (actuated
         or dry-run). Runs on the supervise thread — everything here is
-        best-effort and must not raise into the sweep."""
+        best-effort and must not raise into the sweep. ``scrapes`` is
+        the sweep-shared :class:`~rafiki_tpu.admin.scrape.ScrapeCache`
+        when the supervise pass runs several metric consumers (the SLO
+        engine scraped the same endpoints moments ago); None fetches
+        directly."""
         self.epoch += 1
         now = time.monotonic()
         acted: List[Dict[str, Any]] = []
@@ -358,11 +379,21 @@ class Autoscaler:
         self._prune_departed(live_ids)
         self._track_idle_training()
         any_up = False
+        slo = getattr(self.services, "slo_engine", None)
         for job in jobs:
             state = self._jobs.setdefault(job["id"], JobState())
-            sig = self._signals(job, state, now)
+            # scrapes forwarded only when present: _signals is a test
+            # seam (monkeypatched fakes keep the legacy 3-arg shape).
+            sig = (self._signals(job, state, now) if scrapes is None
+                   else self._signals(job, state, now,
+                                      scrapes=scrapes))
             if sig is None:
                 continue
+            if slo is not None:
+                # The SLO engine swept just before us (same supervise
+                # pass): a firing latency objective is scale-up
+                # pressure for this job, ahead of the queue signals.
+                sig.slo_firing = slo.slo_pressure(job["id"])
             replicas, by_bin = self._replica_counts(job["id"])
             if not replicas:
                 continue
@@ -392,33 +423,29 @@ class Autoscaler:
     # --- Signals -------------------------------------------------------
 
     def _scrape(self, host: str, path: str) -> Any:
-        import json as _json
-        from urllib.request import urlopen
+        from .scrape import fetch_endpoint
 
-        with urlopen(f"http://{host}{path}", timeout=5) as resp:
-            body = resp.read()
-        if path == "/metrics":
-            return body.decode()
-        return _json.loads(body)
+        return fetch_endpoint(host, path)
 
     def _signals(self, job: Dict[str, Any], state: JobState,
-                 now: float) -> Optional[JobSignals]:
+                 now: float, scrapes=None) -> Optional[JobSignals]:
         """Scrape the job's predictor and fold the exposition into
         delta signals. None (skip this job this sweep) when the
         frontend is not reachable yet."""
         host = job.get("predictor_host")
         if not host:
             return None
+        fetch = scrapes.fetch if scrapes is not None else self._scrape
         try:
             if state.labels is None:
-                stats = self._scrape(host, "/stats")
+                stats = fetch(host, "/stats")
                 knobs = stats.get("knobs") or {}
                 state.labels = (stats.get("service") or "",
                                 stats.get("http_service") or "",
                                 float(knobs.get("queue_cap")
                                       or stats.get("queue_cap") or 1.0),
                                 bool(stats.get("microbatch", True)))
-            text = self._scrape(host, "/metrics")
+            text = fetch(host, "/metrics")
         except (OSError, ValueError):
             state.labels = None  # re-resolve after a frontend restart
             return None
@@ -573,6 +600,8 @@ class Autoscaler:
                         "backpressure_delta": sig.backpressure_delta,
                         "p99_ms": sig.p99_ms},
         }
+        if sig.slo_firing is not None:
+            entry["signals"]["slo_firing"] = sig.slo_firing
         if sig.bins:
             entry["signals"]["bins"] = {
                 b: {"qps": round(s.qps, 2),

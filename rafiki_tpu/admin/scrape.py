@@ -1,0 +1,61 @@
+"""Shared predictor-endpoint scraping for the supervise-cadence
+consumers (the autoscaler and the SLO engine).
+
+Both control planes judge each RUNNING inference job from its
+predictor's own ``/stats`` + ``/metrics`` over HTTP. With both armed
+on one node they ride the SAME supervise pass, so fetching (and
+parsing) each endpoint twice per sweep would double the work — and
+double how long an unreachable frontend's timeout can stall the
+supervise thread. ``ServicesManager.supervise`` hands one
+:class:`ScrapeCache` to both sweeps; each endpoint is fetched at most
+once per sweep, failures included (a dead host costs ONE timeout per
+sweep, not one per consumer).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Tuple
+
+
+def fetch_endpoint(host: str, path: str, timeout: float = 5.0) -> Any:
+    """One predictor endpoint fetch: ``/metrics`` returns the raw
+    exposition text, anything else parses as JSON. The ONE fetch
+    implementation both control planes use (a fix applied here cannot
+    silently miss one of them)."""
+    from urllib.request import urlopen
+
+    with urlopen(f"http://{host}{path}", timeout=timeout) as resp:
+        body = resp.read()
+    if path == "/metrics":
+        return body.decode()
+    return json.loads(body)
+
+
+class ScrapeCache:
+    """Per-SWEEP memo over :func:`fetch_endpoint`. Exceptions are
+    memoized too and re-raised to every consumer — each consumer keeps
+    its own skip-this-job-this-sweep semantics, but the blocked socket
+    wait is paid once. Built fresh each supervise pass (staleness
+    within one sweep is the point: both consumers judge the same
+    snapshot); single-threaded by construction — everything runs on
+    the supervise thread."""
+
+    def __init__(self, timeout: float = 5.0):
+        self.timeout = timeout
+        self._memo: Dict[Tuple[str, str], Tuple[bool, Any]] = {}
+
+    def fetch(self, host: str, path: str) -> Any:
+        key = (host, path)
+        hit = self._memo.get(key)
+        if hit is None:
+            try:
+                hit = (True, fetch_endpoint(host, path,
+                                            timeout=self.timeout))
+            except (OSError, ValueError) as e:
+                hit = (False, e)
+            self._memo[key] = hit
+        ok, value = hit
+        if not ok:
+            raise value
+        return value

@@ -107,6 +107,10 @@ class ServicesManager:
         # default) keeps supervise byte-identical: one attribute check,
         # zero new series.
         self.autoscaler = None
+        # SLO engine (admin/slo_engine.py), attached by the platform
+        # ONLY when RAFIKI_TPU_SLO_RULES names objectives — same
+        # disabled-means-free contract as the autoscaler.
+        self.slo_engine = None
 
     # --- Launch plumbing ---
 
@@ -693,12 +697,31 @@ class ServicesManager:
             # future RUNNING scan, so this queue is their only way
             # back into a bin.
             self._pending_respawns.extend(pending)
+        scrapes = None
+        if self.slo_engine is not None or self.autoscaler is not None:
+            # Both metric consumers judge the SAME predictor endpoints
+            # this pass: one sweep-scoped cache means each /stats +
+            # /metrics is fetched (and an unreachable host's timeout
+            # paid) once, not once per consumer.
+            from .scrape import ScrapeCache
+
+            scrapes = ScrapeCache()
+        if self.slo_engine is not None:
+            # The SLO judgment layer rides the supervise cadence,
+            # BEFORE the autoscaler so a same-sweep firing alert is
+            # visible as scale-up pressure (docs/observability.md).
+            # Isolated like the autoscaler: an evaluation failure must
+            # not break dead-service recovery.
+            try:
+                self.slo_engine.sweep(scrapes=scrapes)
+            except Exception:
+                _log.exception("slo sweep failed")
         if self.autoscaler is not None:
             # The serving control loop rides the supervise cadence
             # (docs/autoscaling.md). Isolated: a scrape/actuation
             # failure must not break dead-service recovery.
             try:
-                self.autoscaler.sweep()
+                self.autoscaler.sweep(scrapes=scrapes)
             except Exception:
                 _log.exception("autoscale sweep failed")
         return restarted

@@ -209,6 +209,18 @@ CATALOG: Dict[str, Dict[str, str]] = {
                "node validated.",
         "hint": "Export it in apply_env() like the other tunables.",
     },
+    "RTA506": {
+        "title": "SLO plane references unregistered metric",
+        "flags": "A metric name in the SLO consumed-series vocabulary "
+                 "(observe/slo.py, admin/slo_engine.py) or in a "
+                 "docs/slo/ rules file that no code path registers.",
+        "bug": "A renamed source series silently blanks every "
+               "objective that reads it — no data means no burn, "
+               "which reads as 'SLO healthy' during an outage (r19; "
+               "the RTA502 class, pointed at the judgment layer).",
+        "hint": "Fix the consumed-series name / rules file (or "
+                "restore the registered name).",
+    },
     "RTA601": {
         "title": "side effect at import time",
         "flags": "A thread built/started, socket/server bound, "

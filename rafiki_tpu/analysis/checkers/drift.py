@@ -22,6 +22,12 @@ place.
 RTA505 (new): every NodeConfig knob whose env var is read at worker
 construction time is exported by ``apply_env()`` — otherwise spawned
 children resolve different values than the node validated.
+RTA506 (r19): every metric name the SLO plane READS — the consumed-
+series vocabulary in ``observe/slo.py``/``admin/slo_engine.py`` and
+every ``metric`` reference in a committed SLO rules file under
+``docs/slo/`` — is a registered series name (same machinery as the
+RTA502 Grafana check): a renamed source series must break the build,
+not silently blank every objective that reads it.
 
 The name vocabulary (subsystems, units) lives HERE: extending it is a
 deliberate reviewed edit, exactly as it was in the scripts.
@@ -43,12 +49,14 @@ from ..core import Checker, Finding, RepoContext, register
 PREFIX = "rafiki_tpu_"
 
 SUBSYSTEMS = {"bus", "serving", "http", "train", "trial", "trace",
-              "node", "fault", "autoscale", "profile"}
+              "node", "fault", "autoscale", "profile", "slo"}
 
 # _total marks counters (Prometheus convention); everything else is the
-# physical unit of a gauge/histogram.
+# physical unit of a gauge/histogram. "rate" is the SLO plane's burn
+# rate (budget fractions per window-length — dimensionless but not a
+# 0..1 ratio).
 UNITS = {"total", "seconds", "ratio", "bytes", "queries", "batches",
-         "info", "replicas"}
+         "info", "replicas", "rate"}
 
 NAME_RE = re.compile(r"^rafiki_tpu_[a-z0-9]+(?:_[a-z0-9]+)+$")
 
@@ -167,6 +175,34 @@ def _judge_name(rel: str, line: int, kind: str,
     return out
 
 
+def _strip_hist_suffix(name: str, registered: Set[str]) -> str:
+    for suffix in HIST_SUFFIXES:
+        if name.endswith(suffix) and name[:-len(suffix)] in registered:
+            return name[:-len(suffix)]
+    return name
+
+
+def _scan_artifact_tokens(rel: str, text: str, registered: Set[str],
+                          code: str, message_fmt: str,
+                          ) -> List[Finding]:
+    """Every ``rafiki_tpu_*`` token in one committed artifact (Grafana
+    dashboard, SLO rules file) must be a registered series name after
+    the histogram-suffix strip; ``message_fmt`` takes ``{name!r}``."""
+    findings: List[Finding] = []
+    for name in sorted(set(DASH_TOKEN_RE.findall(text))):
+        if _strip_hist_suffix(name, registered) in registered:
+            continue
+        # Boundary-anchored like the extraction above — a plain
+        # find() would land inside a longer token (e.g. the
+        # `_total` form of the same name) on an earlier line.
+        m = re.search(r"\b%s\b" % re.escape(name), text)
+        line = text[:m.start()].count("\n") + 1
+        findings.append(Finding(
+            code=code, path=rel, line=line,
+            message=message_fmt.format(name=name), anchor=name))
+    return findings
+
+
 def check_dashboards(root: str,
                      registered: Set[str]) -> Tuple[List[Finding], int]:
     """Every metric a dashboard references must be a registered name
@@ -190,26 +226,84 @@ def check_dashboards(root: str,
                 code="RTA502", path=rel, line=1,
                 message=f"invalid JSON ({e})", anchor="json"))
             continue
-        for name in sorted(set(DASH_TOKEN_RE.findall(text))):
-            base = name
-            for suffix in HIST_SUFFIXES:
-                if base.endswith(suffix) and \
-                        base[:-len(suffix)] in registered:
-                    base = base[:-len(suffix)]
-                    break
-            if base not in registered:
-                # Boundary-anchored like the extraction above — a plain
-                # find() would land inside a longer token (e.g. the
-                # `_total` form of the same name) on an earlier line.
-                m = re.search(r"\b%s\b" % re.escape(name), text)
-                line = text[:m.start()].count("\n") + 1
-                findings.append(Finding(
-                    code="RTA502", path=rel, line=line,
-                    message=f"references {name!r}, which no code path "
-                            f"registers (renamed metric? update the "
-                            f"dashboard)",
-                    anchor=name))
+        findings.extend(_scan_artifact_tokens(
+            rel, text, registered, "RTA502",
+            "references {name!r}, which no code path registers "
+            "(renamed metric? update the dashboard)"))
     return findings, n_dash
+
+
+# --- RTA506: SLO plane metric references ------------------------------
+
+#: Modules whose rafiki_tpu_* string constants are READS of series the
+#: SLO plane consumes (they also REGISTER their own rafiki_tpu_slo_*
+#: gauges — registration is covered by the RTA501 scan, so those names
+#: are in the registered set and pass trivially).
+SLO_MODULES = ("rafiki_tpu/observe/slo.py",
+               "rafiki_tpu/admin/slo_engine.py")
+
+#: Committed SLO rules files live here (examples + deploy defaults).
+SLO_RULES_DIR = os.path.join("docs", "slo")
+
+
+def check_slo_refs(root: str, registered: Set[str], modules=None,
+                   ) -> List[Finding]:
+    """RTA506: SLO-consumed series names must be registered. Two
+    sources: (1) full-shape metric-name string constants inside the
+    SLO modules (the CONSUMED_SERIES vocabulary and any literal the
+    engine matches on), (2) every ``rafiki_tpu_*`` token in a rules
+    file under docs/slo/ (the ``metric`` override field included)."""
+    findings: List[Finding] = []
+    by_rel = {rel: (text, tree)
+              for rel, text, tree in _parsed_modules(root, modules)}
+    for rel in SLO_MODULES:
+        if rel not in by_rel:
+            continue
+        text, tree = by_rel[rel]
+        if tree is None:
+            continue
+        seen: Set[str] = set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            name = node.value
+            if not NAME_RE.match(name):
+                continue
+            base = _strip_hist_suffix(name, registered)
+            if base in registered or base in seen:
+                continue
+            seen.add(base)
+            findings.append(Finding(
+                code="RTA506", path=rel, line=node.lineno,
+                message=f"SLO plane consumes {name!r}, which no code "
+                        f"path registers (renamed source series? "
+                        f"update the SLO vocabulary)",
+                hint="fix the name in CONSUMED_SERIES / the engine, "
+                     "or register the series it expects",
+                anchor=name))
+    rules_dir = os.path.join(root, SLO_RULES_DIR)
+    if os.path.isdir(rules_dir):
+        for fn in sorted(os.listdir(rules_dir)):
+            if not (fn.endswith(".json") or fn.endswith(".toml")):
+                continue
+            rel = f"docs/slo/{fn}"
+            with open(os.path.join(rules_dir, fn),
+                      encoding="utf-8") as f:
+                text = f.read()
+            if fn.endswith(".json"):
+                try:
+                    json.loads(text)
+                except json.JSONDecodeError as e:
+                    findings.append(Finding(
+                        code="RTA506", path=rel, line=1,
+                        message=f"invalid JSON ({e})", anchor="json"))
+                    continue
+            findings.extend(_scan_artifact_tokens(
+                rel, text, registered, "RTA506",
+                "SLO rules reference {name!r}, which no code path "
+                "registers (renamed metric? update the rules file)"))
+    return findings
 
 
 # --- RTA503: knob docs ------------------------------------------------
@@ -435,16 +529,19 @@ def _apply_env_exports(root: str) -> Optional[Dict[str, int]]:
 @register
 class DriftChecker(Checker):
     name = "drift"
-    codes = ("RTA501", "RTA502", "RTA503", "RTA504", "RTA505")
+    codes = ("RTA501", "RTA502", "RTA503", "RTA504", "RTA505",
+             "RTA506")
     scope = "repo"
     triggers = ("rafiki_tpu/*", "rafiki_tpu/*/*", "rafiki_tpu/*/*/*",
-                "docs/grafana/*", "docs/ops.md")
+                "docs/grafana/*", "docs/slo/*", "docs/ops.md")
 
     def run(self, ctx: RepoContext) -> List[Finding]:
         findings, registered, _ = check_metric_names(
             ctx.root, modules=ctx.modules)
         dash, _ = check_dashboards(ctx.root, registered)
         findings.extend(dash)
+        findings.extend(check_slo_refs(ctx.root, registered,
+                                       modules=ctx.modules))
         try:
             knob_findings, _ = check_knob_docs(ctx.root)
             findings.extend(knob_findings)

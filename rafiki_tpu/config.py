@@ -270,6 +270,23 @@ class NodeConfig:
     # serving_tenant_* series; the autoscaler consumes the per-bin
     # signals when a scraped frontend exposes them.
     serving_attribution: bool = False
+    # --- SLO plane (docs/observability.md "SLOs & alerting") ---
+    # Declarative objectives + multi-window burn-rate alerting over
+    # the serving metrics (observe/slo.py): a path to a JSON/TOML
+    # rules file (value ends .json/.toml) or the compact inline
+    # grammar ("name:p99<50ms,window=300,...;..."). "" (the default)
+    # disables the whole plane — supervise pays one attribute check
+    # and a scrape shows ZERO rafiki_tpu_slo_* series.
+    slo_rules: str = ""
+    # Optional alert webhook: every alert transition is POSTed as one
+    # JSON object (2 s timeout, best-effort) so an external pager can
+    # attach. "" = off. Transitions always land in the bounded
+    # <logs>/alerts.jsonl sink regardless.
+    slo_webhook_url: str = ""
+    # Size cap (MB) of the JSONL alert log before it rolls to one .1
+    # generation.
+    slo_alert_log_mb: float = 16.0
+
     # Metrics-only HTTP server for subprocess/docker worker runners
     # (they have no HTTP surface of their own). 0 = off; spawned
     # children inherit it via apply_env only when set.
@@ -479,6 +496,21 @@ class NodeConfig:
                              "(1.0 disables tail sampling)")
         if self.trace_tail_slow_ms < 0:
             raise ValueError("trace_tail_slow_ms must be >= 0")
+        if self.slo_rules.strip():
+            # Parse now: a typo'd objective must fail the node's
+            # construction, not silently judge nothing (the fault-plan
+            # discipline). A file source must exist and parse here too.
+            from .observe.slo import parse_rules
+
+            parse_rules(self.slo_rules)
+        if self.slo_webhook_url and not (
+                self.slo_webhook_url.startswith("http://")
+                or self.slo_webhook_url.startswith("https://")):
+            raise ValueError(
+                f"slo_webhook_url {self.slo_webhook_url!r} must be an "
+                f"http(s) URL")
+        if self.slo_alert_log_mb <= 0:
+            raise ValueError("slo_alert_log_mb must be positive")
         if not (0 <= self.metrics_port <= 65535):
             raise ValueError(f"metrics_port {self.metrics_port} out of "
                              f"range (0 = no standalone server)")
@@ -648,6 +680,21 @@ class NodeConfig:
             os.environ[self.env_name("serving_attribution")] = "1"
         else:
             os.environ.pop(self.env_name("serving_attribution"), None)
+        # SLO plane: the platform constructs the engine from these at
+        # startup (admin/slo_engine.py SloEngine.from_env); rules and
+        # webhook pop when empty so "absent = disabled" stays the
+        # contract for hand-launched children.
+        if self.slo_rules.strip():
+            os.environ[self.env_name("slo_rules")] = self.slo_rules
+        else:
+            os.environ.pop(self.env_name("slo_rules"), None)
+        if self.slo_webhook_url:
+            os.environ[self.env_name("slo_webhook_url")] = \
+                self.slo_webhook_url
+        else:
+            os.environ.pop(self.env_name("slo_webhook_url"), None)
+        os.environ[self.env_name("slo_alert_log_mb")] = \
+            str(self.slo_alert_log_mb)
         # 0 = "no standalone metrics server": exporting "0" would make
         # worker runners bind port 0 (a random free port) — pop instead,
         # mirroring serving_client_header's absent-means-off contract.

@@ -38,7 +38,11 @@ Tenant rollup (bounded cardinality):
   malformed hammer cannot inflate a tenant's count or churn the LRU);
 - ``rafiki_tpu_serving_tenant_device_seconds_total{tenant}`` — device
   time prorated over the tenant mix a burst's frames carried (the
-  ``_tenant`` bus-envelope carry, injected next to ``_trace``).
+  ``_tenant`` bus-envelope carry, injected next to ``_trace``);
+- ``rafiki_tpu_serving_tenant_request_seconds{tenant}`` — per-request
+  serving latency histogram at the frontend (SERVED requests only) —
+  the tenant-scoped latency source the SLO plane's per-tenant p99
+  objectives read (observe/slo.py).
 
 The ``tenant`` label is ``blake2b(client_key)[:12]`` — bounded length,
 no raw client identifiers in the exposition — and the live tenant set
@@ -89,6 +93,13 @@ _lock = threading.Lock()
 _state: Optional[Tuple] = None  # dict-of-metrics | (None,) sentinel
 _owners = 0
 _tenants: "collections.OrderedDict[str, None]" = collections.OrderedDict()
+
+
+def active() -> bool:
+    """Whether the ledger is resolved ON in this process (families
+    registered). Cheap enough for per-batch checks; resolves the env
+    on first call like every account site."""
+    return _families() is not None
 
 
 def enabled(raw: Optional[str] = None) -> bool:
@@ -166,6 +177,14 @@ def _families() -> Optional[Dict[str, Any]]:
                             "rafiki_tpu_serving_tenant_device_seconds_total",
                             "Device seconds prorated over the tenant "
                             "mix the bursts carried"),
+                        "tenant_latency": r.histogram(
+                            "rafiki_tpu_serving_tenant_request_seconds",
+                            "Per-request serving latency per hashed "
+                            "client key + frontend service label (the "
+                            "SLO plane's tenant-scoped latency "
+                            "source; tenant LRU cap/lifecycle shared "
+                            "with the rollup counters, service slice "
+                            "dropped on frontend stop)"),
                     }
                     s = (fams,)
                 else:
@@ -212,14 +231,17 @@ def close_owner() -> None:
     if last:
         fams["tenant_requests"].remove()
         fams["tenant_device"].remove()
+        fams["tenant_latency"].remove()
 
 
 def close_service(service: str) -> None:
-    """Drop one frontend's ``service``-labeled ledger series."""
+    """Drop one frontend's ``service``-labeled ledger series (the
+    tenant latency histogram's slice included)."""
     fams = _families()
     if fams is None:
         return
-    for key in ("bin_queries", "bin_queue", "bin_rejected"):
+    for key in ("bin_queries", "bin_queue", "bin_rejected",
+                "tenant_latency"):
         fams[key].remove(service=service)
     close_owner()
 
@@ -266,6 +288,7 @@ def _touch_tenant(fams: Dict[str, Any], tenant: str) -> None:
     if evicted is not None:
         fams["tenant_requests"].remove(tenant=evicted)
         fams["tenant_device"].remove(tenant=evicted)
+        fams["tenant_latency"].remove(tenant=evicted)
 
 
 # --- Frontend accounting ----------------------------------------------
@@ -277,6 +300,28 @@ def account_admitted(tenant: Optional[str], n_requests: int = 1) -> None:
     tenant = _clamp(tenant)
     _touch_tenant(fams, tenant)
     fams["tenant_requests"].inc(n_requests, tenant=tenant)
+
+
+def account_tenant_latency(tenant: Optional[str], seconds: float,
+                           service: str = "") -> None:
+    """One SERVED request's end-to-end latency under its tenant label
+    (frontend side — the r17 carry "tenant-labeled p99 SLO tracking"
+    closed: a tenant-scoped latency objective reads this histogram's
+    bucket deltas). Unlike the process-global tenant rollup counters,
+    this histogram ALSO carries the frontend's ``service`` label: two
+    jobs' frontends sharing one process registry must not fold each
+    other's tenant latency into their own SLO instances (the engine
+    filters on it). Same LRU admission as the rollup counters, so a
+    rotating-key client cannot grow the registry; ``close_service``
+    drops the frontend's slice like every other service-labeled
+    family."""
+    fams = _families()
+    if fams is None or not tenant or seconds < 0:
+        return
+    tenant = _clamp(tenant)
+    _touch_tenant(fams, tenant)
+    fams["tenant_latency"].observe(seconds, tenant=tenant,
+                                   service=service)
 
 
 def account_rejected(service: str, reason: str) -> None:

@@ -77,6 +77,21 @@ MAX_ENVELOPE_TRACES = 32
 
 SPAN_FILE = "spans.jsonl"
 INDEX_SUFFIX = ".idx"
+#: Tail-verdict sidecar (shared log dir, O_APPEND like the span file):
+#: the minting edge appends one ``{"t": trace_id, "v": kept|dropped}``
+#: line per completed tail trace, so OTHER processes (subprocess
+#: workers) can honor the verdict instead of writing orphan spans.
+VERDICT_FILE = "trace_verdicts.jsonl"
+
+#: Remote tail hold: spans of a tail-pending trace minted in ANOTHER
+#: process are buffered this long waiting for its verdict line; no
+#: verdict by then = retained (retain-on-doubt, never silently drop).
+_REMOTE_HOLD_S = 5.0
+_REMOTE_MAX_TRACES = 512
+#: Verdict map memory bound (FIFO): verdicts only matter for the hold
+#: window, so old entries age out.
+_VERDICT_REMEMBER = 8192
+_VERDICT_MAX_BYTES = 4 * 1024 * 1024
 
 #: Tail-sampling buffer bounds: a pending trace whose edge never
 #: completes (crashed handler, client that holds the socket forever)
@@ -226,12 +241,27 @@ def start_trace(header: Optional[str] = None) -> Optional[TraceContext]:
 def inject(ctxs: Iterable[Optional[TraceContext]]) -> Optional[Dict]:
     """Envelope field for a bus frame carrying these requests' traces,
     or None when nothing is traced (the frame then looks exactly like
-    an old frame)."""
-    ids = [[c.trace_id, c.span_id] for c in ctxs
-           if c is not None][:MAX_ENVELOPE_TRACES]
+    an old frame). Tail-pending contexts are marked by INDEX in a
+    separate ``tail`` key — old consumers read only ``ids`` (changing
+    the id pair shape would break their unpack and degrade every
+    trace), new ones buffer those traces' spans until the edge's
+    verdict arrives (see the module docstring)."""
+    ids = []
+    tail = []
+    for c in ctxs:
+        if c is None:
+            continue
+        if len(ids) >= MAX_ENVELOPE_TRACES:
+            break
+        if c.tail:
+            tail.append(len(ids))
+        ids.append([c.trace_id, c.span_id])
     if not ids:
         return None
-    return {"ids": ids}
+    env: Dict[str, Any] = {"ids": ids}
+    if tail:
+        env["tail"] = tail
+    return env
 
 
 def extract(frame: Any) -> List[TraceContext]:
@@ -251,6 +281,12 @@ def extract(frame: Any) -> List[TraceContext]:
     try:
         for tid, sid in env.get("ids", []):
             out.append(TraceContext(str(tid), span_id=str(sid)))
+        for i in env.get("tail") or ():
+            # The tail marks survive the bus hop so a consumer in
+            # ANOTHER process can hold these traces' spans for the
+            # edge's retain/drop verdict instead of writing orphans.
+            if isinstance(i, int) and 0 <= i < len(out):
+                out[i].tail = True
     except (TypeError, ValueError):
         return []
     return out
@@ -283,6 +319,18 @@ _tail_pending: "Dict[str, List[str]]" = {}
 _tail_dropped: "Dict[str, None]" = {}
 _tail_rng = random.Random()
 
+# Cross-process tail verdicts: spans of tail-pending traces minted in
+# ANOTHER process (the bus envelope's tail marks) hold here —
+# ``tid -> [deadline, [lines]]``, insertion-ordered — until the
+# minting edge's verdict line lands in the verdict sidecar, the hold
+# expires (retain-on-doubt), or the buffer overflows (flush, never
+# drop). The verdict map is the sidecar's incremental read, bounded
+# FIFO.
+_remote_pending: "Dict[str, List[Any]]" = {}
+_verdict_sink = None              # this process's verdict appender
+_verdict_reader: List[Any] = [0, None]   # [bytes read, file identity]
+_verdicts: "Dict[str, str]" = {}
+
 # Incremental scan cache for the ACTIVE segment: path -> [bytes
 # scanned, {trace_id: [line offsets]}]. Lookups only ever read the
 # tail appended since the previous lookup.
@@ -301,8 +349,9 @@ def configure(log_dir: Optional[str]) -> None:
     services configure from their ``RAFIKI_TPU_LOG_DIR`` env. Any
     tail-pending buffers are flushed to the OLD sink first (retained:
     reconfiguring must not silently eat buffered spans)."""
-    global _sink_path, _sink_file
+    global _sink_path, _sink_file, _verdict_sink
     _tail_flush_all()
+    flush_remote_tail()
     with _sink_lock:
         if _sink_file is not None:
             try:
@@ -310,7 +359,16 @@ def configure(log_dir: Optional[str]) -> None:
             except OSError:
                 pass
             _sink_file = None
+        if _verdict_sink is not None:
+            try:
+                _verdict_sink.close()
+            except OSError:
+                pass
+            _verdict_sink = None
         _sink_path = span_log_path(log_dir) if log_dir else None
+    with _tail_lock:
+        _verdict_reader[:] = [0, None]
+        _verdicts.clear()
 
 
 def configured() -> bool:
@@ -349,7 +407,7 @@ def _store_counter():
     return metrics.registry().counter(
         "rafiki_tpu_trace_store_total",
         "Trace span-store events (event=roll|index_build|index_read|"
-        "tail_scan)")
+        "tail_scan|compact)")
 
 
 def _write_lines(lines: List[str]) -> None:
@@ -392,6 +450,28 @@ def _write_lines(lines: List[str]) -> None:
             _build_index(rolled)
         except OSError:
             pass
+        if tail_sample_rate() is not None:
+            # Idle-time compaction (rolls are rare): rewrite ONE older
+            # frozen segment to only-retained traces — orphan spans of
+            # tail-dropped traces (eager pre-verdict writers, overflow
+            # flushes) stop surviving on disk. The two NEWEST
+            # generations are skipped: .1 because its traces' verdicts
+            # may still be pending, and .2 because a co-writing
+            # PROCESS whose append handle chased the renames may still
+            # be flushing its last burst into it — compaction swaps
+            # the inode (os.replace of a rewrite), and replacing a
+            # segment a laggard writer still holds open would turn the
+            # documented drop-a-few-spans rotation race into losing
+            # every span that writer appends until its own next roll.
+            # By the time a generation shifts to .3 every writer has
+            # re-rolled (frozen segments sit above the size cap, so a
+            # stale handle's very next write triggers its reopen).
+            try:
+                base = rolled[:-2]  # "<dir>/spans.jsonl.1" -> base
+                compact_segments(os.path.dirname(rolled), limit=1,
+                                 exclude={rolled, base + ".2"})
+            except OSError:
+                pass
     if wrote:
         # Counted at WRITE time (outside the sink lock), so a tail-
         # buffered span only counts once its trace's verdict actually
@@ -502,22 +582,34 @@ def index_path(segment_path: str) -> str:
     return segment_path + INDEX_SUFFIX
 
 
-def _build_index(segment_path: str) -> Dict[str, List[int]]:
-    """Scan one FROZEN segment once and persist its sidecar index
-    (``{trace_id: [offsets]}``). The write is atomic (tmp + replace)
-    so a concurrent reader never loads a torn index."""
-    offsets, _pos = _scan_offsets(segment_path)
+def _write_index(segment_path: str, offsets: Dict[str, List[int]],
+                 compacted: bool) -> None:
+    """Persist one sidecar index atomically (tmp + replace) so a
+    concurrent reader never loads a torn index. The segment's byte
+    size is recorded so a reader can detect a STALE index: compaction
+    replaces segment then index as two separate atomic steps, and
+    offsets loaded against the wrong generation must read as
+    missing-index (rebuild), never seek misaligned."""
     tmp = index_path(segment_path) + ".tmp"
     try:
+        size = os.path.getsize(segment_path)
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"v": 1, "traces": offsets}, f,
-                      separators=(",", ":"))
+            json.dump({"v": 1, "compacted": compacted, "size": size,
+                       "traces": offsets}, f, separators=(",", ":"))
         os.replace(tmp, index_path(segment_path))
     except OSError:
         try:
             os.remove(tmp)
         except OSError:
             pass
+
+
+def _build_index(segment_path: str,
+                 compacted: bool = False) -> Dict[str, List[int]]:
+    """Scan one FROZEN segment once and persist its sidecar index
+    (``{trace_id: [offsets]}`` + the ``compacted`` marker)."""
+    offsets, _pos = _scan_offsets(segment_path)
+    _write_index(segment_path, offsets, compacted)
     try:
         _store_counter().inc(event="index_build")
     except Exception:
@@ -525,14 +617,133 @@ def _build_index(segment_path: str) -> Dict[str, List[int]]:
     return offsets
 
 
-def _load_index(segment_path: str) -> Optional[Dict[str, List[int]]]:
+def _load_index_data(segment_path: str) -> Optional[Dict[str, Any]]:
+    """The sidecar index as written (traces + compacted marker), or
+    None when missing/torn — or STALE: an index whose recorded size
+    disagrees with the segment on disk belongs to another generation
+    of the file (a compaction replaced the segment but not yet the
+    index, or vice versa); its offsets must not be seeked."""
     try:
         with open(index_path(segment_path), encoding="utf-8") as f:
             data = json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
-    traces = data.get("traces") if isinstance(data, dict) else None
-    return traces if isinstance(traces, dict) else None
+    if not isinstance(data, dict) or \
+            not isinstance(data.get("traces"), dict):
+        return None
+    size = data.get("size")
+    if size is not None:
+        try:
+            if os.path.getsize(segment_path) != size:
+                return None
+        except OSError:
+            return None
+    return data
+
+
+def segment_compacted(segment_path: str) -> bool:
+    data = _load_index_data(segment_path)
+    return bool(data and data.get("compacted"))
+
+
+def _dropped_verdict_ids() -> set:
+    """Every trace id the verdict sidecar (active + rolled generation)
+    records as dropped — what compaction removes. A later 'kept' line
+    for the same id wins (a re-used header id must never be erased)."""
+    path = _verdict_path()
+    out: Dict[str, str] = {}
+    if path is None:
+        return set()
+    for p in (path + ".1", path):
+        try:
+            with open(p, "rb") as f:
+                for raw in f:
+                    if not raw.endswith(b"\n"):
+                        break
+                    try:
+                        rec = json.loads(raw)
+                    except json.JSONDecodeError:
+                        continue
+                    tid, v = rec.get("t"), rec.get("v")
+                    if isinstance(tid, str) and v in ("kept",
+                                                      "dropped"):
+                        out[tid] = v
+        except OSError:
+            continue
+    return {tid for tid, v in out.items() if v == "dropped"}
+
+
+def compact_segment(segment_path: str,
+                    dropped: Optional[set] = None) -> Dict[str, Any]:
+    """Rewrite ONE frozen segment to only-retained traces: lines whose
+    trace id carries a ``dropped`` tail verdict (orphan spans written
+    eagerly by other processes, or flushed on buffer overflow before
+    the verdict landed) are removed, everything else — including
+    verdict-less lines — survives. The sidecar index is rebuilt from
+    the new content and replaced atomically WITH its ``compacted``
+    marker; the segment replace itself is atomic too (tmp + replace),
+    so a concurrent reader sees either the old segment or the new one,
+    never a torn file. The segment+index PAIR is not atomic — two
+    replaces — but each index records its segment's byte size, so a
+    reader that catches the window loads a size-mismatched index,
+    treats it as missing, and rebuilds from the file it actually has
+    instead of seeking stale offsets."""
+    if dropped is None:
+        dropped = _dropped_verdict_ids()
+    kept_lines: List[bytes] = []
+    offsets: Dict[str, List[int]] = {}
+    removed = 0
+    pos = 0
+    with open(segment_path, "rb") as f:
+        for raw in f:
+            if not raw.endswith(b"\n"):
+                break  # torn tail (shouldn't exist on a frozen file)
+            tid = _trace_id_of_line(
+                raw.decode("utf-8", errors="replace"))
+            if tid and tid in dropped:
+                removed += 1
+                continue
+            if tid:
+                offsets.setdefault(tid, []).append(pos)
+            kept_lines.append(raw)
+            pos += len(raw)
+    tmp = segment_path + ".compact.tmp"
+    with open(tmp, "wb") as f:
+        f.write(b"".join(kept_lines))
+    os.replace(tmp, segment_path)
+    _write_index(segment_path, offsets, compacted=True)
+    try:
+        _store_counter().inc(event="compact")
+    except Exception:
+        pass
+    return {"segment": os.path.basename(segment_path),
+            "removed": removed, "kept": len(kept_lines)}
+
+
+def compact_segments(log_dir: str, limit: Optional[int] = None,
+                     exclude: Optional[set] = None,
+                     ) -> List[Dict[str, Any]]:
+    """The idle-time compaction pass: rewrite frozen segments (oldest
+    first, never the active file) not yet marked compacted, up to
+    ``limit``. Called with ``limit=1`` from the roll path — rolls are
+    rare and already off the hot lock — and directly by tests/ops."""
+    path = span_log_path(log_dir)
+    out: List[Dict[str, Any]] = []
+    dropped: Optional[set] = None
+    for p in segment_paths(log_dir):
+        if p == path or (exclude and p in exclude):
+            continue
+        if limit is not None and len(out) >= limit:
+            break
+        if segment_compacted(p):
+            continue
+        if dropped is None:
+            dropped = _dropped_verdict_ids()
+        try:
+            out.append(compact_segment(p, dropped))
+        except OSError:
+            continue
+    return out
 
 
 def _read_lines_at(path: str, offsets: List[int],
@@ -571,13 +782,20 @@ def _tail_register(trace_id: str) -> None:
         _write_lines(lines)
 
 
-def _tail_route(lines_by_tid: List[Tuple[Optional[str], str]]) -> None:
+def _tail_route(lines_by_ctx: List[Tuple[Optional[TraceContext],
+                                         str]]) -> None:
     """Write span lines, detouring those of tail-pending traces into
-    their buffer and suppressing those of recently dropped traces."""
+    their buffer, suppressing those of recently dropped traces, and —
+    for tail-marked traces MINTED IN ANOTHER PROCESS (the envelope's
+    tail carry; their ids are unknown to this process's pending
+    buffer) — holding them for the minting edge's verdict line in the
+    verdict sidecar instead of writing orphans."""
     direct: List[str] = []
     overflow: List[str] = []
+    now = time.monotonic()
     with _tail_lock:
-        for tid, line in lines_by_tid:
+        for ctx, line in lines_by_ctx:
+            tid = ctx.trace_id if ctx is not None else None
             buf = _tail_pending.get(tid) if tid else None
             if buf is not None:
                 if len(buf) >= _PENDING_MAX_SPANS:
@@ -591,12 +809,173 @@ def _tail_route(lines_by_tid: List[Tuple[Optional[str], str]]) -> None:
                     buf.append(line)
             elif tid and tid in _tail_dropped:
                 continue
+            elif ctx is not None and ctx.tail and \
+                    _verdicts.get(tid) == "dropped":
+                continue  # verdict already known: suppressed orphan
+            elif ctx is not None and ctx.tail and \
+                    _verdicts.get(tid) != "kept":
+                # Remote-minted, verdict unknown: hold briefly.
+                entry = _remote_pending.get(tid)
+                if entry is None:
+                    while len(_remote_pending) >= _REMOTE_MAX_TRACES:
+                        _oldest, old = next(iter(
+                            _remote_pending.items()))
+                        del _remote_pending[_oldest]
+                        overflow.extend(old[1])  # retain-on-doubt
+                    entry = _remote_pending[tid] = \
+                        [now + _REMOTE_HOLD_S, []]
+                if len(entry[1]) >= _PENDING_MAX_SPANS:
+                    # A runaway remote trace stops holding: flush and
+                    # retain, mirroring the local pending buffer's
+                    # overflow contract (bounded per trace, not just
+                    # per trace COUNT).
+                    del _remote_pending[tid]
+                    overflow.extend(entry[1])
+                    overflow.append(line)
+                else:
+                    entry[1].append(line)
             else:
                 direct.append(line)
     if overflow:
         _write_lines(overflow)
     if direct:
         _write_lines(direct)
+    _remote_sweep(now)
+
+
+# --- Cross-process tail verdicts (the verdict sidecar) ----------------
+
+def _verdict_path() -> Optional[str]:
+    with _sink_lock:
+        path = _sink_path
+    if path is None:
+        return None
+    return os.path.join(os.path.dirname(path), VERDICT_FILE)
+
+
+def _write_verdict(trace_id: str, verdict: str) -> None:
+    """Append one retain/drop verdict line (minting edge only) so
+    OTHER processes' held spans can honor it. Bounded: the file rolls
+    once to ``.1`` at the size cap — verdicts only matter for the hold
+    window, so losing old ones degrades to retain-on-doubt."""
+    global _verdict_sink
+    path = _verdict_path()
+    if path is None:
+        return
+    line = json.dumps({"t": trace_id, "v": verdict},
+                      separators=(",", ":")) + "\n"
+    with _sink_lock:
+        try:
+            f = _verdict_sink
+            if f is None or f.closed or f.name != path:
+                os.makedirs(os.path.dirname(path) or ".",
+                            exist_ok=True)
+                _verdict_sink = f = open(path, "a", encoding="utf-8")
+            f.write(line)
+            f.flush()
+            if f.tell() > _VERDICT_MAX_BYTES:
+                f.close()
+                _verdict_sink = None
+                os.replace(path, path + ".1")
+        except OSError:
+            _verdict_sink = None
+
+
+def _refresh_verdicts() -> None:
+    """Incrementally fold new verdict-sidecar lines into the bounded
+    verdict map (inode-aware: a roll by the writing process resets the
+    read position)."""
+    path = _verdict_path()
+    if path is None:
+        return
+    try:
+        st = os.stat(path)
+    except OSError:
+        return
+    ident = (st.st_ino, st.st_dev)
+    with _tail_lock:
+        pos, prev_ident = _verdict_reader
+        if prev_ident != ident or pos > st.st_size:
+            pos = 0
+        if st.st_size <= pos:
+            _verdict_reader[:] = [pos, ident]
+            return
+    updates: Dict[str, str] = {}
+    try:
+        with open(path, "rb") as f:
+            f.seek(pos)
+            for raw in f:
+                if not raw.endswith(b"\n"):
+                    break  # torn tail write; re-read next refresh
+                pos += len(raw)
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                tid, v = rec.get("t"), rec.get("v")
+                if isinstance(tid, str) and v in ("kept", "dropped"):
+                    updates[tid] = v
+    except OSError:
+        return
+    with _tail_lock:
+        _verdict_reader[:] = [pos, ident]
+        _verdicts.update(updates)
+        while len(_verdicts) > _VERDICT_REMEMBER:
+            _verdicts.pop(next(iter(_verdicts)))
+
+
+def _remote_sweep(now: Optional[float] = None,
+                  force: bool = False) -> None:
+    """Resolve held remote-tail spans: a ``dropped`` verdict suppresses
+    them (the orphan-rate win), ``kept`` — or hold expiry / ``force``
+    with no verdict — writes them (retain-on-doubt)."""
+    with _tail_lock:
+        any_pending = bool(_remote_pending)
+    if not any_pending:
+        return
+    _refresh_verdicts()
+    if now is None:
+        now = time.monotonic()
+    write: List[str] = []
+    kept = dropped = 0
+    with _tail_lock:
+        for tid in list(_remote_pending):
+            deadline, lines = _remote_pending[tid]
+            v = _verdicts.get(tid)
+            if v == "dropped":
+                del _remote_pending[tid]
+                dropped += 1
+            elif v == "kept" or force or now >= deadline:
+                del _remote_pending[tid]
+                write.extend(lines)
+                kept += 1
+    if write:
+        _write_lines(write)
+    for verdict, n in (("remote_kept", kept),
+                       ("remote_dropped", dropped)):
+        if n:
+            try:
+                _tail_counter().inc(n, verdict=verdict)
+            except Exception:
+                pass
+
+
+def flush_remote_tail() -> None:
+    """Resolve every held remote-tail span NOW (verdicts honored when
+    already known, everything else retained) — shutdown/reconfigure
+    hygiene and the test seam."""
+    _remote_sweep(force=True)
+
+
+def flush_remote_expired() -> None:
+    """Resolve remote-held spans whose verdict arrived or whose hold
+    deadline passed. The routine sweep rides every span write, but an
+    IDLE worker writes none — long-poll loops (the inference worker's
+    serve loop) call this per iteration so a quiet worker's held spans
+    still honor the edge's verdict within ~one poll interval instead
+    of waiting for its next burst. One lock check when nothing is
+    pending."""
+    _remote_sweep()
 
 
 def complete(ctx: Optional[TraceContext], dur_s: float,
@@ -612,8 +991,8 @@ def complete(ctx: Optional[TraceContext], dur_s: float,
     with _tail_lock:
         lines = _tail_pending.pop(ctx.trace_id, None)
         if lines is None:
-            return  # already flushed (overflow) — retained
-        if error:
+            verdict = None  # already flushed (overflow) — retained
+        elif error:
             verdict = "kept_error"
         elif dur_s * 1e3 >= tail_slow_ms():
             verdict = "kept_slow"
@@ -624,19 +1003,31 @@ def complete(ctx: Optional[TraceContext], dur_s: float,
             _tail_dropped[ctx.trace_id] = None
             while len(_tail_dropped) > _DROPPED_REMEMBER:
                 _tail_dropped.pop(next(iter(_tail_dropped)))
+    # The verdict rides the sidecar EITHER WAY (overflow counts as
+    # kept): a subprocess worker holding this trace's spans needs the
+    # retain signal as much as the drop.
+    _write_verdict(ctx.trace_id,
+                   "dropped" if verdict == "dropped" else "kept")
+    if verdict is None:
+        return
     if verdict != "dropped" and lines:
         _write_lines(lines)
     try:
-        from . import metrics
-
-        c = metrics.registry().counter(
-            "rafiki_tpu_trace_tail_total",
-            "Tail-sampling verdicts at trace completion (verdict="
-            "kept_error|kept_slow|kept_sampled|dropped)")
-        # rta: disable=RTA301 verdict is the fixed 4-value vocabulary above; process-global family, deliberately immortal
-        c.inc(verdict=verdict)
+        # rta: disable=RTA301 verdict is the fixed vocabulary in _tail_counter's help; process-global family, deliberately immortal
+        _tail_counter().inc(verdict=verdict)
     except Exception:
         pass
+
+
+def _tail_counter():
+    from . import metrics
+
+    return metrics.registry().counter(
+        "rafiki_tpu_trace_tail_total",
+        "Tail-sampling verdicts (verdict=kept_error|kept_slow|"
+        "kept_sampled|dropped at the minting edge; remote_kept|"
+        "remote_dropped for held spans of edge-minted traces resolved "
+        "in this process)")
 
 
 def _tail_flush_all() -> None:
@@ -672,6 +1063,9 @@ def reset_tail_for_tests() -> None:
     with _tail_lock:
         _tail_pending.clear()
         _tail_dropped.clear()
+        _remote_pending.clear()
+        _verdicts.clear()
+        _verdict_reader[:] = [0, None]
 
 
 def record_event(name: str, service: str,
@@ -685,7 +1079,7 @@ def record_event(name: str, service: str,
     which minted it)."""
     if _sink_path is None:
         return
-    lines: List[Tuple[Optional[str], str]] = []
+    lines: List[Tuple[Optional[TraceContext], str]] = []
     for ctx in ctxs:
         if ctx is None:
             continue
@@ -700,7 +1094,7 @@ def record_event(name: str, service: str,
         }
         if attrs:
             span["attrs"] = attrs
-        lines.append((ctx.trace_id,
+        lines.append((ctx,
                       json.dumps(span, separators=(",", ":")) + "\n"))
     if lines:
         _tail_route(lines)
@@ -797,6 +1191,7 @@ def collect_trace(log_dir: str, trace_id: str,
     for p in segment_paths(log_dir):
         if len(spans) >= max_spans:
             break
+        compacted = None
         if p == path:
             offsets, scanned = _active_offsets(p, trace_id)
             mode, overhead = "scan_tail", scanned
@@ -805,15 +1200,17 @@ def collect_trace(log_dir: str, trace_id: str,
             except Exception:
                 pass
         else:
-            index = _load_index(p)
-            if index is None:
+            data = _load_index_data(p)
+            if data is None:
                 try:
                     index = _build_index(p)
-                    mode = "index_rebuilt"
+                    mode, compacted = "index_rebuilt", False
                 except OSError:
                     continue
             else:
+                index = data["traces"]
                 mode = "index"
+                compacted = bool(data.get("compacted"))
             try:
                 _store_counter().inc(event="index_read")
             except Exception:
@@ -828,9 +1225,14 @@ def collect_trace(log_dir: str, trace_id: str,
                 continue
             if rec.get("trace_id") == trace_id:
                 spans.append(rec)
-        diags.append({"segment": os.path.basename(p), "mode": mode,
-                      "n_spans": len(lines),
-                      "bytes_read": n_bytes + overhead})
+        diag = {"segment": os.path.basename(p), "mode": mode,
+                "n_spans": len(lines),
+                "bytes_read": n_bytes + overhead}
+        if compacted is not None:
+            # Frozen segments report whether the idle-time compaction
+            # pass already rewrote them to only-retained traces.
+            diag["compacted"] = compacted
+        diags.append(diag)
     spans.sort(key=lambda s: (s.get("start_s", 0.0), s.get("name", "")))
     t0 = spans[0].get("start_s", 0.0) if spans else 0.0
     for s in spans:

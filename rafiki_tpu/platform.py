@@ -129,6 +129,18 @@ class LocalPlatform:
             self.autoscaler = Autoscaler.from_env(self.services,
                                                   self.meta)
             self.services.autoscaler = self.autoscaler
+        # SLO engine (docs/observability.md "SLOs & alerting"):
+        # constructed ONLY when RAFIKI_TPU_SLO_RULES names objectives
+        # (apply_env pops it when empty). Off = services.slo_engine
+        # stays None: supervise pays one attribute check, zero
+        # rafiki_tpu_slo_* series.
+        self.slo_engine = None
+        if os.environ.get("RAFIKI_TPU_SLO_RULES", "").strip():
+            from .admin.slo_engine import SloEngine
+
+            self.slo_engine = SloEngine.from_env(self.services,
+                                                 self.meta)
+            self.services.slo_engine = self.slo_engine
         self.app: Optional[AdminApp] = None
         if http:
             self.app = AdminApp(self.admin, port=admin_port).start()
@@ -187,6 +199,9 @@ class LocalPlatform:
         if self.autoscaler is not None:
             self.services.autoscaler = None
             self.autoscaler.close()  # drop the autoscale series
+        if self.slo_engine is not None:
+            self.services.slo_engine = None
+            self.slo_engine.close()  # drop the slo series
         if self.app is not None:
             self.app.stop()
         if self.stop_jobs_on_shutdown:

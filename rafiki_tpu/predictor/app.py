@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ..bus import BaseBus
@@ -286,8 +287,6 @@ class PredictorService:
     def _run_cached(self, encoded_queries,
                     client: Optional[str] = None,
                     tenant: Optional[str] = None) -> list:
-        import time
-
         cache = self.edge_cache
         n = len(encoded_queries)
         results: list = [None] * n
@@ -390,7 +389,8 @@ class PredictorService:
             self.stats.admitted(n)
             return self.predictor.predict(
                 [decode_payload(q) for q in encoded_queries],
-                tenants=[(tenant, n)] if tenant else None)
+                tenants=[(tenant, n)] if tenant else None,
+                tenant_rows=[tenant] * n if tenant else None)
         finally:
             if client is not None and self._direct_cap:
                 with self._direct_lock:
@@ -412,18 +412,27 @@ class PredictorService:
         # neither inflate a tenant's request count nor churn real
         # tenants out of the LRU while serving nothing.
         tenant = _attr.tenant_key(client) if self._attribution else None
+        t0 = time.monotonic()
         try:
             if "queries" in body:
                 preds = self._run_queries(body["queries"],
                                           client=client, tenant=tenant)
                 if tenant:
                     _attr.account_admitted(tenant)
+                    # Tenant-labeled request latency (SERVED requests
+                    # only): what a tenant-scoped latency SLO reads.
+                    _attr.account_tenant_latency(
+                        tenant, time.monotonic() - t0,
+                        service=self.stats.service)
                 return 200, {"predictions": preds}
             if "query" in body:
                 preds = self._run_queries([body["query"]],
                                           client=client, tenant=tenant)
                 if tenant:
                     _attr.account_admitted(tenant)
+                    _attr.account_tenant_latency(
+                        tenant, time.monotonic() - t0,
+                        service=self.stats.service)
                 return 200, {"prediction": preds[0]}
         except Backpressure as e:
             if self._attribution:

@@ -412,11 +412,21 @@ class MicroBatcher:
                                            + len(req.queries))
             t1 = time.monotonic()
             wall = time.time()
+            tenant_rows = None
+            if tenants:
+                # Per-query tenant column (only when someone in the
+                # batch IS attributed): the tiered escalation scatter
+                # needs per-index tenants to attribute its subset —
+                # the batch-level mix alone cannot be sliced.
+                tenant_rows = []
+                for req in batch:
+                    tenant_rows.extend([req.tenant] * len(req.queries))
             try:
                 finisher = self.predictor.predict_submit(
                     flat, pre_encoded=self.pre_encoded,
                     trace_ctxs=ctxs,
                     tenants=sorted(tenants.items()) or None,
+                    tenant_rows=tenant_rows,
                     queue_wait_s=queue_wait_s)
             except BaseException as e:  # noqa: BLE001 - forwarded to callers
                 self._inflight_sem.release()

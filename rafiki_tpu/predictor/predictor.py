@@ -667,6 +667,7 @@ class Predictor:
                        pre_encoded: bool = False,
                        trace_ctxs: Optional[List[Any]] = None,
                        tenants: Optional[List[Any]] = None,
+                       tenant_rows: Optional[List[Optional[str]]] = None,
                        queue_wait_s: float = 0.0,
                        ) -> Callable[[], List[Optional[Any]]]:
         """Scatter a batch of queries NOW; returns a finisher that
@@ -705,7 +706,12 @@ class Predictor:
         (``[(tenant_hash, n_queries), ...]``) and ``queue_wait_s``
         (admission wait the batch accrued) feed the attribution ledger
         and the ``_tenant`` envelope carry — both no-ops when the
-        ledger is off.
+        ledger is off. ``tenant_rows`` is the optional PER-QUERY
+        tenant column (None entries = unattributed): the tiered path's
+        escalation scatter re-derives its subset's tenant mix from it,
+        so an escalated query's second-phase device time lands on the
+        right tenant instead of going unattributed (the r17
+        "under-attributed by design" carry, closed).
         """
         n = len(queries)
         if not n:
@@ -722,6 +728,7 @@ class Predictor:
                 return self._submit_tiered(n, wire, groups, rr, lat,
                                            best, trace_ctxs,
                                            tenants=tenants,
+                                           tenant_rows=tenant_rows,
                                            queue_wait_s=queue_wait_s)
             # No best-bin basis (a serving worker predates score
             # registration): the whole batch fans out in full.
@@ -808,6 +815,7 @@ class Predictor:
                        lat: Dict[str, float], best: str,
                        trace_ctxs: Optional[List[Any]],
                        tenants: Optional[List[Any]] = None,
+                       tenant_rows: Optional[List[Optional[str]]] = None,
                        queue_wait_s: float = 0.0,
                        ) -> Callable[[], List[Optional[Any]]]:
         """Cheap-first scatter: phase 1 covers only the best bin; the
@@ -859,7 +867,24 @@ class Predictor:
                 other = {b: ms for b, ms in groups.items() if b != best}
                 esc_wire = wire.take(esc)
                 plan2 = self._plan_for(len(esc), other, rr, lat)
-                batch2 = self._scatter(plan2, esc_wire, trace_ctxs)
+                # The escalation subset's OWN tenant mix rides the
+                # second scatter (from the per-query tenant column):
+                # without it, every escalated query's second-phase
+                # device time was unattributed by design.
+                esc_tenants = None
+                if tenant_rows:
+                    merged: Dict[str, int] = {}
+                    for i in esc:
+                        t = (tenant_rows[i]
+                             if i < len(tenant_rows) else None)
+                        if t:
+                            merged[t] = merged.get(t, 0) + 1
+                    if merged:
+                        esc_tenants = sorted(
+                            merged.items(),
+                            key=lambda kv: (-kv[1], kv[0]))
+                batch2 = self._scatter(plan2, esc_wire, trace_ctxs,
+                                       tenants=esc_tenants)
                 self._gather_shards(batch2, plan2, other, esc_wire,
                                     trace_ctxs)
                 rows2, weights2, _ = self._collect_rows(len(esc), plan2)
@@ -1037,7 +1062,9 @@ class Predictor:
     def predict(self, queries: List[Any], *,
                 pre_encoded: bool = False,
                 tenants: Optional[List[Any]] = None,
+                tenant_rows: Optional[List[Optional[str]]] = None,
                 ) -> List[Optional[Any]]:
         """Scatter-gather-ensemble a batch of queries (blocking)."""
         return self.predict_submit(queries, pre_encoded=pre_encoded,
-                                   tenants=tenants)()
+                                   tenants=tenants,
+                                   tenant_rows=tenant_rows)()

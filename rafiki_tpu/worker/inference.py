@@ -665,6 +665,12 @@ class InferenceWorker:
                         self._complete_batch(*pending)
                     pending = handle
                     consecutive_op_errors = 0
+                    # Remote tail-verdict holds resolve on span WRITES;
+                    # an idle worker writes none, so sweep here — a
+                    # quiet worker's held spans honor the edge's
+                    # retain/drop verdict within one poll interval
+                    # (no-op one lock check when nothing is pending).
+                    trace.flush_remote_expired()
                     if self._profile is not None and \
                             self._profile.expired(_time.monotonic()):
                         self._stop_profile()

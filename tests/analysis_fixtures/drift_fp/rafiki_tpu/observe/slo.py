@@ -1,0 +1,10 @@
+"""Fixture SLO vocabulary: every consumed series is registered (and a
+histogram's exposition ``_bucket`` suffix resolves to its base name).
+NO findings expected."""
+
+CONSUMED_SERIES = {
+    ("latency", "job"): "rafiki_tpu_bus_wait_seconds",
+    ("ratio", "good"): "rafiki_tpu_bus_retries_total",
+}
+
+BUCKET_NAME = "rafiki_tpu_bus_wait_seconds_bucket"

@@ -1,0 +1,7 @@
+"""Fixture SLO vocabulary consuming a metric nobody registers
+(RTA506) next to one that IS registered (sample.py's histogram)."""
+
+CONSUMED_SERIES = {
+    ("latency", "job"): "rafiki_tpu_bus_wait_seconds",       # ok
+    ("latency", "bin"): "rafiki_tpu_serving_gone_seconds",   # RTA506
+}

@@ -120,7 +120,8 @@ def test_drift_true_positives(tmp_path):
     shutil.copytree(os.path.join(FIXTURES, "drift_tp"), root)
     report = _run(root, "drift")
     codes = _codes(report)
-    assert codes == ["RTA501", "RTA502", "RTA503", "RTA504", "RTA505"]
+    assert codes == ["RTA501", "RTA502", "RTA503", "RTA504", "RTA505",
+                     "RTA506"]
     msgs = "\n".join(f.message for f in report.findings)
     assert "rafiki_tpu_serving_widgets" in msgs          # shape
     assert "'mystery'" in msgs                           # subsystem
@@ -128,6 +129,12 @@ def test_drift_true_positives(tmp_path):
     assert "rafiki_tpu_renamed_away_total" in msgs       # dashboard
     assert "RAFIKI_TPU_MYSTERY_KNOB" in msgs             # docs + parity
     assert "RAFIKI_TPU_ROGUE_TWEAK" in msgs              # rogue env
+    # RTA506 fires on BOTH sources: the consumed-series vocabulary in
+    # observe/slo.py and a docs/slo rules file's metric override.
+    assert "rafiki_tpu_serving_gone_seconds" in msgs     # slo module
+    assert "rafiki_tpu_serving_vanished_seconds" in msgs  # rules file
+    # ...but a rule naming a registered series stays clean.
+    assert "rafiki_tpu_bus_wait_seconds'" not in msgs
 
 
 def test_drift_false_positive_guard(tmp_path):
@@ -665,3 +672,56 @@ def test_changed_mode_scopes_per_file_checkers(tmp_path):
     # nothing changed -> nothing to analyze, repo checkers skipped too
     empty = run_suite(str(tmp_path), changed=set())
     assert empty.findings == []
+
+
+def test_renaming_slo_consumed_series_fails_suite(tmp_path):
+    """RTA506 gate (ISSUE r19): the SLO plane's consumed-series
+    vocabulary and the committed docs/slo rules must reference
+    registered names; renaming either side turns the suite red."""
+
+    def tree(name, slo_reps, rules_reps):
+        root = tmp_path / name
+        for rel in ("rafiki_tpu/observe/slo.py",
+                    "rafiki_tpu/admin/slo_engine.py",
+                    "rafiki_tpu/observe/attribution.py",
+                    "rafiki_tpu/observe/serving.py",
+                    "rafiki_tpu/utils/service.py"):
+            with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+                text = f.read()
+            if rel.endswith("observe/slo.py"):
+                for old, new in slo_reps:
+                    assert old in text
+                    text = text.replace(old, new)
+            dst = root / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            dst.write_text(text)
+        with open(os.path.join(REPO, "docs/slo/serving.json"),
+                  encoding="utf-8") as f:
+            rules = f.read()
+        for old, new in rules_reps:
+            assert old in rules
+            rules = rules.replace(old, new)
+        dst = root / "docs" / "slo" / "serving.json"
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(rules)
+        return str(root)
+
+    def rta506(root):
+        return [f for f in run_suite(root, only=["drift"]).new
+                if f.code == "RTA506"]
+
+    assert rta506(tree("clean", [], [])) == []
+    # (a) the engine vocabulary names a series nobody registers
+    mutated = tree("mut-vocab",
+                   [('("latency", "job"): '
+                     '"rafiki_tpu_http_request_seconds"',
+                     '("latency", "job"): '
+                     '"rafiki_tpu_http_request_millis"')], [])
+    assert any(f.anchor == "rafiki_tpu_http_request_millis"
+               for f in rta506(mutated))
+    # (b) a committed rules file references a renamed metric
+    mutated = tree("mut-rules", [],
+                   [("rafiki_tpu_serving_tenant_request_seconds",
+                     "rafiki_tpu_serving_tenant_latency_seconds")])
+    assert any(f.anchor == "rafiki_tpu_serving_tenant_latency_seconds"
+               for f in rta506(mutated))

@@ -26,6 +26,7 @@ FAMILIES = (
     "rafiki_tpu_serving_bin_device_seconds",
     "rafiki_tpu_serving_tenant_requests_total",
     "rafiki_tpu_serving_tenant_device_seconds_total",
+    "rafiki_tpu_serving_tenant_request_seconds",
 )
 
 
@@ -248,13 +249,16 @@ def test_worker_burst_accounts_bin_and_tenants(ledger):
 class _LedgerEchoWorker:
     """Bus-level worker recording the tenant envelopes it receives."""
 
-    def __init__(self, bus, worker_id="w1", job_id="job"):
+    def __init__(self, bus, worker_id="w1", job_id="job",
+                 trial_id="t1", score=None):
         self.cache = Cache(bus)
         self.worker_id = worker_id
         self.stop_flag = threading.Event()
         self.tenants = []
-        self.cache.register_worker(job_id, worker_id,
-                                   info={"trial_id": "t1"})
+        info = {"trial_id": trial_id}
+        if score is not None:
+            info["score"] = score
+        self.cache.register_worker(job_id, worker_id, info=info)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -331,6 +335,49 @@ def test_frontend_attribution_e2e_and_stop_drops_series(ledger):
     assert all(labels.get("service") != service
                for labels, _ in q.samples())
     assert _samples("rafiki_tpu_serving_tenant_requests_total") == []
+
+
+def test_tiered_escalation_carries_tenant_envelope(ledger):
+    """ISSUE r19 satellite (the r17 'under-attributed by design'
+    carry): the tiered path's SECOND scatter re-derives the escalated
+    subset's tenant mix from the per-query tenant column, so the
+    escalation bin's worker receives a ``_tenant`` envelope too —
+    before the fix it received none and the escalated queries' device
+    time went unattributed."""
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    bus = MemoryBus()
+    best = _LedgerEchoWorker(bus, worker_id="wbest", trial_id="tbest",
+                             score=0.9)
+    other = _LedgerEchoWorker(bus, worker_id="wother",
+                              trial_id="tother", score=0.5)
+    pred = Predictor("job", bus, gather_timeout=5.0,
+                     worker_wait_timeout=5.0, tier_threshold=0.5)
+    try:
+        ta, tb = attr.tenant_key("alice"), attr.tenant_key("bob")
+        # echo replies carry NO confidence -> every query escalates;
+        # alice owns queries 0-1, bob query 2.
+        out = pred.predict([1, 2, 3],
+                           tenants=[(ta, 2), (tb, 1)],
+                           tenant_rows=[ta, ta, tb])
+        assert len(out) == 3 and all(v is not None for v in out)
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                (not best.tenants or not other.tenants):
+            time.sleep(0.05)
+        # phase 1 (best bin) carried the whole batch's mix...
+        assert (ta, 2) in best.tenants and (tb, 1) in best.tenants
+        # ...and the ESCALATION scatter carried the subset's own mix
+        assert (ta, 2) in other.tenants and (tb, 1) in other.tenants
+        # counter-pinned: the escalation bin's scatter accounted its
+        # per-bin queries under the frontend label too
+        q = registry().find("rafiki_tpu_serving_bin_queries_total")
+        assert q.value(service=pred.service, bin="tbest") == 3
+        assert q.value(service=pred.service, bin="tother") == 3
+    finally:
+        pred.close()
+        best.stop()
+        other.stop()
 
 
 def test_zero_series_when_attribution_off_e2e(ledger_off):

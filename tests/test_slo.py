@@ -1,0 +1,681 @@
+"""SLO plane (ISSUE r19): objective grammar, burn-rate math, the
+multi-window alert decision table, the engine's scrape-fold, the
+autoscaler's SLO pressure signal, the disabled-plane guard, and a
+per-tenant p99 objective evaluated end-to-end over a real predictor
+frontend's /metrics.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu.admin.autoscaler import (AutoscalePolicy, JobSignals,
+                                         JobState, PolicyKnobs)
+from rafiki_tpu.admin.slo_engine import SloEngine
+from rafiki_tpu.bus import MemoryBus
+from rafiki_tpu.cache import Cache
+from rafiki_tpu.observe import attribution as attr
+from rafiki_tpu.observe import slo
+from rafiki_tpu.observe.metrics import registry
+
+SLO_FAMILIES = ("rafiki_tpu_slo_budget_remaining_ratio",
+                "rafiki_tpu_slo_burn_rate",
+                "rafiki_tpu_slo_alerts_total")
+
+
+def _slo_samples():
+    out = {}
+    for name in SLO_FAMILIES:
+        m = registry().find(name)
+        out[name] = [] if m is None else m.samples()
+    return out
+
+
+# --- Rules grammar ----------------------------------------------------
+
+def test_inline_grammar_latency_and_ratio():
+    objs = slo.parse_rules(
+        "p99:p99<50ms,window=60,fast=5,slow=20,burn=2,for=2,resolve=4"
+        ";avail:ratio>=0.995,window=120")
+    lat, rat = objs
+    assert (lat.otype, lat.target, lat.threshold_ms) == \
+        ("latency", 0.99, 50.0)
+    assert (lat.fast_s, lat.slow_s, lat.for_s, lat.resolve_s) == \
+        (5.0, 20.0, 2.0, 4.0)
+    assert rat.otype == "ratio" and rat.target == 0.995
+    assert lat.source_metric() == "rafiki_tpu_http_request_seconds"
+    assert rat.source_metric() == "rafiki_tpu_serving_requests_total"
+
+
+def test_inline_defaults_follow_window():
+    o = slo.parse_rules("x:p95<10ms,window=100")[0]
+    assert (o.fast_s, o.slow_s, o.resolve_s) == (20.0, 100.0, 20.0)
+    # fractional quantiles parse (p99.9 -> 0.999)
+    o = slo.parse_rules("y:p99.9<5ms")[0]
+    assert o.target == pytest.approx(0.999)
+
+
+@pytest.mark.parametrize("bad", [
+    "x:p99<50",                      # spec missing ms
+    "x:p99<50ms,bogus=1",            # unknown key
+    "x:p99<50ms,window=1,window=2",  # duplicate key
+    "x:ratio>=1.5",                  # target out of range
+    "x:p99<50ms,scope=cluster",      # unknown scope
+    "y:ratio>=0.9,scope=bin",        # ratio is job-scope only
+    # ratio reads a counter PAIR: a single metric override would be
+    # silently half-applied — rejected instead
+    "z:ratio>=0.9,metric=rafiki_tpu_serving_requests_total",
+    "x:p99<50ms,fast=30,slow=10",    # fast > slow
+    "a:p99<1ms;a:p99<2ms",           # duplicate name
+    "nospec",                        # not name:spec
+])
+def test_inline_grammar_rejects_loudly(bad):
+    with pytest.raises(ValueError):
+        slo.parse_rules(bad)
+
+
+def test_rules_file_json_and_missing(tmp_path):
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({"objectives": [
+        {"name": "p99", "type": "latency", "target": 0.99,
+         "threshold_ms": 50, "scope": "tenant", "window_s": 60,
+         "fast_window_s": 5, "slow_window_s": 30}]}))
+    [o] = slo.parse_rules(str(path))
+    assert o.scope == "tenant" and o.fast_s == 5.0
+    assert o.source_metric() == \
+        "rafiki_tpu_serving_tenant_request_seconds"
+    with pytest.raises(ValueError):
+        slo.parse_rules(str(tmp_path / "absent.json"))
+    path.write_text("{not json")
+    with pytest.raises(ValueError):
+        slo.parse_rules(str(path))
+    # unknown fields in a file are rejected like unknown inline keys
+    path.write_text(json.dumps({"objectives": [
+        {"name": "x", "type": "latency", "target": 0.9,
+         "threshold_ms": 5, "burn": 2}]}))
+    with pytest.raises(ValueError):
+        slo.parse_rules(str(path))
+
+
+def test_committed_example_rules_parse():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    objs = slo.parse_rules(os.path.join(repo, "docs", "slo",
+                                        "serving.json"))
+    assert {o.scope for o in objs} == {"job", "bin", "tenant"}
+    assert any(o.otype == "ratio" for o in objs)
+
+
+def test_nodeconfig_validates_rules_and_exports():
+    from rafiki_tpu.config import NodeConfig
+
+    with pytest.raises(ValueError):
+        NodeConfig(slo_rules="x:nope").validate()
+    cfg = NodeConfig(slo_rules="x:p99<10ms").validate()
+    prior = {k: os.environ.get(k) for k in
+             ("RAFIKI_TPU_SLO_RULES", "RAFIKI_TPU_SLO_WEBHOOK_URL")}
+    try:
+        cfg.apply_env()
+        assert os.environ["RAFIKI_TPU_SLO_RULES"] == "x:p99<10ms"
+        assert "RAFIKI_TPU_SLO_WEBHOOK_URL" not in os.environ
+        NodeConfig().validate().apply_env()
+        assert "RAFIKI_TPU_SLO_RULES" not in os.environ
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# --- Burn-rate math on seeded synthetic series ------------------------
+
+def test_good_total_interpolates_like_bucket_percentile():
+    cum = [(0.01, 10), (0.05, 40), (float("inf"), 50)]
+    good, total = slo.good_total_from_deltas(cum, 0.03)
+    assert total == 50 and good == pytest.approx(25.0)
+    # exactly on a bound: the bound's cumulative count
+    assert slo.good_total_from_deltas(cum, 0.05)[0] == 40
+    # beyond the last finite bound: +Inf events count bad
+    assert slo.good_total_from_deltas(cum, 10.0)[0] == 40
+    assert slo.good_total_from_deltas([], 0.1) == (0.0, 0.0)
+    assert slo.good_total_from_deltas([(0.1, 0), (float("inf"), 0)],
+                                      0.05) == (0.0, 0.0)
+
+
+def test_window_ring_burn_and_budget():
+    ring = slo.WindowRing(horizon_s=100)
+    # seeded series: 10 sweeps, 100 events each; sweeps 6..9 are 50%
+    # bad, earlier ones clean.
+    for t in range(6):
+        ring.add(float(t), 100, 100)
+    for t in range(6, 10):
+        ring.add(float(t), 50, 100)
+    budget = 0.01  # target 0.99
+    t = 9.0
+    # fast window (last 2 sweeps at t=8,9): all-bad-half => 50%/1%
+    assert ring.burn_rate(t, 1.5, budget) == pytest.approx(50.0)
+    # full window: 200 bad / 1000 events = 20% bad -> burn 20
+    assert ring.burn_rate(t, 100, budget) == pytest.approx(20.0)
+    assert ring.budget_remaining(t, 100, budget) == 0.0
+    # clean series: burn 0, budget untouched
+    clean = slo.WindowRing(100)
+    clean.add(0.0, 100, 100)
+    assert clean.burn_rate(0.0, 10, budget) == 0.0
+    assert clean.budget_remaining(0.0, 10, budget) == 1.0
+    # a light burn leaves a proportional budget
+    light = slo.WindowRing(100)
+    light.add(0.0, 998, 1000)  # 0.2% bad of a 1% budget
+    assert light.budget_remaining(0.0, 10, budget) == \
+        pytest.approx(0.8)
+
+
+# --- Alert decision table ---------------------------------------------
+
+def _obj(**kw):
+    kw.setdefault("name", "o")
+    kw.setdefault("otype", "latency")
+    kw.setdefault("target", 0.99)
+    kw.setdefault("threshold_ms", 50.0)
+    kw.setdefault("window_s", 300.0)
+    kw.setdefault("fast_s", 5.0)
+    kw.setdefault("slow_s", 30.0)
+    kw.setdefault("burn", 2.0)
+    return slo.Objective(**kw).validate()
+
+
+def test_alert_pending_firing_resolved_lifecycle():
+    obj = _obj(for_s=2.0, resolve_s=4.0)
+    m = slo.AlertMachine()
+    assert m.update(0.0, 3.0, 3.0, obj) == "pending"
+    assert m.update(1.0, 3.0, 3.0, obj) is None      # for_s not met
+    assert m.update(2.0, 3.0, 3.0, obj) == "firing"
+    assert m.state == "firing"
+    assert m.update(3.0, 1.0, 3.0, obj) is None      # quiet starts
+    assert m.update(6.9, 1.0, 3.0, obj) is None      # resolve_s not met
+    assert m.update(7.0, 1.0, 3.0, obj) == "resolved"
+    assert m.state == "ok"
+
+
+def test_alert_pending_clears_without_firing():
+    obj = _obj(for_s=5.0)
+    m = slo.AlertMachine()
+    assert m.update(0.0, 3.0, 3.0, obj) == "pending"
+    assert m.update(1.0, 1.0, 3.0, obj) == "cleared"
+    assert m.state == "ok"
+
+
+def test_alert_needs_both_windows_and_fires_immediately_at_for_zero():
+    obj = _obj(for_s=0.0)
+    m = slo.AlertMachine()
+    # fast alone breaching (a blip the slow window absorbs) never arms
+    assert m.update(0.0, 9.0, 0.5, obj) is None
+    assert m.update(1.0, 0.5, 9.0, obj) is None
+    assert m.state == "ok"
+    assert m.update(2.0, 9.0, 9.0, obj) == "firing"
+
+
+def test_alert_flap_guard():
+    """Oscillation around the threshold changes nothing: while firing,
+    a fast window that dips below threshold for LESS than resolve_s
+    never resolves; the quiet clock restarts on each re-breach."""
+    obj = _obj(for_s=0.0, resolve_s=5.0)
+    m = slo.AlertMachine()
+    assert m.update(0.0, 9.0, 9.0, obj) == "firing"
+    for t in range(1, 20):  # alternate below/above every second
+        tr = m.update(float(t), 0.5 if t % 2 else 9.0, 9.0, obj)
+        assert tr is None, (t, tr)
+    assert m.state == "firing"
+    # sustained quiet resolves exactly once
+    transitions = [m.update(20.0 + dt, 0.5, 9.0, obj)
+                   for dt in (0.0, 2.0, 5.0, 6.0)]
+    assert transitions == [None, None, "resolved", None]
+
+
+# --- Engine: scrape-fold, scoping, pruning ----------------------------
+
+class _Meta:
+    def __init__(self, jobs):
+        self.jobs = jobs
+
+    def get_inference_jobs(self, status=None):
+        return self.jobs
+
+
+class _Services:
+    log_dir = ""
+
+
+def _engine(rules, jobs, monkeypatch, feed):
+    objectives = slo.parse_rules(rules)
+    eng = SloEngine(_Services(), _Meta(jobs), objectives)
+    monkeypatch.setattr(
+        SloEngine, "_scrape",
+        lambda self, host, path:
+        {"service": "svc1", "http_service": "http1"}
+        if path == "/stats" else feed["text"])
+    return eng
+
+
+def _http_expo(per_le):
+    lines = []
+    for le, cum in per_le:
+        lines.append(
+            f'rafiki_tpu_http_request_seconds_bucket{{le="{le}",'
+            f'route="/predict",service="http1"}} {cum}')
+    return "\n".join(lines) + "\n"
+
+
+def test_engine_latency_job_scope_fires_and_publishes(monkeypatch):
+    feed = {"text": _http_expo([("0.025", 0), ("+Inf", 0)])}
+    eng = _engine("p99:p99<25ms,window=30,fast=5,slow=10,burn=1,for=0,"
+                  "resolve=3600", [{"id": "j1" * 6,
+                                    "predictor_host": "x:1"}],
+                  monkeypatch, feed)
+    try:
+        assert eng.sweep() == []  # basis
+        feed["text"] = _http_expo([("0.025", 100), ("+Inf", 100)])
+        assert eng.sweep() == []  # healthy
+        g = registry().find("rafiki_tpu_slo_budget_remaining_ratio")
+        assert g.value(objective="p99", job=("j1" * 6)[:8]) == 1.0
+        feed["text"] = _http_expo([("0.025", 100), ("+Inf", 200)])
+        [tr] = eng.sweep()        # 100 new events, all bad
+        assert tr["transition"] == "firing"
+        # both sweeps land inside the 5 s fast window (the test runs
+        # in ms): 100 bad of 200 events over a 1% budget = burn 50.
+        b = registry().find("rafiki_tpu_slo_burn_rate")
+        assert b.value(objective="p99", job=("j1" * 6)[:8],
+                       window="fast") == pytest.approx(50.0)
+        c = registry().find("rafiki_tpu_slo_alerts_total")
+        assert c.value(objective="p99", state="firing") == 1
+        assert eng.slo_pressure("j1" * 6) == ""
+        assert eng.alerts_snapshot()["firing"] == ["p99"]
+        snap = eng.snapshot()
+        [inst] = snap["objectives"][0]["instances"]
+        assert inst["state"] == "firing"
+        assert inst["budget_remaining"] < 1.0
+    finally:
+        eng.close()
+    assert all(s == [] for s in _slo_samples().values())
+
+
+def test_engine_counter_reset_rebases(monkeypatch):
+    feed = {"text": _http_expo([("0.025", 0), ("+Inf", 0)])}
+    eng = _engine("p99:p99<25ms,window=30,fast=5,slow=10,burn=1,for=0",
+                  [{"id": "j2" * 6, "predictor_host": "x:1"}],
+                  monkeypatch, feed)
+    try:
+        eng.sweep()
+        feed["text"] = _http_expo([("0.025", 0), ("+Inf", 50)])
+        eng.sweep()  # 50 bad events — would fire next breach
+        # a restarted frontend resets the cumulative counts BELOW the
+        # basis: the sweep must re-base, not fold a negative delta
+        feed["text"] = _http_expo([("0.025", 10), ("+Inf", 10)])
+        assert eng.sweep() == []
+        feed["text"] = _http_expo([("0.025", 30), ("+Inf", 30)])
+        assert eng.sweep() == []  # 20 good events on the new basis
+    finally:
+        eng.close()
+
+
+def test_engine_ratio_objective(monkeypatch):
+    def expo(req, rej):
+        return (f'rafiki_tpu_serving_requests_total{{service="svc1"}}'
+                f' {req}\n'
+                f'rafiki_tpu_serving_rejected_total{{service="svc1"}}'
+                f' {rej}\n')
+
+    feed = {"text": expo(0, 0)}
+    eng = _engine("avail:ratio>=0.9,window=30,fast=5,slow=10,burn=1,"
+                  "for=0,resolve=3600",
+                  [{"id": "j3" * 6, "predictor_host": "x:1"}],
+                  monkeypatch, feed)
+    try:
+        eng.sweep()
+        feed["text"] = expo(100, 0)
+        assert eng.sweep() == []          # all admitted
+        feed["text"] = expo(150, 50)      # 50% rejected this sweep
+        [tr] = eng.sweep()
+        assert tr["transition"] == "firing"
+        # ratio objectives are not latency pressure for the autoscaler
+        assert eng.slo_pressure("j3" * 6) is None
+    finally:
+        eng.close()
+
+
+def test_engine_bin_and_tenant_scopes_make_per_label_instances(
+        monkeypatch):
+    job_id = "abcdef012345xyz"
+
+    def expo(bins, tenants):
+        lines = []
+        for b, (good, bad) in bins.items():
+            for le, cum in (("0.025", good), ("+Inf", good + bad)):
+                lines.append(
+                    f'rafiki_tpu_serving_bin_device_seconds_bucket'
+                    f'{{job="{job_id[:12]}",bin="{b}",le="{le}"}} '
+                    f'{cum}')
+        for t, (good, bad) in tenants.items():
+            for le, cum in (("0.025", good), ("+Inf", good + bad)):
+                lines.append(
+                    f'rafiki_tpu_serving_tenant_request_seconds_bucket'
+                    f'{{service="svc1",tenant="{t}",le="{le}"}} {cum}')
+        # ANOTHER job's co-resident frontend shares the process
+        # registry: its tenant series must NOT fold into this job's
+        # instances (the service-label filter).
+        lines.append(
+            'rafiki_tpu_serving_tenant_request_seconds_bucket'
+            '{service="other-svc",tenant="intruder",le="+Inf"} 500')
+        return "\n".join(lines) + "\n"
+
+    feed = {"text": expo({"binA": (0, 0), "binB": (0, 0)},
+                         {"t1": (0, 0)})}
+    eng = _engine(
+        "bin-p99:p99<25ms,scope=bin,window=30,fast=5,slow=10,burn=1,"
+        "for=0,resolve=3600;"
+        "ten-p99:p99<25ms,scope=tenant,window=30,fast=5,slow=10,"
+        "burn=1,for=0,resolve=3600",
+        [{"id": job_id, "predictor_host": "x:1"}], monkeypatch, feed)
+    try:
+        eng.sweep()
+        # binB and tenant t1 go bad; binA stays clean
+        feed["text"] = expo({"binA": (100, 0), "binB": (0, 100)},
+                            {"t1": (0, 50)})
+        transitions = eng.sweep()
+        assert {(t["objective"], tuple(sorted(t["labels"].items())))
+                for t in transitions} == {
+            ("bin-p99", (("bin", "binB"), ("job", job_id[:8]))),
+            ("ten-p99", (("job", job_id[:8]), ("tenant", "t1")))}
+        # the violating BIN is the autoscaler's pressure target
+        assert eng.slo_pressure(job_id) == "binB"
+        # the other frontend's tenant never became an instance here
+        assert not any(i["labels"].get("tenant") == "intruder"
+                       for o in eng.snapshot()["objectives"]
+                       for i in o["instances"])
+        g = registry().find("rafiki_tpu_slo_budget_remaining_ratio")
+        assert g.value(objective="bin-p99", job=job_id[:8],
+                       bin="binA") == 1.0
+        assert g.value(objective="bin-p99", job=job_id[:8],
+                       bin="binB") == 0.0
+    finally:
+        eng.close()
+
+
+def test_engine_prunes_departed_jobs_and_their_gauges(monkeypatch):
+    feed = {"text": _http_expo([("0.025", 0), ("+Inf", 0)])}
+    meta = _Meta([{"id": "j4" * 6, "predictor_host": "x:1"}])
+    objectives = slo.parse_rules("p99:p99<25ms,window=30,fast=5,"
+                                 "slow=10")
+    eng = SloEngine(_Services(), meta, objectives)
+    monkeypatch.setattr(
+        SloEngine, "_scrape",
+        lambda self, host, path:
+        {"service": "svc1", "http_service": "http1"}
+        if path == "/stats" else feed["text"])
+    try:
+        eng.sweep()
+        feed["text"] = _http_expo([("0.025", 10), ("+Inf", 10)])
+        eng.sweep()
+        g = registry().find("rafiki_tpu_slo_budget_remaining_ratio")
+        assert g.samples() != []
+        meta.jobs = []  # job stopped
+        eng.sweep()
+        assert g.samples() == []
+    finally:
+        eng.close()
+
+
+def test_alert_sink_jsonl_and_webhook(monkeypatch, tmp_path):
+    hits = []
+
+    class _Handler:
+        pass
+
+    from rafiki_tpu.utils.service import JsonHttpServer
+
+    server = JsonHttpServer(
+        [("POST", "/hook",
+          lambda params, body, ctx: (hits.append(body) or
+                                     (200, {"ok": True})))],
+        host="127.0.0.1", name="hook").start()
+    try:
+        feed = {"text": _http_expo([("0.025", 0), ("+Inf", 0)])}
+
+        class _Svc:
+            log_dir = str(tmp_path)
+
+        objectives = slo.parse_rules(
+            "p99:p99<25ms,window=30,fast=5,slow=10,burn=1,for=0,"
+            "resolve=3600")
+        eng = SloEngine(_Svc(), _Meta([{"id": "j5" * 6,
+                                        "predictor_host": "x:1"}]),
+                        objectives,
+                        webhook_url=f"http://127.0.0.1:{server.port}"
+                                    f"/hook")
+        monkeypatch.setattr(
+            SloEngine, "_scrape",
+            lambda self, host, path:
+            {"service": "svc1", "http_service": "http1"}
+            if path == "/stats" else feed["text"])
+        try:
+            eng.sweep()
+            feed["text"] = _http_expo([("0.025", 0), ("+Inf", 100)])
+            [tr] = eng.sweep()
+            assert tr["transition"] == "firing"
+            log = (tmp_path / "alerts.jsonl").read_text().splitlines()
+            assert json.loads(log[-1])["transition"] == "firing"
+            # the webhook rides a sender thread OFF the supervise
+            # thread (a slow pager must not stall the sweep): poll
+            deadline = time.time() + 10
+            while not hits and time.time() < deadline:
+                time.sleep(0.05)
+            assert hits and hits[0]["objective"] == "p99"
+            assert hits[0]["trace_id"]
+        finally:
+            eng.close()
+    finally:
+        server.stop()
+
+
+# --- Autoscaler consumption -------------------------------------------
+
+def test_policy_slo_firing_outranks_queue_signals():
+    p = AutoscalePolicy(PolicyKnobs(up_cooldown_s=0.0))
+    # a dead-idle queue still scales up while the SLO fires
+    sig = JobSignals(queue_depth=0, queue_cap=100, slo_firing="")
+    out = p.decide(sig, {"a": 1, "b": 2}, JobState(), now=0.0)
+    assert [(d.action, d.bin, d.reason) for d in out] == \
+        [("scale_up", "a", "slo_firing")]
+    # classify: slo wins over backpressure's reason
+    sig2 = JobSignals(queue_depth=0, queue_cap=100,
+                      backpressure_delta=5, slo_firing="")
+    assert p.classify(sig2) == ("up", "slo_firing")
+    # no firing alert -> unchanged legacy behavior
+    sig3 = JobSignals(queue_depth=0, queue_cap=100)
+    assert p.classify(sig3)[0] == "down"
+
+
+def test_policy_slo_bin_scoped_alert_targets_violating_bin():
+    p = AutoscalePolicy(PolicyKnobs(up_cooldown_s=0.0))
+    # "hot" has FEWER replicas (the legacy first pick) but the alert
+    # names "cold2" — the violating bin takes the capacity.
+    sig = JobSignals(queue_depth=0, queue_cap=100, slo_firing="cold2")
+    out = p.decide(sig, {"hot": 1, "cold2": 2}, JobState(), now=0.0)
+    assert [(d.action, d.bin) for d in out] == [("scale_up", "cold2")]
+    # an alert naming an unknown bin degrades to the legacy order
+    sig2 = JobSignals(queue_depth=0, queue_cap=100, slo_firing="gone")
+    out = p.decide(sig2, {"hot": 1, "cold2": 2}, JobState(), now=0.0)
+    assert out[0].bin == "hot"
+
+
+def test_policy_slo_firing_respects_cooldown_and_ceiling():
+    p = AutoscalePolicy(PolicyKnobs(up_cooldown_s=10.0,
+                                    max_replicas=2))
+    sig = JobSignals(queue_depth=0, queue_cap=100, slo_firing="")
+    state = JobState()
+    state.last_up_mono = 0.0
+    assert p.decide(sig, {"a": 1}, state, now=5.0) == []
+    assert p.decide(sig, {"a": 2}, state, now=20.0) == []  # ceiling
+    assert p.decide(sig, {"a": 1}, state, now=20.0)
+
+
+# --- Disabled plane + platform wiring ---------------------------------
+
+def test_disabled_plane_zero_series_and_supervise_unchanged(tmp_path,
+                                                            monkeypatch):
+    monkeypatch.delenv("RAFIKI_TPU_SLO_RULES", raising=False)
+    from rafiki_tpu.platform import LocalPlatform
+
+    plat = LocalPlatform(workdir=str(tmp_path / "p"),
+                         supervise_interval=0)
+    try:
+        assert plat.slo_engine is None
+        assert plat.services.slo_engine is None
+        assert plat.services.supervise() == []
+        assert all(s == [] for s in _slo_samples().values())
+        assert plat.admin.get_slo() == {"enabled": False}
+        assert plat.admin.get_alerts() == {"enabled": False}
+    finally:
+        plat.shutdown()
+
+
+def test_platform_constructs_engine_from_env_and_serves_routes(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFIKI_TPU_SLO_RULES",
+                       "p99:p99<50ms,window=60,fast=5,slow=30")
+    from rafiki_tpu.platform import LocalPlatform
+
+    plat = LocalPlatform(workdir=str(tmp_path / "p"), http=True,
+                         supervise_interval=0)
+    try:
+        assert plat.slo_engine is not None
+        assert plat.services.slo_engine is plat.slo_engine
+        assert [o.name for o in plat.slo_engine.objectives] == ["p99"]
+        # supervise drives the sweep (no jobs: epoch still advances)
+        plat.services.supervise()
+        assert plat.slo_engine.epoch == 1
+        token = plat.admin.authenticate(
+            "superadmin@rafiki", "rafiki")["token"]
+        headers = {"Authorization": f"Bearer {token}"}
+        r = requests.get(
+            f"http://127.0.0.1:{plat.admin_port}/slo",
+            headers=headers, timeout=10).json()
+        assert r["enabled"] and r["objectives"][0]["name"] == "p99"
+        r = requests.get(
+            f"http://127.0.0.1:{plat.admin_port}/alerts",
+            headers=headers, timeout=10).json()
+        assert r["enabled"] and r["alerts"] == []
+    finally:
+        plat.shutdown()
+    # close() ran: no stale slo series survive the platform
+    assert all(s == [] for s in _slo_samples().values())
+
+
+# --- Per-tenant p99 end-to-end over a real frontend -------------------
+
+class _EchoWorker:
+    """Bus-level inference worker echoing predictions instantly."""
+
+    def __init__(self, bus, worker_id="w1", job_id="job"):
+        self.cache = Cache(bus)
+        self.worker_id = worker_id
+        self.job_id = job_id
+        self.stop_flag = threading.Event()
+        self.cache.register_worker(job_id, worker_id,
+                                   info={"trial_id": "t1"})
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self.stop_flag.is_set():
+            items = self.cache.pop_queries(self.worker_id, timeout=0.1)
+            attr.extract_frames_tenants(items)
+            for it in items:
+                if "queries" not in it:
+                    continue
+                self.cache.send_prediction_batch(
+                    it["batch_id"], self.worker_id,
+                    [[float(q), 0.0] for q in it["queries"]],
+                    shard=it.get("shard"))
+
+    def stop(self):
+        self.stop_flag.set()
+        self._thread.join(timeout=5)
+
+
+def test_tenant_p99_objective_end_to_end(monkeypatch):
+    """The r17 carry 'tenant-labeled p99 SLO tracking', closed: real
+    requests under a client header land in the tenant latency
+    histogram, and a tenant-scoped objective scraping the REAL
+    /metrics over HTTP evaluates per tenant hash — breaching for the
+    tight threshold, healthy for the loose one."""
+    from rafiki_tpu.predictor.app import PredictorService
+
+    monkeypatch.setenv(attr.ATTRIBUTION_ENV, "1")
+    attr.reset_for_tests()
+    bus = MemoryBus()
+    worker = _EchoWorker(bus)
+    svc = PredictorService("slosvc", "job", meta=None, bus=bus,
+                           host="127.0.0.1", client_header="X-Client")
+    svc.predictor.worker_wait_timeout = 5.0
+    svc.predictor.gather_timeout = 5.0
+    svc.batcher.start()
+    svc._http.start()
+    eng = None
+    try:
+        url = f"http://127.0.0.1:{svc.port}/predict"
+        for _ in range(8):
+            r = requests.post(url, json={"queries": [1, 2]},
+                              headers={"X-Client": "alice"},
+                              timeout=30)
+            assert r.status_code == 200
+        t = attr.tenant_key("alice")
+        h = registry().find("rafiki_tpu_serving_tenant_request_seconds")
+        assert h.count(tenant=t, service=svc.stats.service) == 8
+
+        # a sub-microsecond threshold every real request breaches, and
+        # a 100 s threshold none does — one engine, two objectives
+        objectives = slo.parse_rules(
+            "tight:p99<0.001ms,scope=tenant,window=30,fast=5,slow=10,"
+            "burn=1,for=0,resolve=3600;"
+            "loose:p99<100000ms,scope=tenant,window=30,fast=5,slow=10,"
+            "burn=1,for=0,resolve=3600")
+        eng = SloEngine(_Services(),
+                        _Meta([{"id": "jobe2e",
+                                "predictor_host":
+                                    f"127.0.0.1:{svc.port}"}]),
+                        objectives)
+        eng.sweep()  # basis (scrapes the real /metrics over HTTP)
+        for _ in range(8):
+            requests.post(url, json={"queries": [1]},
+                          headers={"X-Client": "alice"}, timeout=30)
+        transitions = eng.sweep()
+        assert [(tr["objective"], tr["transition"])
+                for tr in transitions] == [("tight", "firing")]
+        [inst] = [i for o in eng.snapshot()["objectives"]
+                  if o["name"] == "tight" for i in o["instances"]]
+        assert inst["labels"]["tenant"] == t
+        assert inst["state"] == "firing"
+        [linst] = [i for o in eng.snapshot()["objectives"]
+                   if o["name"] == "loose" for i in o["instances"]]
+        assert linst["state"] == "ok"
+        assert linst["budget_remaining"] == 1.0
+    finally:
+        if eng is not None:
+            eng.close()
+        svc._http.stop()
+        svc.batcher.stop()
+        svc.stats.close()
+        svc.predictor.close()
+        worker.stop()
+        attr.reset_for_tests()
+        for fam in ("rafiki_tpu_serving_tenant_request_seconds",
+                    "rafiki_tpu_serving_tenant_requests_total",
+                    "rafiki_tpu_serving_bin_queries_total",
+                    "rafiki_tpu_serving_bin_queue_seconds_total"):
+            m = registry().find(fam)
+            if m is not None:
+                m.remove()

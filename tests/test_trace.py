@@ -665,3 +665,250 @@ def test_advisor_rpc_carries_trace_context(span_sink):
         assert trace.collect_trace(span_sink, "ee" * 16)["n_spans"] == 0
     finally:
         worker.stop()
+
+
+# --- Cross-process tail verdicts (ISSUE r19 satellite) -----------------
+
+def test_envelope_carries_tail_marks_and_old_consumers_survive():
+    edge = trace.TraceContext("aa" * 16, tail=True)
+    plain = trace.TraceContext("bb" * 16)
+    env = trace.inject([plain, edge])
+    assert env["ids"] == [["bb" * 16, plain.span_id],
+                          ["aa" * 16, edge.span_id]]
+    assert env["tail"] == [1]
+    out = trace.extract({"_trace": dict(env)})
+    assert [c.tail for c in out] == [False, True]
+    # an old consumer reading only "ids" loses nothing: the pair shape
+    # is unchanged, the extra key is additive
+    legacy = [(tid, sid) for tid, sid in env["ids"]]
+    assert len(legacy) == 2
+    # malformed tail marks degrade to untailed, never to no-trace
+    out = trace.extract({"_trace": {"ids": [["cc" * 16, "d" * 16]],
+                                    "tail": ["bogus", 7]}})
+    assert len(out) == 1 and not out[0].tail
+
+
+def test_remote_worker_honors_edge_verdict(span_sink, monkeypatch):
+    """The orphan-rate satellite: a subprocess worker's spans for a
+    tail-pending trace it did NOT mint hold until the edge's verdict
+    sidecar line says kept/dropped — a dropped trace's worker spans no
+    longer survive as orphans."""
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    trace.reset_tail_for_tests()
+    try:
+        from rafiki_tpu.observe.metrics import registry as _registry
+
+        c0 = _registry().find("rafiki_tpu_trace_tail_total")
+        base_dropped = (c0.value(verdict="remote_dropped")
+                        if c0 is not None else 0.0)
+        # Worker side: contexts arrive via the envelope with the tail
+        # mark; their ids are unknown to this process's pending buffer
+        # (exactly the subprocess case).
+        dropped_tid, kept_tid = "ab" * 16, "cd" * 16
+        for tid in (dropped_tid, kept_tid):
+            [ctx] = trace.extract(
+                {"_trace": {"ids": [[tid, "e" * 16]], "tail": [0]}})
+            trace.record_event("worker.predict", "w1", [ctx],
+                               time.time(), 0.002)
+        # neither trace's spans hit the store yet (held)
+        for tid in (dropped_tid, kept_tid):
+            assert trace.collect_trace(span_sink, tid)["n_spans"] == 0
+        # the edge (another process) writes its verdicts
+        trace._write_verdict(dropped_tid, "dropped")
+        trace._write_verdict(kept_tid, "kept")
+        trace.flush_remote_tail()
+        assert trace.collect_trace(span_sink,
+                                   dropped_tid)["n_spans"] == 0
+        assert trace.collect_trace(span_sink,
+                                   kept_tid)["n_spans"] == 1
+        c = _registry().find("rafiki_tpu_trace_tail_total")
+        assert c.value(verdict="remote_dropped") == base_dropped + 1
+        # a STRAGGLER span arriving after the known drop verdict is
+        # suppressed immediately (no re-hold)
+        [late] = trace.extract(
+            {"_trace": {"ids": [[dropped_tid, "f" * 16]],
+                        "tail": [0]}})
+        trace.record_event("worker.late", "w1", [late], time.time(),
+                           0.001)
+        trace.flush_remote_tail()
+        assert trace.collect_trace(span_sink,
+                                   dropped_tid)["n_spans"] == 0
+    finally:
+        trace.reset_tail_for_tests()
+
+
+def test_remote_hold_expires_to_retain_on_doubt(span_sink,
+                                                monkeypatch):
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    monkeypatch.setattr(trace, "_REMOTE_HOLD_S", 0.05)
+    trace.reset_tail_for_tests()
+    try:
+        tid = "ef" * 16
+        [ctx] = trace.extract(
+            {"_trace": {"ids": [[tid, "a" * 16]], "tail": [0]}})
+        trace.record_event("worker.predict", "w1", [ctx],
+                           time.time(), 0.002)
+        assert trace.collect_trace(span_sink, tid)["n_spans"] == 0
+        time.sleep(0.1)
+        # the sweep rides the next span write; no verdict ever came
+        trace.record_event("other", "w1",
+                           [trace.TraceContext("ba" * 16)],
+                           time.time(), 0.001)
+        assert trace.collect_trace(span_sink, tid)["n_spans"] == 1
+    finally:
+        trace.reset_tail_for_tests()
+
+
+def test_remote_hold_caps_spans_per_trace(span_sink, monkeypatch):
+    """The remote hold is bounded per TRACE, not just per trace count:
+    one dense remote trace hits the same span cap as the local pending
+    buffer and overflows to disk (retain-on-doubt), never growing an
+    unbounded in-memory list for the hold window."""
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    monkeypatch.setattr(trace, "_PENDING_MAX_SPANS", 5)
+    trace.reset_tail_for_tests()
+    try:
+        tid = "fe" * 16
+        for i in range(8):
+            [ctx] = trace.extract(
+                {"_trace": {"ids": [[tid, "a" * 16]], "tail": [0]}})
+            trace.record_event(f"worker.s{i}", "w1", [ctx],
+                               time.time(), 0.001)
+        # spans 1..5 buffered; the 6th overflowed all six to disk;
+        # 7..8 re-hold (bounded again) awaiting a verdict
+        assert trace.collect_trace(span_sink, tid)["n_spans"] == 6
+        with trace._tail_lock:
+            held = trace._remote_pending.get(tid)
+            assert held is not None and len(held[1]) == 2
+    finally:
+        trace.reset_tail_for_tests()
+
+
+def test_edge_complete_writes_verdict_sidecar(span_sink, monkeypatch):
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0")
+    trace.reset_tail_for_tests()
+    try:
+        dropped = trace.start_trace(None)
+        trace.complete(dropped, 0.001)           # fast/ok -> dropped
+        kept = trace.start_trace(None)
+        trace.complete(kept, 0.001, error=True)  # error -> kept
+        lines = [json.loads(x) for x in
+                 open(os.path.join(span_sink,
+                                   trace.VERDICT_FILE))]
+        verdicts = {r["t"]: r["v"] for r in lines}
+        assert verdicts[dropped.trace_id] == "dropped"
+        assert verdicts[kept.trace_id] == "kept"
+    finally:
+        trace.reset_tail_for_tests()
+
+
+# --- Segment compaction (ISSUE r19 satellite) --------------------------
+
+def test_compaction_rewrites_frozen_segment_and_marks_index(
+        span_sink, monkeypatch):
+    path = os.path.join(span_sink, trace.SPAN_FILE)
+    for tid in ("aa" * 16, "bb" * 16, "cc" * 16):
+        trace.record_event("worker.predict", "w",
+                           [trace.TraceContext(tid)], time.time(),
+                           0.001)
+    os.replace(path, path + ".1")  # freeze (as a roll would)
+    trace._build_index(path + ".1")
+    assert not trace.segment_compacted(path + ".1")
+    trace._write_verdict("bb" * 16, "dropped")
+    [out] = trace.compact_segments(span_sink)
+    assert (out["removed"], out["kept"]) == (1, 2)
+    assert trace.segment_compacted(path + ".1")
+    content = open(path + ".1").read()
+    assert "bb" * 16 not in content and "aa" * 16 in content
+    # diagnostics report the compacted marker; the surviving trace
+    # still stitches via the rebuilt index
+    res = trace.collect_trace(span_sink, "aa" * 16)
+    assert res["n_spans"] == 1
+    assert [d.get("compacted") for d in res["segments"]
+            if d["segment"].endswith(".1")] == [True]
+    # a second pass skips the already-compacted segment
+    assert trace.compact_segments(span_sink) == []
+    from rafiki_tpu.observe.metrics import registry as _registry
+
+    c = _registry().find("rafiki_tpu_trace_store_total")
+    assert c.value(event="compact") >= 1
+    # a later KEPT verdict for the same id protects it from erasure
+    trace._write_verdict("aa" * 16, "dropped")
+    trace._write_verdict("aa" * 16, "kept")
+    assert "aa" * 16 not in trace._dropped_verdict_ids()
+
+
+def test_stale_index_is_detected_and_rebuilt(span_sink):
+    """A reader racing compaction (segment already replaced, index not
+    yet) must not seek the old generation's offsets into the new file:
+    the index records its segment's byte size, a mismatch loads as
+    missing, and the lookup rebuilds from the file it actually has."""
+    path = os.path.join(span_sink, trace.SPAN_FILE)
+    for tid in ("aa" * 16, "bb" * 16, "cc" * 16):
+        trace.record_event("worker.predict", "w",
+                           [trace.TraceContext(tid)], time.time(),
+                           0.001)
+    os.replace(path, path + ".1")
+    trace._build_index(path + ".1")
+    # simulate the compaction window: rewrite the segment (first line
+    # removed, every later offset shifted) leaving the OLD index
+    with open(path + ".1", "rb") as f:
+        lines = f.readlines()
+    with open(path + ".1.tmp", "wb") as f:
+        f.write(b"".join(lines[1:]))
+    os.replace(path + ".1.tmp", path + ".1")
+    assert trace._load_index_data(path + ".1") is None  # stale by size
+    res = trace.collect_trace(span_sink, "cc" * 16)
+    assert res["n_spans"] == 1
+    [d] = [d for d in res["segments"] if d["segment"].endswith(".1")]
+    assert d["mode"] == "index_rebuilt"
+
+
+def test_roll_triggers_compaction_of_older_segment(span_sink,
+                                                   monkeypatch):
+    """The idle-time trigger: with tail sampling armed, each roll
+    compacts one OLDER frozen segment — never the just-rolled .1
+    (verdicts may still be pending) and never .2 (a co-writing
+    process's append handle may still chase the renames into it; an
+    inode-swapping rewrite under that handle would lose its spans)."""
+    monkeypatch.setenv(trace.TRACE_TAIL_SAMPLE_ENV, "0.5")
+    monkeypatch.setenv(trace.TRACE_MAX_MB_ENV, "0.0005")  # ~500 bytes
+    trace.reset_tail_for_tests()
+    try:
+        path = os.path.join(span_sink, trace.SPAN_FILE)
+        # two frozen generations; the ORPHAN sits in the older one
+        # (.2, about to shift to .3 — the compaction candidate)
+        trace.record_event("orphan", "w",
+                           [trace.TraceContext("dd" * 16)],
+                           time.time(), 0.001)
+        os.replace(path, path + ".2")
+        trace.configure(span_sink)  # reopen: the handle chased the move
+        trace._build_index(path + ".2")
+        trace.record_event("recent", "w",
+                           [trace.TraceContext("cc" * 16)],
+                           time.time(), 0.001)
+        os.replace(path, path + ".1")
+        trace.configure(span_sink)
+        trace._build_index(path + ".1")
+        trace._write_verdict("dd" * 16, "dropped")
+        # now overflow the active file so a real roll fires:
+        # .2 -> .3, .1 -> .2, active -> .1
+        big_attrs = {"pad": "x" * 200}
+        for i in range(5):
+            trace.record_event("spanny", "w",
+                               [trace.TraceContext("ee" * 16)],
+                               time.time(), 0.001, attrs=big_attrs)
+        deadline = time.time() + 5
+        while not os.path.exists(path + ".3") and \
+                time.time() < deadline:
+            trace.record_event("spanny", "w",
+                               [trace.TraceContext("ee" * 16)],
+                               time.time(), 0.001, attrs=big_attrs)
+        assert os.path.exists(path + ".3")
+        # the roll compacted the shifted .3: the orphan is gone —
+        # while the two newest generations stayed untouched
+        assert trace.segment_compacted(path + ".3")
+        assert "dd" * 16 not in open(path + ".3").read()
+        assert not trace.segment_compacted(path + ".2")
+    finally:
+        trace.reset_tail_for_tests()
