@@ -2903,6 +2903,311 @@ def main_slo() -> dict:
         off_slo_series=record["off_slo_series"])
 
 
+def main_replay() -> dict:
+    """Config[replay]: the trace-replay capacity engine, closed loop
+    (docs/capacity.md). Not a sweep member — it records its OWN serving
+    stack's workload and judges the simulator against it.
+
+    Act 1, the recorder gate: the OFF side runs FIRST — a platform
+    without ``RAFIKI_TPU_WORKLOAD_RECORD`` serves real traffic and is
+    asserted to expose ZERO ``rafiki_tpu_workload_*`` series and to
+    write no ``workload.jsonl`` (the resolve-once gates are reset
+    between sides through the same seam the unit tests use, so the
+    process registry cannot have been fed by the later ON side).
+
+    Act 2, calibration: the ON side arms the recorder AND the serving
+    attribution ledger, serves a short paced ramp (client think time
+    keeps the single replica below saturation — an open-loop replay
+    of a saturated closed loop amplifies the queueing tail), and the
+    recorded trace replays against a fleet model FIT from the live
+    exposition's per-bin device-seconds histogram, replicas pinned
+    (the live side runs no autoscaler). The headline is sim p50 /
+    live p50 (the p99 ratio rides along as a finding — an i.i.d.
+    redraw of the fit recurs one-off live stalls through the sim's
+    tail): the simulator is a policy RANKER, not a latency oracle
+    (docs/capacity.md spells out what is modeled), so the gate is a
+    generous band, not equality.
+
+    Act 3, the predictive A/B (pure simulation, deterministic): the
+    canned ramp trace against a slow-provisioning fleet, reactive vs
+    predictive with the periodicity table learned from the trace
+    itself. The predictive side must apply >= 1 ``scale_up:predicted``
+    and reject STRICTLY fewer — the same strictly-fewer-429s
+    discipline the autoscale config judges the live loop on.
+    """
+    import tempfile
+    import threading
+
+    import requests
+
+    from rafiki_tpu.admin import capacity
+    from rafiki_tpu.admin.autoscaler import PolicyKnobs
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.config import NodeConfig
+    from rafiki_tpu.constants import BudgetOption, TaskType, UserType
+    from rafiki_tpu.model import load_image_dataset
+    from rafiki_tpu.observe import attribution, replay, workload
+    from rafiki_tpu.observe.metrics import registry
+    from rafiki_tpu.platform import LocalPlatform
+
+    phases = [(2, 4.0), (4, 6.0)]  # (clients, seconds)
+    batch_n = 4
+    knob_env = {
+        NodeConfig.env_name("serving_queue_cap"): "32",
+        NodeConfig.env_name("serving_max_batch"): "8",
+        NodeConfig.env_name("serving_max_inflight"): "1",
+    }
+    rec_env = {workload.WORKLOAD_ENV: "1",
+               attribution.ATTRIBUTION_ENV: "1"}
+
+    def workload_series() -> int:
+        m = registry().find("rafiki_tpu_workload_requests_total")
+        return len(m.samples()) if m is not None else 0
+
+    def reset_gates() -> None:
+        workload.reset_for_tests()
+        attribution.reset_for_tests()
+
+    def build(plat):
+        admin = plat.admin
+        u = admin.create_user("cap@x.c", "pw",
+                              UserType.MODEL_DEVELOPER)
+        mdl = admin.create_model(
+            u["id"], "ff-cap", TaskType.IMAGE_CLASSIFICATION,
+            "rafiki_tpu.models.feedforward:JaxFeedForward")
+        job = admin.create_train_job(
+            u["id"], "cap", TaskType.IMAGE_CLASSIFICATION,
+            [mdl["id"]], {BudgetOption.MODEL_TRIAL_COUNT: 2},
+            build.train_path, build.val_path)
+        assert admin.wait_until_train_job_done(job["id"], timeout=1200)
+        inf = admin.create_inference_job(u["id"], job["id"],
+                                         max_models=1)
+        cache = Cache(plat.bus)
+        deadline = time.time() + 600
+        while not cache.running_workers(inf["id"]) and \
+                time.time() < deadline:
+            time.sleep(0.5)
+        assert cache.running_workers(inf["id"])
+        host = admin.get_inference_job(inf["id"])["predictor_host"]
+        val = load_image_dataset(build.val_path)
+        batch = [encode_payload(val.images[i]) for i in range(batch_n)]
+        url = f"http://{host}/predict"
+        requests.post(url, json={"queries": batch},
+                      timeout=300).raise_for_status()
+        return inf, host, url, batch
+
+    def ramp(url, batch, counts):
+        # main_autoscale's load shape, shortened: per-client count
+        # slots, folded after join (lost-update-free).
+        for n_clients, dur in phases:
+            stop = threading.Event()
+            errors: list = []
+            rejected = [0] * n_clients
+            served = [0] * n_clients
+
+            def client(i: int) -> None:
+                session = requests.Session()
+                try:
+                    while not stop.is_set():
+                        r = session.post(url, json={"queries": batch},
+                                         timeout=300)
+                        if r.status_code == 429:
+                            rejected[i] += 1
+                            time.sleep(0.05)
+                        else:
+                            r.raise_for_status()
+                            served[i] += 1
+                            # Think time paces the loop below the
+                            # single replica's capacity. Zero-think
+                            # closed loops run at utilization ~1, and
+                            # an OPEN-loop replay of a saturated
+                            # trace amplifies the queueing tail into
+                            # numbers the live (self-throttling) side
+                            # never saw — the calibration band only
+                            # means something at rho < 1.
+                            time.sleep(0.03)
+                except Exception as e:  # surfaced by the caller
+                    errors.append(e)
+                    stop.set()
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            time.sleep(dur)
+            stop.set()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(f"ramp client failed: {errors[0]}")
+            counts["429"] += sum(rejected)
+            counts["served"] += sum(served)
+
+    record: dict = {}
+    prior = {k: os.environ.get(k) for k in
+             list(knob_env) + list(rec_env)}
+    os.environ.update(knob_env)
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            build.train_path, build.val_path = \
+                make_synthetic_image_dataset_compat(tmp, n_train=2048,
+                                                    n_val=256)
+
+            # --- OFF side (runs FIRST: the zero-series gate) ---------
+            for k in rec_env:
+                os.environ.pop(k, None)
+            reset_gates()
+            plat = LocalPlatform(workdir=f"{tmp}/off", http=True,
+                                 supervise_interval=0)
+            try:
+                inf, host, url, batch = build(plat)
+                for _ in range(8):
+                    requests.post(url, json={"queries": batch},
+                                  timeout=300).raise_for_status()
+                assert not workload.active()
+                record["off_workload_series"] = workload_series()
+                assert record["off_workload_series"] == 0
+                off_store = workload.workload_path(
+                    plat.services.log_dir)
+                assert not os.path.exists(off_store), off_store
+                plat.admin.stop_inference_job(inf["id"])
+            finally:
+                plat.shutdown()
+
+            # --- ON side: record, then replay what was recorded ------
+            os.environ.update(rec_env)
+            reset_gates()
+            plat = LocalPlatform(workdir=f"{tmp}/on", http=True,
+                                 supervise_interval=0)
+            try:
+                assert workload.active()
+                inf, host, url, batch = build(plat)
+                stats = requests.get(f"http://{host}/stats",
+                                     timeout=30).json()
+                before = _http_predict_buckets(host,
+                                               stats["http_service"])
+                side = {"429": 0, "served": 0}
+                ramp(url, batch, side)
+                record["live_429"] = side["429"]
+                record["live_served"] = side["served"]
+                live_p = _bucket_delta_percentiles_ms(
+                    before,
+                    _http_predict_buckets(host, stats["http_service"]),
+                    qs=(0.5, 0.99))
+                assert live_p is not None
+                record["live_ms_p50_p99"] = live_p
+                m = registry().find("rafiki_tpu_workload_requests_total")
+                record["on_workload_total"] = \
+                    int(sum(v for _, v in m.samples())) if m else 0
+                exposition = requests.get(f"http://{host}/metrics",
+                                          timeout=30).text
+                trace = workload.load(plat.services.log_dir)
+                plat.admin.stop_inference_job(inf["id"])
+            finally:
+                plat.shutdown()
+    finally:
+        reset_gates()
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # The recorder captured the ramp line for line: the trace IS the
+    # counter total (one store segment, no roll at this volume).
+    assert trace, "recorder wrote no workload records"
+    record["trace_records"] = len(trace)
+    assert record["trace_records"] == record["on_workload_total"], \
+        (record["trace_records"], record["on_workload_total"])
+
+    # --- Calibration: the recorded trace vs the live p99 -------------
+    # Two fits, two jobs. The trace fit (edge-measured compute_ms) is
+    # what the live p99 is judged against: it carries the scatter/
+    # gather + HTTP overhead the edge actually pays. The ledger fit
+    # (device-kernel histogram) is recorded alongside as the honest
+    # kernel-vs-edge gap — the attribution path must WORK (non-None),
+    # but its ratio is a finding, not a gate.
+    #
+    # build()'s single warmup post pays the one-time serving compile;
+    # the live percentiles are bucket DELTAS snapshotted after it, so
+    # the warmup sits outside the live population. Drop its record
+    # (the earliest arrival) before fitting/replaying: the i.i.d.
+    # service redraw would otherwise recur the compile stall all
+    # through the open-loop replay and judge the fit on a tail the
+    # live side was never measured on.
+    trace = trace[1:]
+    sim_kn = replay.SimKnobs(queue_cap=32.0, max_batch=8)
+    pinned = PolicyKnobs(max_replicas=1)  # pinned, like the stack
+    fleet = replay.FleetModel.from_trace(trace)
+    assert fleet is not None, "trace carries no served compute samples"
+    sim_report = replay.simulate(trace, fleet=fleet, sim=sim_kn,
+                                 policy=pinned)
+    sim_p50 = sim_report["latency_ms"]["p50"]
+    sim_p99 = sim_report["latency_ms"]["p99"]
+    live_p50, live_p99 = live_p
+    assert sim_p50 and live_p50, (sim_p50, live_p50)
+    ratio = round(sim_p50 / live_p50, 3)
+    record["sim_live_p99_ratio"] = \
+        round(sim_p99 / live_p99, 3) if live_p99 else None
+    record["sim_ms_p50_p99"] = [sim_p50, sim_p99]
+    record["sim_rejected"] = sim_report["rejected"]
+    ledger_fleet = replay.FleetModel.from_exposition(exposition)
+    assert ledger_fleet is not None, \
+        "attribution ledger exposed no device-seconds buckets to fit"
+    record["fleet_bins"] = [b.name for b in ledger_fleet.bins]
+    ledger_p99 = replay.simulate(
+        trace, fleet=ledger_fleet, sim=sim_kn,
+        policy=pinned)["latency_ms"]["p99"]
+    record["ledger_sim_p99_ratio"] = \
+        round(ledger_p99 / live_p99, 3) if ledger_p99 else None
+    # The fidelity claim docs/capacity.md makes: same order of
+    # magnitude AT THE MEDIAN, not equality. The gate deliberately
+    # sits at p50: the empirical fit redraws service times i.i.d.,
+    # so a one-off mid-ramp stall (a fused-shape compile, say) that
+    # delayed ONE live request — below the live p99 rank — recurs
+    # throughout the replay and lands above the sim's p99 rank far
+    # more often than not. The tail ratio is still recorded
+    # (sim_live_p99_ratio) as the honest finding it is.
+    assert 1 / 3 <= ratio <= 3.0, (sim_p50, live_p50)
+
+    # --- Predictive A/B (simulated, deterministic) --------------------
+    ab_trace = capacity.canned_trace("ramp")
+    table = capacity.learn_periodicity(ab_trace, period_s=120.0,
+                                       bin_s=10.0)
+    ab_sim = replay.SimKnobs(provision_delay_s=6.0, queue_cap=48.0)
+    reactive = replay.simulate(ab_trace, sim=ab_sim,
+                               policy=PolicyKnobs(),
+                               periodicity=table)
+    predictive = replay.simulate(
+        ab_trace, sim=ab_sim,
+        policy=PolicyKnobs(predict_horizon_s=15.0),
+        periodicity=table)
+    pred_ups = predictive["actions"].get("scale_up:predicted", 0)
+    assert pred_ups >= 1, predictive["actions"]
+    assert predictive["rejected"] < reactive["rejected"], \
+        (predictive["rejected"], reactive["rejected"])
+
+    return _emit(
+        "replay_sim_live_p50_ratio", ratio, "ratio",
+        ramp_phases=[{"clients": c, "seconds": s} for c, s in phases],
+        queries_per_request=batch_n,
+        live_ms_p50_p99=record["live_ms_p50_p99"],
+        sim_ms_p50_p99=record["sim_ms_p50_p99"],
+        sim_live_p99_ratio=record["sim_live_p99_ratio"],
+        live_served=record["live_served"],
+        live_429=record["live_429"],
+        sim_rejected=record["sim_rejected"],
+        ledger_sim_p99_ratio=record["ledger_sim_p99_ratio"],
+        trace_records=record["trace_records"],
+        fleet_bins=record["fleet_bins"],
+        off_workload_series=record["off_workload_series"],
+        ab_rejected_reactive=reactive["rejected"],
+        ab_rejected_predictive=predictive["rejected"],
+        ab_predicted_scale_ups=pred_ups,
+        ab_actions_reactive=reactive["actions"],
+        ab_actions_predictive=predictive["actions"])
+
+
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
                                         image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
@@ -2945,6 +3250,12 @@ _CONFIGS = {
     # to drive a latency objective healthy -> firing -> resolved;
     # judged on the alert ring + the SLO-triggered autoscale action.
     "slo": (main_slo, "slo_time_to_fire_s", "seconds"),
+    # Not in _SWEEP_ORDER: the capacity engine's closed loop — records
+    # its own stack's workload, replays it against the fitted fleet
+    # model (the calibration figure), and runs the reactive-vs-
+    # predictive policy A/B in simulation; judged on the calibration
+    # band + strictly-fewer simulated 429s, not a throughput figure.
+    "replay": (main_replay, "replay_sim_live_p50_ratio", "ratio"),
 }
 
 

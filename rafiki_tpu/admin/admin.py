@@ -851,6 +851,16 @@ class Admin:
             return {"enabled": False}
         return engine.alerts_snapshot()
 
+    def get_capacity(self) -> Dict[str, Any]:
+        """The capacity engine's snapshot (``GET /capacity``;
+        docs/capacity.md): the node's recorded-workload inventory plus
+        a canned-ramp policy-gate run of the policy this node would
+        apply. Always enabled — the gate needs no live traffic, only
+        the simulator."""
+        from . import capacity as capacity_mod
+
+        return capacity_mod.admin_snapshot(self.services)
+
     def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
         return [dict(j) for j in self.meta.get_inference_jobs(user_id)]
 

@@ -41,6 +41,10 @@ route-compatible so reference quickstart scripts port 1:1:
 - ``GET  /alerts``                   burn-rate alert transition ring
                                      (newest first) + currently firing
                                      objectives
+- ``GET  /capacity``                 recorded-workload inventory + a
+                                     canned-ramp policy-gate simulation
+                                     of this node's autoscale policy
+                                     (docs/capacity.md)
 - ``GET  /trial_phases``             trial-lifecycle phase breakdown +
                                      residency-cache counters (resident
                                      workers only; see docs/training.md)
@@ -102,6 +106,7 @@ class AdminApp:
             ("GET", "/autoscale", self._autoscale),
             ("GET", "/slo", self._slo),
             ("GET", "/alerts", self._alerts),
+            ("GET", "/capacity", self._capacity),
             ("POST", "/datasets", self._create_dataset),
             ("GET", "/datasets", self._list_datasets),
             ("GET", "/services", self._list_services),
@@ -270,6 +275,10 @@ class AdminApp:
     def _alerts(self, params, body, ctx):
         self._auth(ctx)
         return 200, self.admin.get_alerts()
+
+    def _capacity(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_capacity()
 
     def _create_dataset(self, params, body, ctx):
         claims = self._auth(ctx, *_WRITE_TYPES)

@@ -84,6 +84,13 @@ class PolicyKnobs:
     mfu_floor: float = 0.05        # train sub-job idle threshold (0 = no
     #                                preemption)
     idle_sweeps: int = 3           # consecutive idle sweeps to preempt
+    # Predictive scale-ahead (docs/capacity.md): 0 = reactive only.
+    # With a horizon, a positive queue-fraction trend projected to
+    # cross the high water mark within ``predict_horizon_s`` — or a
+    # learned periodicity table expecting >= ``predict_ramp_ratio``x
+    # the current qps within the horizon — scales up BEFORE the ramp.
+    predict_horizon_s: float = 0.0
+    predict_ramp_ratio: float = 1.5
 
 
 @dataclass(frozen=True)
@@ -110,6 +117,14 @@ class JobSignals:
     # attribution ledger — pre-r17 workers / attribution off — the
     # per-job fallback). Keyed by the ledger's truncated bin label.
     bins: Optional[Dict[str, BinSignals]] = None
+    # Predictive inputs (None = predictive plane off or no basis):
+    # queue_frac projected ``predict_horizon_s`` ahead along the trend
+    # EWMA (set by AutoscalePolicy.note_trend), and the learned
+    # periodicity table's expected qps at now+horizon (set by the
+    # sweep from the loaded table; the replay simulator sets both the
+    # same way — docs/capacity.md).
+    queue_frac_pred: Optional[float] = None
+    expected_qps: Optional[float] = None
     # A FIRING latency-SLO alert for this job (admin/slo_engine.py):
     # None = none firing; "" = job/tenant-scoped alert (any bin may
     # take the capacity); a bin label = the violating bin, which the
@@ -146,6 +161,11 @@ class JobState:
     prev_bin: Dict[str, Tuple[float, float]] = field(default_factory=dict)
     bin_qps_ewma: Dict[str, float] = field(default_factory=dict)
     bin_queue_ewma: Dict[str, float] = field(default_factory=dict)
+    # Queue-fraction trend basis (predictive scale-ahead): previous
+    # observation + slope EWMA, advanced by AutoscalePolicy.note_trend.
+    trend_mono: Optional[float] = None
+    trend_frac: float = 0.0
+    queue_slope_ewma: Optional[float] = None
     # /stats memo: (serving service label, http service label,
     # queue cap, microbatch on?).
     labels: Optional[Tuple[str, str, float, bool]] = None
@@ -157,7 +177,8 @@ class Decision:
 
     action: str      # "scale_up" | "scale_down"
     bin: str
-    reason: str      # "backpressure" | "queue_high" | "p99_high" | "idle"
+    reason: str      # "slo_firing" | "backpressure" | "queue_high" |
+    #                  "p99_high" | "predicted" | "idle"
 
 
 class AutoscalePolicy:
@@ -192,11 +213,52 @@ class AutoscalePolicy:
         if k.p99_high_ms > 0 and sig.p99_ms is not None \
                 and sig.p99_ms >= k.p99_high_ms:
             return "up", "p99_high"
+        if k.predict_horizon_s > 0:
+            # Scale AHEAD of the ramp: the projected queue fraction
+            # crosses the high water within the horizon (and the queue
+            # already shows life — above the low water, so floor noise
+            # cannot trigger a prediction), or the learned periodicity
+            # table expects a >= ramp_ratio x step-up (vs the current
+            # qps, floored at 1 qps so near-idle noise never reads as
+            # an imminent ramp). Ranked below every OBSERVED pressure
+            # signal — a prediction must not outrank a measurement.
+            if sig.queue_frac_pred is not None \
+                    and sig.queue_frac_pred >= k.queue_high \
+                    and sig.queue_frac > k.queue_low:
+                return "up", "predicted"
+            if sig.expected_qps is not None and sig.expected_qps \
+                    >= k.predict_ramp_ratio * max(sig.qps, 1.0):
+                return "up", "predicted"
         p99_quiet = (k.p99_high_ms <= 0 or sig.p99_ms is None
                      or sig.p99_ms <= 0.5 * k.p99_high_ms)
         if sig.queue_frac <= k.queue_low and p99_quiet:
             return "down", "idle"
         return "hold", "band"
+
+    def note_trend(self, sig: JobSignals, state: JobState,
+                   now: float) -> None:
+        """Fold this sweep's queue fraction into the per-job slope EWMA
+        and project ``sig.queue_frac_pred`` at ``predict_horizon_s``
+        (left None on a flat/negative trend, a first observation, or a
+        disabled horizon). Shared verbatim by the live sweep and the
+        replay simulator (observe/replay.py) — the regression gate only
+        means something if both predict with the same arithmetic."""
+        k = self.knobs
+        if k.predict_horizon_s <= 0:
+            return
+        if state.trend_mono is not None and now > state.trend_mono:
+            inst = (sig.queue_frac - state.trend_frac) \
+                / (now - state.trend_mono)
+            prev = state.queue_slope_ewma
+            state.queue_slope_ewma = (
+                inst if prev is None else
+                _QPS_ALPHA * inst + (1.0 - _QPS_ALPHA) * prev)
+            if state.queue_slope_ewma > 0:
+                sig.queue_frac_pred = min(
+                    1.0, sig.queue_frac
+                    + state.queue_slope_ewma * k.predict_horizon_s)
+        state.trend_mono = now
+        state.trend_frac = sig.queue_frac
 
     def decide(self, sig: JobSignals, replicas: Dict[str, int],
                state: JobState, now: float) -> List[Decision]:
@@ -282,11 +344,15 @@ class Autoscaler:
     ``autoscaler`` attribute that is None otherwise."""
 
     def __init__(self, services, meta, knobs: Optional[PolicyKnobs] = None,
-                 dry_run: bool = False):
+                 dry_run: bool = False,
+                 periodicity: Optional[Dict[str, Any]] = None):
         self.services = services
         self.meta = meta
         self.policy = AutoscalePolicy(knobs or PolicyKnobs())
         self.dry_run = dry_run
+        # Learned periodicity table (admin/capacity.py; None = no table
+        # loaded). Consulted only when predict_horizon_s > 0.
+        self.periodicity = periodicity
         self.epoch = 0
         self._jobs: Dict[str, JobState] = {}
         # sub_train_job_id -> consecutive sweeps its MFU sat below the
@@ -347,10 +413,28 @@ class Autoscaler:
             p99_high_ms=f("autoscale_p99_high_ms", 0.0),
             mfu_floor=f("autoscale_mfu_floor", 0.05),
             idle_sweeps=f("autoscale_idle_sweeps", 3),
+            predict_horizon_s=f("autoscale_predict_horizon_s", 0.0),
+            predict_ramp_ratio=f("autoscale_predict_ramp_ratio", 1.5),
         )
         dry = _parse_bool(os.environ.get(
             NodeConfig.env_name("autoscale_dry_run"), "0"))
-        return cls(services, meta, knobs=knobs, dry_run=dry)
+        periodicity = None
+        table_path = os.environ.get(
+            NodeConfig.env_name("autoscale_periodicity"), "").strip()
+        if table_path:
+            from .capacity import load_periodicity
+
+            try:
+                periodicity = load_periodicity(table_path)
+            except (OSError, ValueError):
+                # NodeConfig.validate parsed this path at startup; a
+                # table deleted since is a degraded signal, not a
+                # reason to refuse the whole control loop.
+                _log.warning("autoscale periodicity table %s "
+                             "unreadable; periodicity predictions off",
+                             table_path, exc_info=True)
+        return cls(services, meta, knobs=knobs, dry_run=dry,
+                   periodicity=periodicity)
 
     def close(self) -> None:
         """Drop every autoscale series (job/bin labels churn with
@@ -394,6 +478,17 @@ class Autoscaler:
                 # pass): a firing latency objective is scale-up
                 # pressure for this job, ahead of the queue signals.
                 sig.slo_firing = slo.slo_pressure(job["id"])
+            # Predictive inputs (no-ops when predict_horizon_s == 0):
+            # trend projection from controller state, expected qps from
+            # the learned periodicity table at wall-clock phase.
+            self.policy.note_trend(sig, state, now)
+            if self.periodicity is not None and \
+                    self.policy.knobs.predict_horizon_s > 0:
+                from .capacity import expected_qps
+
+                sig.expected_qps = expected_qps(
+                    self.periodicity, time.time(),
+                    self.policy.knobs.predict_horizon_s)
             replicas, by_bin = self._replica_counts(job["id"])
             if not replicas:
                 continue
@@ -602,6 +697,11 @@ class Autoscaler:
         }
         if sig.slo_firing is not None:
             entry["signals"]["slo_firing"] = sig.slo_firing
+        if sig.queue_frac_pred is not None:
+            entry["signals"]["queue_frac_pred"] = \
+                round(sig.queue_frac_pred, 4)
+        if sig.expected_qps is not None:
+            entry["signals"]["expected_qps"] = round(sig.expected_qps, 2)
         if sig.bins:
             entry["signals"]["bins"] = {
                 b: {"qps": round(s.qps, 2),

@@ -15,7 +15,7 @@ sweep, not one per consumer).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, List, Tuple
 
 
 def fetch_endpoint(host: str, path: str, timeout: float = 5.0) -> Any:
@@ -30,6 +30,24 @@ def fetch_endpoint(host: str, path: str, timeout: float = 5.0) -> Any:
     if path == "/metrics":
         return body.decode()
     return json.loads(body)
+
+
+def worker_metrics_addrs(services, job_id: str) -> List[str]:
+    """Advertised worker ``/metrics`` addresses for one inference job,
+    from the bus worker registry's ``metrics`` key (set by subprocess/
+    docker entrypoints after they bind a metrics server —
+    container/services.py). Resident-runner workers advertise nothing:
+    their series already live in the admin process's shared registry.
+    Best-effort — a bus hiccup degrades to "no worker scrape this
+    sweep", never into the supervise thread."""
+    try:
+        bus = services.serving_bus()
+        prefix = f"w:{job_id}:"
+        addrs = {str((bus.get(k) or {}).get("metrics") or "")
+                 for k in bus.keys(prefix)}
+        return sorted(a for a in addrs if a)
+    except Exception:
+        return []
 
 
 class ScrapeCache:

@@ -171,7 +171,18 @@ class SloEngine:
     def _job_exposition(self, job: Dict[str, Any],
                         scrapes=None) -> Optional[str]:
         """The job's predictor ``/metrics`` text (+ a one-time
-        ``/stats`` label resolve). None = skip this job this sweep."""
+        ``/stats`` label resolve), concatenated with every worker-
+        advertised metrics exposition. None = skip this job this sweep.
+
+        The worker scrape closes the r19 bin-scope visibility caveat:
+        under subprocess/docker runners the worker-owned families
+        (``rafiki_tpu_serving_bin_device_seconds``) live in each worker
+        process's registry, not the frontend's — workers that bound a
+        metrics server advertise its address in their bus registration
+        (``metrics`` key), and the concatenation is safe because
+        frontend- and worker-owned families never share a name+label
+        set. Worker fetch failures degrade to frontend-only (a dead
+        worker must not blind the whole job's objectives)."""
         host = job.get("predictor_host")
         if not host:
             return None
@@ -182,10 +193,18 @@ class SloEngine:
                 self._labels[job["id"]] = (
                     stats.get("service") or "",
                     stats.get("http_service") or "")
-            return fetch(host, "/metrics")
+            text = fetch(host, "/metrics")
         except (OSError, ValueError):
             self._labels.pop(job["id"], None)  # re-resolve on restart
             return None
+        from .scrape import worker_metrics_addrs
+
+        for addr in worker_metrics_addrs(self.services, job["id"]):
+            try:
+                text += "\n" + fetch(addr, "/metrics")
+            except (OSError, ValueError):
+                continue
+        return text
 
     def _scrape(self, host: str, path: str) -> Any:
         from .scrape import fetch_endpoint

@@ -246,6 +246,30 @@ class ConcurrencyChecker(Checker):
                      "acceptable",
                 anchor=(f"{program.describe(key)}->"
                         f"{program.describe(target)}:{blabel}")))
+        # Direct blocking under a held lock in a FREE function — the
+        # module-global-lock case. Inside a method the same shape is
+        # RTA102's (per-class) territory; module-level code has no
+        # class, so this is the only checker that can see it.
+        seen: Set[Tuple[tuple, str]] = set()
+        for key, s in sorted(program.summaries().items(),
+                             key=lambda kv: (kv[0][0],
+                                             str(kv[0][1]),
+                                             kv[0][2])):
+            if s.cls_key is not None:
+                continue
+            for held, blabel, line in s.held_blocking:
+                if (key, blabel) in seen:
+                    continue
+                seen.add((key, blabel))
+                findings.append(Finding(
+                    code="RTA105", path=key[0], line=line,
+                    message=f"{program.describe(key)}() holds "
+                            f"{'/'.join(sorted(held))} while calling "
+                            f"blocking {blabel} directly",
+                    hint="move the blocking call outside the `with` "
+                         "block, or waive with why the stall under "
+                         "the module lock is acceptable",
+                    anchor=f"{program.describe(key)}:{blabel}:direct"))
         return findings
 
     # --- RTA106: cross-thread-root unguarded shared state ---
@@ -261,7 +285,12 @@ class ConcurrencyChecker(Checker):
     def _class_roots(self, program: Program, rel: str, cname: str,
                      cnode) -> List[Finding]:
         info = program.class_info(cnode)
-        roots = info.thread_roots()
+        roots = dict(info.thread_roots())
+        # Roots registered FROM OTHER classes (or free functions):
+        # Thread(target=self.consumer.loop) in an owner makes loop a
+        # root HERE — the bus-consumer shape, where the class that
+        # owns the loop never constructs the thread itself.
+        roots.update(program.extra_class_roots((rel, cname)))
         if not roots:
             return []
         graph = info.self_call_graph()

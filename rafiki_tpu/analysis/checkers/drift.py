@@ -49,7 +49,8 @@ from ..core import Checker, Finding, RepoContext, register
 PREFIX = "rafiki_tpu_"
 
 SUBSYSTEMS = {"bus", "serving", "http", "train", "trial", "trace",
-              "node", "fault", "autoscale", "profile", "slo"}
+              "node", "fault", "autoscale", "profile", "slo",
+              "workload", "capacity"}
 
 # _total marks counters (Prometheus convention); everything else is the
 # physical unit of a gauge/histogram. "rate" is the SLO plane's burn

@@ -464,6 +464,13 @@ class ModuleInfo:
         self.import_time: List[Tuple[str, int]] = []
         self.classes: Dict[str, ast.ClassDef] = {}
         self.functions: Dict[str, ast.FunctionDef] = {}
+        #: Names bound at PLAIN top level to a lock factory
+        #: (``_lock = threading.Lock()``) — the module-global sync
+        #: primitives free functions guard with. Deliberately not the
+        #: recursive ``_toplevel_stmts`` walk: that descends into class
+        #: bodies, and a class-attribute lock is the class's, not the
+        #: module's.
+        self.global_locks: Set[str] = set()
         if tree is None:
             return
         for node in tree.body:
@@ -472,6 +479,13 @@ class ModuleInfo:
             elif isinstance(node, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
                 self.functions[node.name] = node
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                parts = _dotted(node.value.func)
+                if parts and parts[-1] in LOCK_FACTORIES:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.global_locks.add(tgt.id)
         pkg = self.modname if rel.endswith("__init__.py") else \
             self.modname.rsplit(".", 1)[0] if "." in self.modname else ""
         for node, guarded in _toplevel_stmts(tree):
@@ -589,7 +603,7 @@ def _toplevel_stmts(tree: ast.AST):
 
 class MethodSummary:
     __slots__ = ("key", "node", "cls_key", "direct_locks", "calls",
-                 "blocking")
+                 "blocking", "held_blocking")
 
     def __init__(self, key, node, cls_key):
         self.key = key          # (rel, clsname-or-None, methodname)
@@ -601,6 +615,11 @@ class MethodSummary:
         self.calls: List[Tuple[frozenset, Optional[tuple], int, str]] = []
         #: (label, line) of the first direct blocking call, or None.
         self.blocking: Optional[Tuple[str, int]] = None
+        #: Direct blocking calls made WITH a qualified lock held:
+        #: (held, label, line). For free functions this is the only
+        #: blocking-under-lock signal there is — the per-class RTA102
+        #: never sees module-level code.
+        self.held_blocking: List[Tuple[frozenset, str, int]] = []
 
 
 class Program:
@@ -626,6 +645,9 @@ class Program:
                 self._classes_by_name.setdefault(cname, []).append(
                     (mi.rel, cnode))
         self._attr_types: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
+        self._module_locks: Dict[str, Dict[str, str]] = {}
+        self._extra_roots: Optional[
+            Dict[Tuple[str, str], Dict[str, Tuple[str, str]]]] = None
         self._summaries: Optional[Dict[tuple, MethodSummary]] = None
         self._locks_closure: Optional[Dict[tuple, Set[str]]] = None
         self._lock_via: Dict[tuple, Dict[str, tuple]] = {}
@@ -754,6 +776,92 @@ class Program:
                         return t
         return None
 
+    # -- module-global locks --
+
+    def module_lock_names(self, rel: str) -> Dict[str, str]:
+        """local name -> module-qualified lock id for the module-global
+        sync primitives visible in ``rel``: its own top-level
+        ``NAME = threading.Lock()`` binds plus ``from x import NAME``
+        of another repo module's. Qualified as ``<modname>.<NAME>`` so
+        ``lock_owner`` yields the module — the cross-owner filters
+        treat a module exactly like a class."""
+        cached = self._module_locks.get(rel)
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        mi = self.modules.get(rel)
+        if mi is not None:
+            for local, (modname, symbol) in mi.imports.items():
+                if symbol is None:
+                    continue
+                target = self.by_modname.get(modname)
+                if target is not None and \
+                        symbol in target.global_locks:
+                    out[local] = f"{target.modname}.{symbol}"
+            for name in mi.global_locks:
+                out[name] = f"{mi.modname}.{name}"
+        self._module_locks[rel] = out
+        return out
+
+    # -- cross-class thread roots --
+
+    def extra_class_roots(self, cls_key: Tuple[str, str]
+                          ) -> Dict[str, Tuple[str, str]]:
+        """Thread roots REGISTERED FROM OUTSIDE the class:
+        ``Thread(target=self.consumer.loop)`` in an owner (or a free
+        function's ``Thread(target=c.loop)`` through a local alias)
+        makes ``loop`` a root ON the consumer's class — the bus-
+        consumer shape, where the object that OWNS the loop never
+        constructs the thread and so ``_ClassInfo.thread_roots`` is
+        blind to it. Only receivers whose type resolves through the
+        bounded alias rules, and methods the target class actually
+        defines, register."""
+        if self._extra_roots is None:
+            self._extra_roots = {}
+            for mi in self.modules.values():
+                for cname, cnode in mi.classes.items():
+                    info = self.class_info(cnode)
+                    atypes = self.attr_types((mi.rel, cname))
+                    for m in info.methods():
+                        self._collect_foreign_targets(
+                            mi.rel, atypes,
+                            self._local_types(mi.rel, (mi.rel, cname),
+                                              m, atypes), m)
+                for fnode in mi.functions.values():
+                    self._collect_foreign_targets(
+                        mi.rel, {},
+                        self._local_types(mi.rel, None, fnode, {}),
+                        fnode)
+        return self._extra_roots.get(cls_key, {})
+
+    def _collect_foreign_targets(self, rel, atypes, local_types,
+                                 fnode) -> None:
+        for node in ast.walk(fnode):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            leaf = func.attr if isinstance(func, ast.Attribute) else \
+                (func.id if isinstance(func, ast.Name) else "")
+            if leaf != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target" or \
+                        not isinstance(kw.value, ast.Attribute):
+                    continue
+                recv, meth = kw.value.value, kw.value.attr
+                attr = _self_attr(recv)
+                fk = atypes.get(attr) if attr is not None else None
+                if fk is None and isinstance(recv, ast.Name):
+                    fk = local_types.get(recv.id)
+                if fk is None:
+                    continue
+                finfo = self._class_info_of(fk)
+                if finfo is None or not any(m.name == meth
+                                            for m in finfo.methods()):
+                    continue
+                self._extra_roots.setdefault(fk, {})[
+                    f"thread:{meth}"] = ("thread", meth)
+
     # -- method summaries + call resolution --
 
     def summaries(self) -> Dict[tuple, MethodSummary]:
@@ -787,25 +895,16 @@ class Program:
 
     def _build_function_summary(self, rel: str, fname: str,
                                 fnode) -> None:
-        """Module-level functions: no self, no own locks tracked (a
-        module-global lock is a documented blind spot) — but their
-        calls resolve and their blocking matters to the closure."""
+        """Module-level functions: no self, but module-GLOBAL locks
+        (top-level ``NAME = threading.Lock()``, own or from-imported)
+        qualify and their ``with NAME:`` holds track, so free-function
+        acquisitions feed the cross-owner lock graph (RTA104) and the
+        blocking closure (RTA105) exactly like class locks do."""
         s = self._summaries[(rel, None, fname)]
-        local_types = self._local_types(rel, None, fnode, {})
-        free = _FREE_CONTEXT
-        for node in ast.walk(fnode):
-            if not isinstance(node, ast.Call):
-                continue
-            target, label = self._resolve_call(rel, None, node, {},
-                                               local_types)
-            s.calls.append((frozenset(), target, node.lineno, label))
-            if s.blocking is None:
-                blabel = _blocking_label(free, node)
-                if blabel is None:
-                    blabel = self._bus_blocking_label(
-                        rel, node, {}, local_types)
-                if blabel is not None:
-                    s.blocking = (blabel, node.lineno)
+        walker = _QualifiedWalker(self, rel, None, _FREE_CONTEXT, {},
+                                  s, frozenset())
+        for stmt in fnode.body:
+            walker.visit(stmt)
 
     def _build_class_summaries(self, rel: str, cname: str,
                                cnode: ast.ClassDef) -> None:
@@ -1147,11 +1246,16 @@ class _QualifiedWalker(ast.NodeVisitor):
         self.depth = 0
         self._local_types = program._local_types(
             rel, cls_key, summary.node, atypes)
+        self._module_locks = program.module_lock_names(rel)
 
     def _lock_of(self, expr: ast.AST) -> Optional[str]:
         attr = _self_attr(expr)
         if attr is not None and attr in self.info.lock_attrs:
             return self.program.lock_id(self.cls_key, attr)
+        if isinstance(expr, ast.Name):
+            # ``with _LOCK:`` — a module-global primitive (methods and
+            # free functions alike reach them by bare name).
+            return self._module_locks.get(expr.id)
         if isinstance(expr, ast.Attribute):
             owner = _self_attr(expr.value)
             fk = self.atypes.get(owner) if owner is not None else None
@@ -1206,13 +1310,17 @@ class _QualifiedWalker(ast.NodeVisitor):
             self._local_types)
         self.summary.calls.append(
             (self._effective(), target, node.lineno, label))
-        if self.summary.blocking is None:
-            blabel = _blocking_label(self.info, node)
-            if blabel is None:
-                blabel = self.program._bus_blocking_label(
-                    self.rel, node, self.atypes, self._local_types)
-            if blabel is not None:
+        blabel = _blocking_label(self.info, node)
+        if blabel is None:
+            blabel = self.program._bus_blocking_label(
+                self.rel, node, self.atypes, self._local_types)
+        if blabel is not None:
+            if self.summary.blocking is None:
                 self.summary.blocking = (blabel, node.lineno)
+            held = self._effective()
+            if held:
+                self.summary.held_blocking.append(
+                    (held, blabel, node.lineno))
         self.generic_visit(node)
 
 

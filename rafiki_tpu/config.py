@@ -171,6 +171,21 @@ class NodeConfig:
     # deployments, where worker MFU is invisible to this registry.
     autoscale_mfu_floor: float = 0.05
     autoscale_idle_sweeps: int = 3
+    # Predictive scale-ahead (docs/capacity.md): with a horizon > 0 the
+    # autoscaler projects each job's queue occupancy forward along its
+    # per-sweep trend (EWMA slope) and scales UP with reason
+    # "predicted" when the projection crosses autoscale_queue_high
+    # within the horizon — ahead of the ramp instead of behind it.
+    # 0 (the default) disables the predictive path entirely.
+    autoscale_predict_horizon_s: float = 0.0
+    # Optional periodicity table (a JSON file learned from a recorded
+    # workload trace by `python -m rafiki_tpu.capacity learn`): the
+    # second predictive signal — a recurring ramp due within the
+    # horizon whose expected qps exceeds the current bin's by
+    # autoscale_predict_ramp_ratio pre-provisions the same way.
+    # "" = trend signal only.
+    autoscale_periodicity: str = ""
+    autoscale_predict_ramp_ratio: float = 1.5
 
     # Time-sliced tenancy cap: max co-owners per chip when shared
     # placement is admitted (parallel/chips.py). Promoted from the
@@ -286,6 +301,16 @@ class NodeConfig:
     # Size cap (MB) of the JSONL alert log before it rolls to one .1
     # generation.
     slo_alert_log_mb: float = 16.0
+
+    # Workload recorder (docs/capacity.md): one JSONL arrival record
+    # per /predict request at the predictor edge — what the capacity
+    # engine replays. Default OFF — one bool check per request, zero
+    # rafiki_tpu_workload_* series. The store rolls at
+    # workload_max_mb per segment, keeping workload_retain_segments
+    # rolled generations (the span store's discipline).
+    workload_record: bool = False
+    workload_max_mb: float = 64.0
+    workload_retain_segments: int = 4
 
     # Metrics-only HTTP server for subprocess/docker worker runners
     # (they have no HTTP surface of their own). 0 = off; spawned
@@ -462,6 +487,20 @@ class NodeConfig:
                              "(0 disables preemption)")
         if self.autoscale_idle_sweeps < 1:
             raise ValueError("autoscale_idle_sweeps must be >= 1")
+        if self.autoscale_predict_horizon_s < 0:
+            raise ValueError("autoscale_predict_horizon_s must be >= 0 "
+                             "(0 disables predictive scale-ahead)")
+        if self.autoscale_predict_ramp_ratio < 1.0:
+            raise ValueError("autoscale_predict_ramp_ratio must be "
+                             ">= 1 (a recurring ramp must mean MORE "
+                             "load, not less)")
+        if self.autoscale_periodicity.strip():
+            # Parse now: a typo'd/missing table must fail the node's
+            # construction, not silently predict nothing (the
+            # fault-plan / slo-rules discipline).
+            from .admin.capacity import load_periodicity
+
+            load_periodicity(self.autoscale_periodicity)
         if self.max_chip_share < 1:
             raise ValueError("max_chip_share must be >= 1 (1 = no "
                              "time-sliced co-ownership)")
@@ -511,6 +550,10 @@ class NodeConfig:
                 f"http(s) URL")
         if self.slo_alert_log_mb <= 0:
             raise ValueError("slo_alert_log_mb must be positive")
+        if self.workload_max_mb <= 0:
+            raise ValueError("workload_max_mb must be positive")
+        if self.workload_retain_segments < 1:
+            raise ValueError("workload_retain_segments must be >= 1")
         if not (0 <= self.metrics_port <= 65535):
             raise ValueError(f"metrics_port {self.metrics_port} out of "
                              f"range (0 = no standalone server)")
@@ -581,8 +624,17 @@ class NodeConfig:
                   "autoscale_up_cooldown_s", "autoscale_down_cooldown_s",
                   "autoscale_queue_high", "autoscale_queue_low",
                   "autoscale_p99_high_ms", "autoscale_mfu_floor",
-                  "autoscale_idle_sweeps"):
+                  "autoscale_idle_sweeps",
+                  "autoscale_predict_horizon_s",
+                  "autoscale_predict_ramp_ratio"):
             os.environ[self.env_name(f)] = str(getattr(self, f))
+        # Periodicity table path pops when empty so "absent = trend
+        # signal only" stays the contract for hand-launched children.
+        if self.autoscale_periodicity.strip():
+            os.environ[self.env_name("autoscale_periodicity")] = \
+                self.autoscale_periodicity
+        else:
+            os.environ.pop(self.env_name("autoscale_periodicity"), None)
         # Read per allocate() call by the chip allocator (a layer that
         # must work without a NodeConfig), so RTA505 tracks it by name.
         os.environ[self.env_name("max_chip_share")] = \
@@ -695,6 +747,18 @@ class NodeConfig:
             os.environ.pop(self.env_name("slo_webhook_url"), None)
         os.environ[self.env_name("slo_alert_log_mb")] = \
             str(self.slo_alert_log_mb)
+        # Workload recorder: the predictor edge resolves the gate once
+        # at first use (observe.workload); pops when off so "absent =
+        # disabled" stays the contract (the attribution pattern). The
+        # store knobs are read per roll by the sink.
+        if self.workload_record:
+            os.environ[self.env_name("workload_record")] = "1"
+        else:
+            os.environ.pop(self.env_name("workload_record"), None)
+        os.environ[self.env_name("workload_max_mb")] = \
+            str(self.workload_max_mb)
+        os.environ[self.env_name("workload_retain_segments")] = \
+            str(self.workload_retain_segments)
         # 0 = "no standalone metrics server": exporting "0" would make
         # worker runners bind port 0 (a random free port) — pop instead,
         # mirroring serving_client_header's absent-means-off contract.

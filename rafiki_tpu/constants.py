@@ -111,3 +111,9 @@ class EnvVars:
     BUS_URI = "RAFIKI_TPU_BUS_URI"
     PARAMS_DIR = "RAFIKI_TPU_PARAMS_DIR"
     LOG_DIR = "RAFIKI_TPU_LOG_DIR"
+    # Set by the subprocess/docker entrypoint AFTER it binds its
+    # metrics server (container/services.py): the scrapable host:port
+    # this service advertises in its bus registration so the SLO
+    # engine can read worker-owned families (never a config knob —
+    # the bound port is only known at runtime).
+    METRICS_ADDR = "RAFIKI_TPU_METRICS_ADDR"

@@ -149,6 +149,12 @@ def main() -> None:
         # interleave safely with the admin process and sibling
         # services), so Admin.get_trace sees this worker's spans.
         trace.configure(env[EnvVars.LOG_DIR])
+        # Workload-recorder sink (dormant unless the env gate is on):
+        # a subprocess predictor's arrival records land in the same
+        # shared log dir the capacity engine replays from.
+        from ..observe import workload as _workload
+
+        _workload.configure(env[EnvVars.LOG_DIR])
         # The root FileHandler above now owns the file; dropping the
         # env var stops build_service from ALSO binding the thread-
         # routing handler to it (every record would land twice).
@@ -176,6 +182,21 @@ def main() -> None:
                     name=f"metrics-{env.get(EnvVars.SERVICE_ID, '?')[:8]}")
                 logging.getLogger(__name__).info(
                     "metrics server on port %d", server.port)
+                # Advertise the BOUND address (port 0 picks one) so
+                # this worker's bus registration can carry it and the
+                # admin's SLO engine can scrape worker-owned families
+                # (serving_bin_device_seconds lives in THIS process's
+                # registry, invisible to the frontend's exposition —
+                # docs/observability.md). gethostname covers docker
+                # networks; loopback covers same-host subprocesses.
+                import socket
+
+                try:
+                    host = socket.gethostbyname(socket.gethostname())
+                except OSError:
+                    host = "127.0.0.1"
+                os.environ[EnvVars.METRICS_ADDR] = \
+                    f"{host}:{server.port}"
             except (OSError, ValueError):
                 # A node-wide fixed port collides when several services
                 # share one host (or the value is garbage): metrics are

@@ -110,6 +110,7 @@ def probe_accelerator(timeout: Optional[float] = None) -> bool:
             "ds = jax.devices()\n"
             "print('RAFIKI_PROBE', len(ds))\n")
         try:
+            # rta: disable=RTA105 the lock EXISTS to serialize this probe: concurrent boot threads must share one subprocess verdict, not spawn N probes
             r = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
                 text=True, timeout=timeout, start_new_session=True)

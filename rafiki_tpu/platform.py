@@ -22,6 +22,7 @@ from .admin.app import AdminApp
 from .bus import BusServer, MemoryBus, connect
 from .container import SystemContext, ThreadContainerManager
 from .observe import trace as observe_trace
+from .observe import workload as observe_workload
 from .parallel.chips import ChipAllocator
 from .store import MetaStore, ParamStore
 
@@ -113,6 +114,10 @@ class LocalPlatform:
         # services configure their own sink from RAFIKI_TPU_LOG_DIR
         # (container/services.py) — same file, O_APPEND interleaving.
         observe_trace.configure(self.services.log_dir)
+        # Workload-recorder sink (observe/workload.py): dormant unless
+        # RAFIKI_TPU_WORKLOAD_RECORD is on — configure just points the
+        # would-be <logs>/workload.jsonl at the same shared log dir.
+        observe_workload.configure(self.services.log_dir)
         self.admin = Admin(self.meta, self.params, self.services,
                            datasets_dir=os.path.join(workdir, "datasets"))
         # Metrics-driven autoscaler (docs/autoscaling.md): constructed

@@ -37,6 +37,7 @@ from ..config import NodeConfig, _parse_bool
 from ..constants import ServiceStatus
 from ..observe import ServingStats, trace
 from ..observe import attribution as _attr
+from ..observe import workload as _workload
 from ..store import MetaStore
 from ..utils.service import JsonHttpServer
 from .batcher import Backpressure, MicroBatcher
@@ -115,6 +116,9 @@ class PredictorService:
         # discipline): off = no tenant hashing, no account calls
         # beyond a None check inside the ledger.
         self._attribution = _attr.enabled()
+        # Workload recorder (same snapshot discipline): off = one bool
+        # check per request, no record dicts, zero workload series.
+        self._workload = _workload.active()
         # Batcher-OFF fairness (the direct one-scatter-per-request
         # path has no admission queue): the same client_share caps one
         # client key's IN-FLIGHT queries instead, against the same
@@ -264,7 +268,8 @@ class PredictorService:
 
     def _run_queries(self, encoded_queries,
                      client: Optional[str] = None,
-                     tenant: Optional[str] = None) -> list:
+                     tenant: Optional[str] = None,
+                     record: Optional[Dict[str, Any]] = None) -> list:
         """One request's queries → ensembled predictions. With the edge
         cache enabled, each query is first resolved against it: hits
         are answered without touching the batcher/bus, concurrent
@@ -273,8 +278,9 @@ class PredictorService:
         straight to dispatch."""
         if self.edge_cache is None:
             return self._dispatch_queries(encoded_queries, client,
-                                          tenant=tenant)
-        return self._run_cached(encoded_queries, client, tenant=tenant)
+                                          tenant=tenant, record=record)
+        return self._run_cached(encoded_queries, client, tenant=tenant,
+                                record=record)
 
     def _handler_timeout(self) -> float:
         """Bound a handler's wait by the worst honest path: worker
@@ -286,7 +292,8 @@ class PredictorService:
 
     def _run_cached(self, encoded_queries,
                     client: Optional[str] = None,
-                    tenant: Optional[str] = None) -> list:
+                    tenant: Optional[str] = None,
+                    record: Optional[Dict[str, Any]] = None) -> list:
         cache = self.edge_cache
         n = len(encoded_queries)
         results: list = [None] * n
@@ -318,7 +325,7 @@ class PredictorService:
             try:
                 sub = self._dispatch_queries(
                     [encoded_queries[i] for i, _, _ in misses], client,
-                    tenant=tenant)
+                    tenant=tenant, record=record)
             except BaseException as e:
                 for _, key, flight in misses:
                     cache.fail(key, e, flight=flight)
@@ -363,7 +370,9 @@ class PredictorService:
 
     def _dispatch_queries(self, encoded_queries,
                           client: Optional[str] = None,
-                          tenant: Optional[str] = None) -> list:
+                          tenant: Optional[str] = None,
+                          record: Optional[Dict[str, Any]] = None,
+                          ) -> list:
         """Cache-miss path: through the shared micro-batcher when
         enabled (frames stay wire-encoded all the way to the bus — no
         decode/re-encode on the hot path)."""
@@ -372,7 +381,8 @@ class PredictorService:
         if self.batcher is not None:
             return self.batcher.submit(encoded_queries,
                                        timeout=self._handler_timeout(),
-                                       client=client, tenant=tenant)
+                                       client=client, tenant=tenant,
+                                       record=record)
         n = len(encoded_queries)
         if client is not None and self._direct_cap:
             with self._direct_lock:
@@ -403,6 +413,9 @@ class PredictorService:
     def _predict(self, params, body, ctx):
         if not body:
             return 400, {"error": "missing JSON body"}
+        single = "queries" not in body
+        if single and "query" not in body:
+            return 400, {"error": "body needs 'query' or 'queries'"}
         client = (ctx.headers.get(self.client_header)
                   if self.client_header else None)
         # Attribution: the hashed tenant key (never the raw header
@@ -412,34 +425,37 @@ class PredictorService:
         # neither inflate a tenant's request count nor churn real
         # tenants out of the LRU while serving nothing.
         tenant = _attr.tenant_key(client) if self._attribution else None
+        queries = [body["query"]] if single else body["queries"]
+        # Workload recorder: one arrival record per request (429s
+        # included — replay must reproduce the overload, not just the
+        # served fraction). The record dict rides the dispatch path so
+        # the micro-batcher can annotate the admission wait.
+        record = (_workload.open_request(self.inference_job_id, tenant,
+                                         len(queries))
+                  if self._workload else None)
         t0 = time.monotonic()
         try:
-            if "queries" in body:
-                preds = self._run_queries(body["queries"],
-                                          client=client, tenant=tenant)
-                if tenant:
-                    _attr.account_admitted(tenant)
-                    # Tenant-labeled request latency (SERVED requests
-                    # only): what a tenant-scoped latency SLO reads.
-                    _attr.account_tenant_latency(
-                        tenant, time.monotonic() - t0,
-                        service=self.stats.service)
-                return 200, {"predictions": preds}
-            if "query" in body:
-                preds = self._run_queries([body["query"]],
-                                          client=client, tenant=tenant)
-                if tenant:
-                    _attr.account_admitted(tenant)
-                    _attr.account_tenant_latency(
-                        tenant, time.monotonic() - t0,
-                        service=self.stats.service)
-                return 200, {"prediction": preds[0]}
+            preds = self._run_queries(queries, client=client,
+                                      tenant=tenant, record=record)
         except Backpressure as e:
             if self._attribution:
                 _attr.account_rejected(self.stats.service, e.reason)
+            _workload.commit(record, 429, time.monotonic() - t0,
+                             reason=e.reason)
             return (429,
                     {"error": str(e), "queue_depth": e.depth,
                      "queue_cap": e.cap, "reason": e.reason,
                      "retry_after": e.retry_after},
                     {"Retry-After": str(int(e.retry_after))})
-        return 400, {"error": "body needs 'query' or 'queries'"}
+        dur_s = time.monotonic() - t0
+        if tenant:
+            _attr.account_admitted(tenant)
+            # Tenant-labeled request latency (SERVED requests only):
+            # what a tenant-scoped latency SLO reads.
+            _attr.account_tenant_latency(tenant, dur_s,
+                                         service=self.stats.service)
+        _workload.commit(record, 200, dur_s,
+                         bins=self.predictor.serving_vector())
+        if single:
+            return 200, {"prediction": preds[0]}
+        return 200, {"predictions": preds}

@@ -52,6 +52,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..observe import ServingStats, trace
+from ..observe import workload
 
 _log = logging.getLogger(__name__)
 
@@ -81,11 +82,12 @@ class _Request:
     """One caller's slice of a super-batch."""
 
     __slots__ = ("queries", "event", "result", "error", "trace",
-                 "client", "tenant", "t_admit")
+                 "client", "tenant", "t_admit", "record")
 
     def __init__(self, queries: List[Any],
                  client: Optional[str] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 record: Optional[Dict[str, Any]] = None):
         self.queries = queries
         self.event = threading.Event()
         self.result: Optional[List[Any]] = None
@@ -101,6 +103,10 @@ class _Request:
         # ledger charges per bin.
         self.tenant = tenant
         self.t_admit = time.monotonic()
+        # The workload recorder's open per-request record (None when
+        # the recorder is off): the batcher annotates the admission
+        # wait into it at dispatch (observe/workload.py).
+        self.record = record
 
     def resolve(self, result: List[Any]) -> None:
         self.result = result
@@ -233,13 +239,15 @@ class MicroBatcher:
     def submit(self, queries: List[Any],
                timeout: Optional[float] = None,
                client: Optional[str] = None,
-               tenant: Optional[str] = None) -> List[Any]:
+               tenant: Optional[str] = None,
+               record: Optional[Dict[str, Any]] = None) -> List[Any]:
         """Enqueue one request's queries; block until its slice of the
         super-batch results is ready. Raises :class:`Backpressure` when
         the admission queue is full — or, with fairness on, when
         ``client``'s share of it is (the caller maps it to HTTP 429).
         ``tenant`` is the hashed attribution key riding into the bus
-        envelope (None = unattributed)."""
+        envelope (None = unattributed); ``record`` is the workload
+        recorder's open request record (None = recorder off)."""
         # rta: disable=RTA101 unlocked fast-path peek; start() re-checks under _cond
         if not self._started:
             self.start()
@@ -248,7 +256,8 @@ class MicroBatcher:
             return []
         if self._client_cap == 0:
             client = None
-        req = _Request(queries, client=client, tenant=tenant)
+        req = _Request(queries, client=client, tenant=tenant,
+                       record=record)
         with self._cond:
             # Checked under the lock: a request admitted after stop()'s
             # queue drain would sit in a queue no thread reads, blocking
@@ -407,6 +416,9 @@ class MicroBatcher:
                 # Summed per-request admission wait — the queue-time
                 # signal the attribution ledger charges per bin.
                 queue_wait_s += max(0.0, now - req.t_admit)
+                if req.record is not None:
+                    workload.note_queue_wait(
+                        req.record, max(0.0, now - req.t_admit))
                 if req.tenant:
                     tenants[req.tenant] = (tenants.get(req.tenant, 0)
                                            + len(req.queries))

@@ -561,6 +561,14 @@ class InferenceWorker:
             # serves via ONE vmapped program — the admin's promote
             # path may then restack a single member in place
             # (send_restack) instead of refusing surgical replacement.
+            # "metrics" advertises this process's bound metrics server
+            # (subprocess/docker entrypoints export METRICS_ADDR after
+            # binding — container/services.py) so the admin's SLO
+            # engine can scrape worker-owned families; resident-runner
+            # workers leave it unset (shared registry, nothing extra
+            # to scrape).
+            from ..constants import EnvVars as _EnvVars
+
             self._reg_info = {"trial_id": self.trial_id,
                               "pipeline": bool(self.pipeline),
                               "sync_latency_ms": sync_ms,
@@ -568,7 +576,9 @@ class InferenceWorker:
                               "wire": self._wire_formats,
                               "quant": (self._quant_req
                                         if self._quant_active else None),
-                              "stacked": self._stacked_active}
+                              "stacked": self._stacked_active,
+                              "metrics": os.environ.get(
+                                  _EnvVars.METRICS_ADDR) or None}
             self.cache.register_worker(self.inference_job_id,
                                        self.service_id,
                                        info=self._reg_info)

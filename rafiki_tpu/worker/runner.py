@@ -64,9 +64,10 @@ class _PersistStage:
         self._lock = threading.Lock()
         self._pending = 0
         self._failures = 0
+        self._failed_ids: set = set()
         self._commits = 0
 
-    def note_failure(self) -> None:
+    def note_failure(self, trial_id: str = "") -> None:
         """Called by a tail that errored its trial retroactively. The
         runner's loop folds this into the consecutive-error circuit
         breaker — otherwise a persistently failing tail (disk full)
@@ -75,10 +76,23 @@ class _PersistStage:
         loop."""
         with self._lock:
             self._failures += 1
+            if trial_id:
+                self._failed_ids.add(str(trial_id))
 
     def failure_count(self) -> int:
         with self._lock:
             return self._failures
+
+    def has_failed(self, trial_id: str) -> bool:
+        """Whether this trial's OWN tail already noted a failure — the
+        breaker's dedupe: a fast tail can error its trial before
+        run_one snapshots the row, and counting that trial via the
+        ERRORED snapshot AND the failure-count delta tripped the
+        breaker a trial early. The tail notes the failure strictly
+        before it marks the row, so a tail-errored snapshot implies
+        membership here by the time the loop asks."""
+        with self._lock:
+            return str(trial_id) in self._failed_ids
 
     def commit_count(self) -> int:
         """Tails that committed (trial genuinely COMPLETED) — the
@@ -225,8 +239,16 @@ class TrialRunner:
                     # an unbroken failure streak — a deterministic
                     # disk-full tail could run a dozen-plus trials
                     # before tripping instead of max_consecutive.
-                    new_failures = int(row["status"]
-                                       == TrialStatus.ERRORED)
+                    # The SAME fast tail can also land before run_one's
+                    # snapshot, making the row read ERRORED while its
+                    # failure rides the delta too — has_failed dedupes
+                    # that trial so it counts once, not twice (double
+                    # counting tripped the breaker a trial early).
+                    new_failures = int(
+                        row["status"] == TrialStatus.ERRORED
+                        and not (self._persist is not None
+                                 and self._persist.has_failed(
+                                     row["id"])))
                     new_commits = 0
                     if self._persist is not None:
                         f = self._persist.failure_count()
@@ -542,7 +564,7 @@ class TrialRunner:
                 _log.warning("trial %s: persist tail failed; marking "
                              "errored:\n%s", trial_id[:8], err)
                 if self._persist is not None:
-                    self._persist.note_failure()
+                    self._persist.note_failure(trial_id)
                 try:
                     self.meta.mark_trial_errored(trial_id, err)
                 except Exception:

@@ -1,0 +1,39 @@
+"""FP guard for cross-class thread roots: the registered loop thread
+and callers share ``_seen`` UNDER the consumer's own lock — a
+cross-class root must honor held sets exactly like an own-class one.
+``UntypedOwner`` registers a target through a receiver whose type
+does NOT resolve (constructor param, no annotation): no root, no
+finding, no crash."""
+
+import threading
+
+
+class GuardedConsumer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = 0
+
+    def loop(self):
+        while True:
+            with self._lock:
+                self._seen += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._seen
+
+
+class GuardedOwner:
+    def __init__(self):
+        self.consumer = GuardedConsumer()
+        self._t = threading.Thread(target=self.consumer.loop,
+                                   daemon=True)
+        self._t.start()
+
+
+class UntypedOwner:
+    def __init__(self, consumer):
+        self.consumer = consumer
+        self._t = threading.Thread(target=self.consumer.loop,
+                                   daemon=True)
+        self._t.start()

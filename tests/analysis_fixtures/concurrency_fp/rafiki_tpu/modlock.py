@@ -1,0 +1,35 @@
+"""FP guard for module-global locks: discipline that must stay
+clean — consistent single-lock holds with no blocking under them, and
+the snapshot-then-block shape (``export`` opens the file only AFTER
+releasing the lock)."""
+
+import threading
+
+_CACHE_LOCK = threading.Lock()
+_cache = {}
+
+
+def put(k, v):
+    with _CACHE_LOCK:
+        _cache[k] = v
+
+
+def get(k):
+    with _CACHE_LOCK:
+        return _cache.get(k)
+
+
+def refresh(k):
+    with _CACHE_LOCK:
+        _bump(k)
+
+
+def _bump(k):
+    _cache[k] = _cache.get(k, 0) + 1
+
+
+def export(path):
+    with _CACHE_LOCK:
+        snap = dict(_cache)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(str(snap))

@@ -1,0 +1,18 @@
+"""RTA106 TP (cross-class root): ``BusConsumer.loop`` never
+constructs a thread — its OWNER does (``owner.py``:
+``Thread(target=self.consumer.loop)``) — yet its unguarded ``_seen``
+is written by that thread and read by callers. The per-class
+inventory is blind here; the Program-level cross-class root
+registration is what makes this fire."""
+
+
+class BusConsumer:
+    def __init__(self):
+        self._seen = 0
+
+    def loop(self):
+        while True:
+            self._seen += 1
+
+    def snapshot(self):
+        return self._seen

@@ -1,0 +1,14 @@
+"""The registering half of the cross-class RTA106 TP: the owner
+builds the consumer AND the thread that runs its loop."""
+
+import threading
+
+from .consumer import BusConsumer
+
+
+class ConsumerOwner:
+    def __init__(self):
+        self.consumer = BusConsumer()
+        self._t = threading.Thread(target=self.consumer.loop,
+                                   daemon=True)
+        self._t.start()

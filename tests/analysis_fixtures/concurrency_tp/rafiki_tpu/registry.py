@@ -1,0 +1,54 @@
+"""Module-global-lock TPs — free functions have no class, so only the
+whole-program pass can see any of this:
+
+- RTA105 (chained): ``publish`` holds the top-level ``_REG_LOCK``
+  while ``_settle`` reaches ``time.sleep``;
+- RTA105 (direct): ``drain`` sleeps inside the ``with _REG_LOCK:``
+  block itself — invisible to the per-class RTA102;
+- RTA104: ``Journal.append`` takes ``Journal._lock -> _REG_LOCK``
+  (via ``_publish_row``) while ``seal`` orders them the other way —
+  a lock-order cycle between a CLASS lock and a MODULE lock.
+"""
+
+import threading
+import time
+
+_REG_LOCK = threading.Lock()
+_entries = {}
+
+
+def publish(name, value):
+    with _REG_LOCK:
+        _entries[name] = value
+        _settle()
+
+
+def _settle():
+    time.sleep(0.05)
+
+
+def drain(name):
+    with _REG_LOCK:
+        time.sleep(0.01)
+        return _entries.get(name)
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def append(self, row):
+        with self._lock:
+            self._rows.append(row)
+            _publish_row(row)
+
+
+def _publish_row(row):
+    with _REG_LOCK:
+        _entries["last"] = row
+
+
+def seal(journal: "Journal"):
+    with _REG_LOCK:
+        journal.append(1)

@@ -164,6 +164,18 @@ def test_concurrency_true_positives(tmp_path):
     # route handler both fire.
     assert "Poller._latest:cross-root" in by_anchor
     assert "MiniService._hits:cross-root" in by_anchor
+    # Cross-class root: the owner registers Thread(target=
+    # self.consumer.loop); the finding lands on the CONSUMER's class.
+    cc = by_anchor["BusConsumer._seen:cross-root"]
+    assert "'loop'" in cc.message and cc.path.endswith("consumer.py")
+    # Module-global lock, chained blocking (free functions only the
+    # whole-program pass can see)...
+    mg = by_anchor["publish->_settle:time.sleep()"]
+    assert "rafiki_tpu.registry._REG_LOCK" in mg.message
+    # ...the direct form RTA102 can never reach...
+    assert "drain:time.sleep():direct" in by_anchor
+    # ...and a lock-order cycle between a CLASS lock and a MODULE one.
+    assert "Journal._lock<->rafiki_tpu.registry._REG_LOCK" in by_anchor
 
 
 def test_concurrency_false_positive_guard(tmp_path):
@@ -538,6 +550,28 @@ def test_unguarded_cross_thread_write_fails_suite(tmp_path):
     cross = [f for f in report.new if f.code == "RTA106"]
     assert any(f.anchor == "_PersistStage._pending:cross-root"
                for f in cross), [f.render() for f in report.new]
+
+
+def test_blocking_under_module_lock_fails_suite(tmp_path):
+    """r17 carry: the workload recorder's module-global gate lock sits
+    on the request hot path; introducing a sleep under it must turn
+    the suite red via RTA105. Free functions are invisible to the
+    per-class RTA102 — this gate proves the module-lock plane actually
+    protects the real source."""
+    clean = _mutated_tree(tmp_path / "clean",
+                          "rafiki_tpu/observe/workload.py", [])
+    report = run_suite(clean, only=["concurrency"])
+    assert not [f for f in report.new if f.code == "RTA105"], \
+        [f.render() for f in report.new]
+    mutated = _mutated_tree(
+        tmp_path / "mut", "rafiki_tpu/observe/workload.py",
+        [("    with _lock:\n        _log_dir = log_dir or None",
+          "    with _lock:\n        time.sleep(0.01)\n"
+          "        _log_dir = log_dir or None")])
+    report = run_suite(mutated, only=["concurrency"])
+    assert any(f.code == "RTA105" and
+               f.anchor == "configure:time.sleep():direct"
+               for f in report.new), [f.render() for f in report.new]
 
 
 def test_cross_class_lock_inversion_fails_suite(tmp_path):

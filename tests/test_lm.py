@@ -12,6 +12,7 @@ import pytest
 from rafiki_tpu.datasets import make_synthetic_token_dataset
 from rafiki_tpu.model.dataset import (load_token_dataset,
                                       write_token_dataset)
+from rafiki_tpu.model.logger import logger
 from rafiki_tpu.models import JaxTransformerLM
 
 TINY = {"d_model": 256, "n_layers": 2, "seq_len": 256, "batch_size": 4,
@@ -73,7 +74,24 @@ def test_lm_quick_train_cap(token_data):
     """quick_train caps the step budget (the AutoML trial contract)."""
     train_path, _ = token_data
     knobs = dict(TINY, train_steps=5000, quick_train=True)
-    m = JaxTransformerLM(**JaxTransformerLM.validate_knobs(knobs))
-    m.train(train_path)  # must return promptly (30 steps, not 5000)
+    # trial_steps is a FixedKnob (production policy: 30); the cap
+    # MECHANISM — min(train_steps, trial_steps) — is what's under
+    # test, so override it below validation and keep the 1-core CPU
+    # mesh inside the tier-1 wall-clock budget (16 = two fused
+    # dispatches at steps_per_dispatch=8, covering the tail-chunk
+    # path too).
+    m = JaxTransformerLM(**dict(JaxTransformerLM.validate_knobs(knobs),
+                                trial_steps=16))
+    records = []
+    prev = logger.current_sink()
+    logger.set_sink(records.append)
+    try:
+        m.train(train_path)
+    finally:
+        logger.set_sink(prev)
+    steps = [r["values"]["step"] for r in records
+             if r.get("type") == "values"
+             and "step" in r.get("values", {})]
+    assert steps and max(steps) == 16, steps  # capped, not 5000
     assert m.dump_parameters()
     m.destroy()
