@@ -51,7 +51,8 @@ import ast
 from typing import Dict, List, Set, Tuple
 
 from ..core import Checker, Finding, RepoContext, register
-from ..program import _Access, _blocking_label, _ClassInfo, _self_attr
+from ..program import (_Access, _blocking_label, _ClassInfo, _self_attr,
+                       held_display)
 
 
 @register
@@ -109,7 +110,7 @@ class GuardedStateChecker(Checker):
             if key in seen:
                 continue
             seen.add(key)
-            lock_list = "/".join(f"self.{x}" for x in sorted(g))
+            lock_list = "/".join(held_display(x) for x in sorted(g))
             findings.append(Finding(
                 code="RTA101", path=rel, line=acc.line,
                 message=f"{cls.name}.{acc.attr} is guarded by "
@@ -139,7 +140,7 @@ class GuardedStateChecker(Checker):
             if anchor in seen:
                 continue
             seen.add(anchor)
-            locks = "/".join(f"self.{x}" for x in sorted(eff))
+            locks = "/".join(held_display(x) for x in sorted(eff))
             findings.append(Finding(
                 code="RTA102", path=rel, line=call.lineno,
                 message=f"{cls.name}.{method}() calls blocking "

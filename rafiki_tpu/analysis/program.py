@@ -120,6 +120,22 @@ def _foreign_lock_token(expr: ast.AST) -> Optional[str]:
     return None
 
 
+#: Held-set tokens for module-GLOBAL locks taken inside a class method
+#: (``with _REG_LOCK:``) carry this prefix + the module-qualified lock
+#: id, so they can never collide with a self-attribute token and so
+#: the same lock unifies across every class/function that shares it.
+MODULE_LOCK_TOKEN = "::"
+
+
+def held_display(token: str) -> str:
+    """Render a held-set token the way the source spells it:
+    ``self.<attr>`` for own/foreign attribute locks, the module-
+    qualified name for module-global ones."""
+    if token.startswith(MODULE_LOCK_TOKEN):
+        return token[len(MODULE_LOCK_TOKEN):]
+    return f"self.{token}"
+
+
 class _MethodWalker(ast.NodeVisitor):
     """Walks one method body tracking the lexically-held lock set."""
 
@@ -142,9 +158,15 @@ class _MethodWalker(ast.NodeVisitor):
                     (frozenset(self.held), attr, item.context_expr.lineno,
                      self.method, self.depth))
             else:
-                # Foreign locks enter the HELD set (they guard) but not
-                # lock_entries (RTA103's ordering stays own-lock).
+                # Foreign and module-global locks enter the HELD set
+                # (they guard) but not lock_entries (RTA103's ordering
+                # stays own-lock).
                 token = _foreign_lock_token(item.context_expr)
+                if token is None and \
+                        isinstance(item.context_expr, ast.Name) and \
+                        item.context_expr.id in self.cls.module_locks:
+                    token = MODULE_LOCK_TOKEN + \
+                        self.cls.module_locks[item.context_expr.id]
                 if token is not None:
                     entered.append(token)
                 else:
@@ -214,9 +236,16 @@ class _ClassInfo:
     call graph — the unit the RTA1xx checkers (and the whole-program
     pass) share. Walked at most once per run via ``Program``."""
 
-    def __init__(self, node: ast.ClassDef):
+    def __init__(self, node: ast.ClassDef,
+                 module_locks: Optional[Dict[str, str]] = None):
         self.node = node
         self.name = node.name
+        #: local name -> module-qualified id for the module-global
+        #: sync primitives visible where this class is defined: a
+        #: ``with _REG_LOCK:`` in a method guards exactly like an own
+        #: lock (the workload-recorder shape, r18) — without this the
+        #: guarded-state family reads such classes as lock-free.
+        self.module_locks: Dict[str, str] = module_locks or {}
         self.lock_attrs: Set[str] = set()
         self.lock_kind: Dict[str, str] = {}      # attr -> factory name
         self.atomic_attrs: Set[str] = set()
@@ -638,12 +667,16 @@ class Program:
             mi = ModuleInfo(m.rel, m.tree)
             self.modules[m.rel] = mi
             self.by_modname[mi.modname] = mi
-        # Globally-unique simple-name class index (resolution fallback).
+        # Globally-unique simple-name class index (resolution fallback)
+        # + node -> defining module (class_info needs the module's
+        # global-lock names to walk `with <MODULE_LOCK>:` correctly).
         self._classes_by_name: Dict[str, List[Tuple[str, ast.ClassDef]]] = {}
+        self._class_module: Dict[int, str] = {}
         for mi in self.modules.values():
             for cname, cnode in mi.classes.items():
                 self._classes_by_name.setdefault(cname, []).append(
                     (mi.rel, cnode))
+                self._class_module[id(cnode)] = mi.rel
         self._attr_types: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
         self._module_locks: Dict[str, Dict[str, str]] = {}
         self._extra_roots: Optional[
@@ -662,7 +695,9 @@ class Program:
         ask."""
         info = self._class_infos.get(id(node))
         if info is None:
-            info = _ClassInfo(node)
+            rel = self._class_module.get(id(node))
+            locks = self.module_lock_names(rel) if rel else {}
+            info = _ClassInfo(node, module_locks=locks)
             info.classify()
             info.walk()
             self._class_infos[id(node)] = info
@@ -808,14 +843,16 @@ class Program:
     def extra_class_roots(self, cls_key: Tuple[str, str]
                           ) -> Dict[str, Tuple[str, str]]:
         """Thread roots REGISTERED FROM OUTSIDE the class:
-        ``Thread(target=self.consumer.loop)`` in an owner (or a free
-        function's ``Thread(target=c.loop)`` through a local alias)
-        makes ``loop`` a root ON the consumer's class — the bus-
-        consumer shape, where the object that OWNS the loop never
-        constructs the thread and so ``_ClassInfo.thread_roots`` is
-        blind to it. Only receivers whose type resolves through the
-        bounded alias rules, and methods the target class actually
-        defines, register."""
+        ``Thread(target=self.consumer.loop)`` or an executor
+        ``pool.submit(self.consumer.drain)`` in an owner (or a free
+        function, through a local alias) makes the method a root ON
+        the consumer's class — the bus-consumer / decode-scheduler
+        shape, where the object that OWNS the loop never constructs
+        the thread and so ``_ClassInfo.thread_roots`` is blind to it.
+        Only executor-shaped submit receivers (pool/executor/exec in
+        the name), receivers whose type resolves through the bounded
+        alias rules, and methods the target class actually defines,
+        register."""
         if self._extra_roots is None:
             self._extra_roots = {}
             for mi in self.modules.values():
@@ -842,13 +879,28 @@ class Program:
             func = node.func
             leaf = func.attr if isinstance(func, ast.Attribute) else \
                 (func.id if isinstance(func, ast.Name) else "")
-            if leaf != "Thread":
-                continue
-            for kw in node.keywords:
-                if kw.arg != "target" or \
-                        not isinstance(kw.value, ast.Attribute):
+            # (kind, target expression) candidates this call registers.
+            targets: List[Tuple[str, ast.AST]] = []
+            if leaf == "Thread":
+                targets.extend(
+                    ("thread", kw.value) for kw in node.keywords
+                    if kw.arg == "target")
+            elif leaf == "submit" and node.args:
+                # Executor-shaped receivers only (same vocabulary as
+                # _ClassInfo.thread_roots): pool.submit(c.loop) is a
+                # thread hop; app.submit(self.x.m) is an app method.
+                owner = func.value \
+                    if isinstance(func, ast.Attribute) else None
+                ownername = (_self_attr(owner) or
+                             (owner.id if isinstance(owner, ast.Name)
+                              else "")) if owner is not None else ""
+                if "pool" in ownername or "executor" in ownername \
+                        or "exec" in ownername:
+                    targets.append(("submit", node.args[0]))
+            for kind, value in targets:
+                if not isinstance(value, ast.Attribute):
                     continue
-                recv, meth = kw.value.value, kw.value.attr
+                recv, meth = value.value, value.attr
                 attr = _self_attr(recv)
                 fk = atypes.get(attr) if attr is not None else None
                 if fk is None and isinstance(recv, ast.Name):
@@ -860,7 +912,7 @@ class Program:
                                             for m in finfo.methods()):
                     continue
                 self._extra_roots.setdefault(fk, {})[
-                    f"thread:{meth}"] = ("thread", meth)
+                    f"{kind}:{meth}"] = (kind, meth)
 
     # -- method summaries + call resolution --
 

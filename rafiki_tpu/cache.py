@@ -703,6 +703,10 @@ class Cache:
         for it in items:
             if DRAIN_KEY in it or RESTACK_KEY in it or PROFILE_KEY in it:
                 pass  # control marker; the worker's loop acts on it
+            elif it.get("op") == "generate":
+                pass  # token-level request; routed whole to the
+                #      worker's decode scheduler (plain-JSON tokens,
+                #      nothing to decode here)
             elif "batch" in it:
                 raw = it["batch"]
                 try:
@@ -786,3 +790,52 @@ class Cache:
         if compute_s is not None:
             frame["compute_s"] = compute_s
         self.bus.push(f"r:{batch_id}", frame)
+
+    # --- Generative serving (token streaming) ---
+    #
+    # A generate request is ONE frame on the worker's query queue
+    # (op="generate"); the reply is MANY frames on the request's reply
+    # queue — one per decode step that produced a token for this
+    # sequence, each carrying a monotonically increasing "seq" index so
+    # a consumer can detect loss/reordering, with the final frame
+    # marked done=true (finish="eos"|"length"|"error"). Tokens are
+    # plain ints end to end: no payload codec, the frames are small and
+    # latency-bound, not bandwidth-bound.
+
+    def send_generate(self, worker_id: str, tokens: List[int], *,
+                      max_new: int, temperature: float = 0.0,
+                      seed: int = 0, eos: Optional[int] = None,
+                      query_id: Optional[str] = None) -> str:
+        """Queue one token-generation request on ``worker_id``'s query
+        queue; token frames stream back on ``r:{query_id}``."""
+        query_id = query_id or uuid.uuid4().hex
+        frame: Dict[str, Any] = {
+            "query_id": query_id, "op": "generate",
+            "gen": {"tokens": [int(t) for t in tokens],
+                    "max_new": int(max_new),
+                    "temperature": float(temperature),
+                    "seed": int(seed),
+                    "eos": int(eos) if eos is not None else None}}
+        env = _trace_envelope()
+        if env is not None:
+            frame[_trace.ENVELOPE_KEY] = env
+        self.bus.push(f"q:{worker_id}", frame)
+        return query_id
+
+    def send_token_frame(self, query_id: str, worker_id: str,
+                         frame: Dict[str, Any]) -> None:
+        """Push one token frame (worker side). ``frame`` carries
+        ``seq``/``tok``/``done`` (+ ``finish``/``n_tokens``/``error``
+        on the last one); the worker id rides along for debuggability,
+        mirroring ``send_prediction``."""
+        self.bus.push(f"r:{query_id}",
+                      dict(frame, worker_id=worker_id))
+
+    def pop_token_frames(self, query_id: str, timeout: float = 1.0,
+                         max_items: int = 0) -> List[Dict[str, Any]]:
+        """Blocking pop of whatever token frames have arrived for one
+        generate request (edge side). The frames are plain dicts — no
+        decode step — so this is just the bus pop with the reply-queue
+        naming convention applied."""
+        return self.bus.pop_all(f"r:{query_id}", max_items=max_items,
+                                timeout=timeout)

@@ -141,6 +141,24 @@ class NodeConfig:
     # serving and ZERO stacked metric series.
     serving_stacked: str = "on"
 
+    # --- Generative serving (docs/serving.md "Generative serving") ---
+    # Token-level continuous batching on LM-hosting inference workers:
+    # paged KV cache, per-step admission, streamed token frames.
+    # Default OFF — a generate-off node pays one attribute check per
+    # worker loop pass and exposes ZERO rafiki_tpu_lm_* series.
+    serving_generate: bool = False
+    # Tokens per KV page (the allocation granule). Smaller pages waste
+    # less on short tails but grow the per-sequence page table.
+    generate_page_size: int = 16
+    # Device page-pool size (pages; page 0 is reserved scratch). Total
+    # KV bytes/layer/projection = pages * page_size * d_model * 2 (bf16).
+    generate_pool_pages: int = 256
+    # Decode-batch width: resident-sequence lanes per compiled decode
+    # step. The continuous-batching dispatch win is ~1/width.
+    generate_decode_batch: int = 8
+    # Per-request cap on generated tokens (requests may ask for less).
+    generate_max_new: int = 128
+
     # --- Metrics-driven autoscaler (docs/autoscaling.md) ---
     # Default OFF: supervise pays one attribute check, zero new metric
     # series, byte-identical sweep behavior. On, the admin-side control
@@ -460,6 +478,15 @@ class NodeConfig:
             raise ValueError(
                 f"serving_stacked {self.serving_stacked!r} is not one "
                 f"of on/off")
+        if self.generate_page_size < 1:
+            raise ValueError("generate_page_size must be >= 1")
+        if self.generate_pool_pages < 2:
+            raise ValueError("generate_pool_pages must be >= 2 "
+                             "(page 0 is reserved scratch)")
+        if self.generate_decode_batch < 1:
+            raise ValueError("generate_decode_batch must be >= 1")
+        if self.generate_max_new < 1:
+            raise ValueError("generate_max_new must be >= 1")
         if self.worker_reregister <= 0:
             raise ValueError("worker_reregister must be positive")
         if self.node_lease <= 0:
@@ -610,6 +637,24 @@ class NodeConfig:
         os.environ[self.env_name("node_lease")] = str(self.node_lease)
         os.environ[self.env_name("pipeline_sync_min")] = \
             str(self.pipeline_sync_min)
+        # Generative serving: the InferenceWorker reads the gate and
+        # the engine shape at construction (observe.lm resolves the
+        # gate once at first use); the flag pops when off so "absent =
+        # disabled" stays the contract for hand-launched children.
+        if self.serving_generate:
+            os.environ[self.env_name("serving_generate")] = "1"
+        else:
+            os.environ.pop(self.env_name("serving_generate"), None)
+        # Spelled out one by one (not a loop) so RTA505 can track each
+        # export by name, like the other construction-time knobs above.
+        os.environ[self.env_name("generate_page_size")] = \
+            str(self.generate_page_size)
+        os.environ[self.env_name("generate_pool_pages")] = \
+            str(self.generate_pool_pages)
+        os.environ[self.env_name("generate_decode_batch")] = \
+            str(self.generate_decode_batch)
+        os.environ[self.env_name("generate_max_new")] = \
+            str(self.generate_max_new)
         # Autoscaler: the platform constructs the controller from these
         # at startup (admin/autoscaler.py Autoscaler.from_env); the
         # enable flag is popped when off so "absent = disabled" stays

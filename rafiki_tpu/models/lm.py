@@ -441,6 +441,18 @@ class JaxTransformerLM(BaseModel):
                 lp[:n], jnp.asarray(ids[1:, None]), axis=-1).mean()))
         return out
 
+    def make_generator(self, **cfg: Any):
+        """Token-level generation engine over this model's trained
+        params: paged KV cache, AOT prefill/decode split, per-step
+        admission. See :mod:`rafiki_tpu.models.lm_generate` — the
+        serving plane (worker decode scheduler) is the intended
+        caller; ``cfg`` passes through to :class:`LMGenerator`
+        (``page_size``, ``n_pages``, ``decode_batch``, ...)."""
+        from .lm_generate import LMGenerator
+        assert self._params is not None, \
+            "train() or load_parameters() first"
+        return LMGenerator(self, **cfg)
+
     def _ensure_predict_fn(self):
         assert self._params is not None, "train() or load_parameters() first"
         if self._params_dev is None:

@@ -11,6 +11,16 @@ trials inside ``Array._value``).
 (compiled once per tree signature, cached), ONE readback per dtype,
 then a host-side split. The same ~30 MB moves in 1-3 transfers instead
 of hundreds.
+
+``make_host_stager`` is the host→device counterpart for the generative
+decode loop's per-step token upload: it probes whether the runtime can
+route the hop through a genuinely pinned (page-locked) host staging
+buffer (TPU runtimes expose it as the ``pinned_host`` memory kind;
+a pageable source forces the runtime to bounce through its own pinned
+pool first) and falls back silently to a plain ``device_put`` where
+the memory space doesn't exist. The worker records which path is live
+in its bus registration (``staging``) so bench artifacts can tell what
+was measured.
 """
 
 from __future__ import annotations
@@ -76,3 +86,33 @@ def device_get_tree(tree: Any) -> Any:
             out[i] = flat[offset:offset + n].reshape(shape)
             offset += n
     return jax.tree.unflatten(treedef, out)
+
+
+def make_host_stager(sharding) -> Tuple[Any, str]:
+    """Build the host→device staging callable for small per-step
+    uploads (the decode loop's next-token ids).
+
+    Returns ``(stage, mode)``: ``stage(np_array)`` places the array
+    under ``sharding``; ``mode`` is ``"pinned"`` when the hop rides a
+    page-locked host buffer (``pinned_host`` memory kind, probed once
+    here with a real round-trip so a runtime that ADVERTISES the space
+    but can't transfer through it still falls back) or ``"pageable"``
+    for the plain ``device_put`` path. The probe is deliberately
+    silent on failure — CPU meshes and older runtimes simply don't
+    have the memory space, and that is not an error.
+    """
+    try:
+        pinned = sharding.with_memory_kind("pinned_host")
+        probe = jax.device_put(
+            jax.device_put(np.zeros((4,), np.int32), pinned), sharding)
+        jax.block_until_ready(probe)
+
+        def stage(arr):
+            return jax.device_put(jax.device_put(arr, pinned), sharding)
+
+        return stage, "pinned"
+    except Exception:
+        def stage(arr):
+            return jax.device_put(arr, sharding)
+
+        return stage, "pageable"

@@ -26,6 +26,8 @@ one-scatter-per-request path (the bench's A/B comparison rides this).
 
 from __future__ import annotations
 
+import itertools
+import json
 import os
 import threading
 import time
@@ -39,7 +41,7 @@ from ..observe import ServingStats, trace
 from ..observe import attribution as _attr
 from ..observe import workload as _workload
 from ..store import MetaStore
-from ..utils.service import JsonHttpServer
+from ..utils.service import JsonHttpServer, StreamResponse
 from .batcher import Backpressure, MicroBatcher
 from .edge_cache import EdgeCache, query_key
 from .predictor import Predictor
@@ -160,10 +162,14 @@ class PredictorService:
                 queue_cap=_qcap,
                 client_share=_share,
                 stats=self.stats)
+        # Generate-worker round robin (replicas of a generative bin
+        # each run their own decode loop; spread streams across them).
+        self._gen_rr = itertools.count()
         self._http = JsonHttpServer([
             ("GET", "/", self._health),
             ("GET", "/stats", self._stats),
             ("POST", "/predict", self._predict),
+            ("POST", "/generate", self._generate),
             ("POST", "/cache/invalidate", self._cache_invalidate),
         ], host=host, port=port,
             # Same per-INSTANCE uniqueness rule as the stats label (and
@@ -409,6 +415,87 @@ class PredictorService:
                         self._direct_pending[client] = left
                     else:
                         self._direct_pending.pop(client, None)
+
+    def _pick_generate_worker(self) -> Optional[str]:
+        """Round-robin over workers advertising ``gen`` in their bus
+        registration (the engine geometry a generative bin publishes);
+        None when the job has no token-capable worker."""
+        info = self.predictor.cache.running_worker_info(
+            self.inference_job_id)
+        gens = sorted(w for w, i in info.items()
+                      if isinstance(i, dict) and i.get("gen"))
+        if not gens:
+            return None
+        return gens[next(self._gen_rr) % len(gens)]
+
+    def _generate(self, params, body, ctx):
+        """Token generation, streamed: ``{"tokens": [...], "max_new":
+        N, "temperature": t, "seed": s, "eos": id}`` → one NDJSON line
+        per token frame (``{"seq": k, "tok": [t], "done": ...}``, the
+        final line carrying ``finish`` + ``n_tokens``). The request
+        rides the bus to ONE generate-capable worker whose decode loop
+        admits it between steps; frames stream back through the reply
+        queue and out of this handler as HTTP chunks while later
+        tokens are still decoding. Prompt-prefix reuse happens
+        worker-side (the engine's content-addressed prefix cache), so
+        repeated prompts skip prefill without any edge coordination."""
+        if not body or not isinstance(body.get("tokens"), list) \
+                or not body["tokens"]:
+            return 400, {"error":
+                         "body needs 'tokens' (non-empty id list)"}
+        try:
+            tokens = [int(t) for t in body["tokens"]]
+            max_new = int(body.get("max_new") or 16)
+            temperature = float(body.get("temperature") or 0.0)
+            seed = int(body.get("seed") or 0)
+            eos = (int(body["eos"])
+                   if body.get("eos") is not None else None)
+        except (TypeError, ValueError):
+            return 400, {"error": "malformed generation parameters"}
+        worker = self._pick_generate_worker()
+        if worker is None:
+            return 503, {"error": "no generate-capable worker "
+                                  "registered for this job"}
+        cache = self.predictor.cache
+        qid = cache.send_generate(worker, tokens, max_new=max_new,
+                                  temperature=temperature, seed=seed,
+                                  eos=eos)
+        client = (ctx.headers.get(self.client_header)
+                  if self.client_header else None)
+        tenant = _attr.tenant_key(client) if self._attribution else None
+        record = (_workload.open_request(self.inference_job_id, tenant,
+                                         1)
+                  if self._workload else None)
+        timeout = self._handler_timeout()
+
+        def frames():
+            t0 = time.monotonic()
+            deadline = t0 + timeout
+            status, done = 200, False
+            try:
+                while not done and time.monotonic() < deadline:
+                    for fr in cache.pop_token_frames(qid, timeout=0.25):
+                        if fr.get("finish") == "error":
+                            status = 502
+                        yield json.dumps(fr) + "\n"
+                        if fr.get("done"):
+                            done = True
+                if not done:
+                    status = 504
+                    yield json.dumps({"done": True,
+                                      "finish": "timeout"}) + "\n"
+            finally:
+                # Runs on client disconnect too (StreamResponse closes
+                # the iterator): the workload record reflects what the
+                # stream actually did.
+                dur = time.monotonic() - t0
+                _workload.commit(record, status, dur)
+                if tenant and status == 200:
+                    _attr.account_admitted(tenant)
+                    _attr.account_tenant_latency(
+                        tenant, dur, service=self.stats.service)
+
+        return 200, StreamResponse("application/x-ndjson", frames())
 
     def _predict(self, params, body, ctx):
         if not body:

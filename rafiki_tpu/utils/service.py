@@ -83,6 +83,23 @@ class RawResponse:
         self.data = data.encode() if isinstance(data, str) else data
 
 
+class StreamResponse:
+    """A handler return value streamed as chunked transfer encoding.
+
+    ``chunks`` is a LAZY iterable of str/bytes fragments — the handler
+    returns immediately and the fragments are produced while the
+    response is being written, which is what the generative token
+    stream needs (each token frame reaches the client as soon as the
+    decode loop emits it, not when the sequence finishes). A client
+    that disconnects mid-stream just ends the iteration; the
+    generator's ``finally`` still runs (commit hooks ride there).
+    """
+
+    def __init__(self, content_type: str, chunks):
+        self.content_type = content_type
+        self.chunks = chunks
+
+
 def _compile(path: str) -> re.Pattern:
     # "/train_jobs/<id>/stop" -> ^/train_jobs/(?P<id>[^/]+)/stop$
     pattern = re.sub(r"<(\w+)>", r"(?P<\1>[^/]+)", path)
@@ -289,6 +306,9 @@ class JsonHttpServer:
 
             def _reply(self, status: int, obj: Any,
                        headers: Optional[Dict[str, str]] = None):
+                if isinstance(obj, StreamResponse):
+                    self._reply_stream(status, obj, headers)
+                    return
                 if isinstance(obj, RawResponse):
                     data, ctype = obj.data, obj.content_type
                 else:
@@ -300,6 +320,39 @@ class JsonHttpServer:
                     self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _reply_stream(self, status: int, obj: "StreamResponse",
+                              headers: Optional[Dict[str, str]] = None):
+                """Chunked transfer: one HTTP chunk per produced
+                fragment, flushed immediately so latency-bound streams
+                (token frames) reach the client per fragment. A broken
+                pipe (client gone) stops the iteration and closes the
+                connection; the source iterator is always closed so
+                its ``finally`` blocks run."""
+                self.send_response(status)
+                self.send_header("Content-Type", obj.content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                it = iter(obj.chunks)
+                try:
+                    for chunk in it:
+                        if isinstance(chunk, str):
+                            chunk = chunk.encode()
+                        if not chunk:
+                            continue
+                        self.wfile.write(b"%x\r\n" % len(chunk)
+                                         + chunk + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    self.close_connection = True
+                finally:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
 
             def do_GET(self):
                 self._dispatch("GET")

@@ -29,6 +29,7 @@ from ..cache import RESTACK_KEY as _CACHE_RESTACK_KEY
 from ..cache import WIRE_NDBATCH, Cache
 from ..constants import ServiceStatus
 from ..observe import attribution as _attr
+from ..observe import lm as _lm_obs
 from ..observe import trace
 from ..observe import wire as _wire
 from ..parallel.chips import ChipGroup
@@ -407,6 +408,26 @@ class InferenceWorker:
         self._stacked_req = _wire.stacked_mode()
         self._stacked_active = False
         self._stager = _HostStager()
+        # Generative serving (token-level continuous batching):
+        # gate + engine geometry snapshotted at construction
+        # (NodeConfig knobs; env is the transport, like every serving
+        # knob above). The engine and its decode loop are built in
+        # run() AFTER the model loads — and only when the model
+        # exposes make_generator; classifier bins ignore all of this.
+        self._gen_enabled = _lm_obs.generate_enabled()
+        self._gen_cfg = {
+            "page_size": int(os.environ.get(
+                "RAFIKI_TPU_GENERATE_PAGE_SIZE", "16")),
+            "n_pages": int(os.environ.get(
+                "RAFIKI_TPU_GENERATE_POOL_PAGES", "256")),
+            "decode_batch": int(os.environ.get(
+                "RAFIKI_TPU_GENERATE_DECODE_BATCH", "8")),
+            "max_new_cap": int(os.environ.get(
+                "RAFIKI_TPU_GENERATE_MAX_NEW", "128")),
+        }
+        self._gen_sched: Optional[Any] = None
+        self._gen_thread: Optional[threading.Thread] = None
+        self._staging_mode: Optional[str] = None
         # Broker-REPORTED op failures (BusOpError) this many times in a
         # row — with zero successful iterations in between — mean
         # protocol skew, not an outage: the serve loop escalates to
@@ -569,6 +590,14 @@ class InferenceWorker:
             # to scrape).
             from ..constants import EnvVars as _EnvVars
 
+            # "gen" advertises token-level generation capability (the
+            # engine geometry a Predictor's /generate route needs to
+            # pick a worker); None for classifier bins or when the
+            # gate is off. "staging" records which host→device path
+            # the per-step token upload actually took (pinned vs
+            # pageable — bench evidence, not negotiation).
+            gen_info = self._start_generate() if self._gen_enabled \
+                else None
             self._reg_info = {"trial_id": self.trial_id,
                               "pipeline": bool(self.pipeline),
                               "sync_latency_ms": sync_ms,
@@ -577,6 +606,8 @@ class InferenceWorker:
                               "quant": (self._quant_req
                                         if self._quant_active else None),
                               "stacked": self._stacked_active,
+                              "gen": gen_info,
+                              "staging": self._staging_mode,
                               "metrics": os.environ.get(
                                   _EnvVars.METRICS_ADDR) or None}
             self.cache.register_worker(self.inference_job_id,
@@ -663,6 +694,17 @@ class InferenceWorker:
                                  if _CACHE_PROFILE_KEY not in it]
                         for p in profiles:
                             self._start_profile(p)
+                    # Token-generation requests route to the decode
+                    # scheduler's admission queue and return
+                    # immediately — the decode loop owns them from
+                    # here; classifier bursts below are untouched.
+                    gens = [it for it in items
+                            if it.get("op") == "generate"]
+                    if gens:
+                        items = [it for it in items
+                                 if it.get("op") != "generate"]
+                        for g in gens:
+                            self._route_generate(g)
                     handle = (self._dispatch_batch(items) if items
                               else None)
                     for r in restacks:
@@ -717,6 +759,7 @@ class InferenceWorker:
             if pending is not None:
                 self._complete_batch(*pending)
             self._stop_profile()
+            self._stop_generate()
             self._close_attr_owner()
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.STOPPED)
@@ -733,6 +776,7 @@ class InferenceWorker:
             # for the process's life (every later trial trace blocked,
             # the tenant rollup never cleared) — release those.
             self._stop_profile()
+            self._stop_generate()
             self._close_attr_owner()
             _log.error("inference worker %s: injected crash; dying "
                        "hard (row left RUNNING, registration stale)",
@@ -741,6 +785,7 @@ class InferenceWorker:
         except Exception:
             _log.exception("inference worker %s crashed", self.service_id)
             self._stop_profile()
+            self._stop_generate()
             self._close_attr_owner()
             self.meta.update_service(self.service_id,
                                      status=ServiceStatus.ERRORED)
@@ -748,6 +793,82 @@ class InferenceWorker:
             raise
         else:
             self._unregister_best_effort()
+
+    # --- Generative serving (token-level decode loop) ---
+
+    def _start_generate(self) -> Optional[dict]:
+        """Build the paged-KV engine and its continuous-batching loop
+        for a generate-enabled bin; returns the registration payload
+        (engine geometry) or None when this bin can't serve tokens —
+        never fatal: a classifier bin with the gate on just serves
+        classification, and an engine-construction failure degrades the
+        same way (logged, advertised as non-generative)."""
+        make = getattr(self._model, "make_generator", None)
+        if make is None:
+            _log.info("inference worker %s: generate gate on but %s "
+                      "has no make_generator; serving without it",
+                      self.service_id, type(self._model).__name__)
+            return None
+        try:
+            from ..parallel.mesh import replicated
+            from ..parallel.transfer import make_host_stager
+            from .decode_scheduler import DecodeScheduler
+
+            stager, self._staging_mode = make_host_stager(
+                replicated(self._model.mesh))
+            engine = make(stager=stager, **self._gen_cfg)
+            self._gen_sched = DecodeScheduler(engine, self.cache,
+                                              self.service_id)
+        except Exception:
+            _log.exception("inference worker %s: generate engine "
+                           "construction failed; serving without it",
+                           self.service_id)
+            self._gen_sched = None
+            self._staging_mode = None
+            return None
+        self._gen_thread = threading.Thread(
+            target=self._gen_sched.loop,
+            name=f"decode-{self.service_id[:8]}", daemon=True)
+        self._gen_thread.start()
+        _log.info("inference worker %s: generative serving up "
+                  "(decode_batch=%d, pool=%d pages x %d tokens, "
+                  "staging=%s)", self.service_id,
+                  self._gen_cfg["decode_batch"],
+                  self._gen_cfg["n_pages"], self._gen_cfg["page_size"],
+                  self._staging_mode)
+        return dict(self._gen_cfg)
+
+    def _route_generate(self, item: dict) -> None:
+        """Hand one popped generate frame to the decode scheduler; a
+        bin not serving tokens answers with a terminal error frame so
+        the client fails fast instead of timing out."""
+        if self._gen_sched is not None:
+            self._gen_sched.submit(item)
+            return
+        qid = item.get("query_id")
+        if qid:
+            try:
+                self.cache.send_token_frame(
+                    qid, self.service_id,
+                    {"seq": 0, "tok": [], "done": True,
+                     "finish": "error", "n_tokens": 0,
+                     "error": "generative serving not available on "
+                              "this worker"})
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+
+    def _stop_generate(self) -> None:
+        """Idempotent decode-loop teardown (every run() exit path):
+        stop the loop, join its thread, release the engine's pages."""
+        sched, self._gen_sched = self._gen_sched, None
+        thread, self._gen_thread = self._gen_thread, None
+        if sched is None:
+            return
+        try:
+            sched.close(join=thread)
+        except Exception:
+            _log.exception("inference worker %s: decode loop "
+                           "teardown failed", self.service_id)
 
     def _restack_member(self, req: Any) -> None:
         """Apply one promote-path restack request (``{"old": tid,

@@ -37,3 +37,40 @@ class UntypedOwner:
         self._t = threading.Thread(target=self.consumer.loop,
                                    daemon=True)
         self._t.start()
+
+
+class SubmitGuardedOwner:
+    """FP guard (executor form): the pool-submitted loop and callers
+    share ``_seen`` under the consumer's own lock — a submit-
+    registered cross-class root must honor held sets exactly like a
+    Thread-registered one."""
+
+    def __init__(self, pool):
+        self.consumer = GuardedConsumer()
+        self._pool = pool
+        self._pool.submit(self.consumer.loop)
+
+
+class Tracker:
+    def __init__(self):
+        self._notes = []
+
+    def note(self, x):
+        self._notes.append(x)
+
+    def notes(self):
+        return list(self._notes)
+
+
+class RouterOwner:
+    """FP guard (receiver shape): ``submit`` on a NON-executor
+    receiver is an app method, not a thread hop — ``Tracker.note``
+    must NOT become a root (its unguarded ``_notes`` would otherwise
+    read as a cross-root race)."""
+
+    def __init__(self):
+        self.tracker = Tracker()
+        self.router = object()
+
+    def route(self, x):
+        self.router.submit(self.tracker.note, x)

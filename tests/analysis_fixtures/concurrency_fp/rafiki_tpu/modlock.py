@@ -33,3 +33,24 @@ def export(path):
         snap = dict(_cache)
     with open(path, "w", encoding="utf-8") as f:
         f.write(str(snap))
+
+
+class ModuleLockedPoller:
+    """FP guard for module-global locks inside a CLASS: the loop
+    thread and callers share ``_latest`` under ``_CACHE_LOCK`` — a
+    bare-Name module lock in a method guards exactly like an own
+    lock, so this must not read as an unguarded cross-root race."""
+
+    def __init__(self):
+        self._latest = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            with _CACHE_LOCK:
+                self._latest = get("k")
+
+    def peek(self):
+        with _CACHE_LOCK:
+            return self._latest

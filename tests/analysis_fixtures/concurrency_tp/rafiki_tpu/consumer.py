@@ -16,3 +16,20 @@ class BusConsumer:
 
     def snapshot(self):
         return self._seen
+
+
+class SubmitConsumer:
+    """Same blindness, executor form: ``drain`` is only ever run via
+    the OWNER's ``self._pool.submit(self.stage.drain)`` — no Thread()
+    anywhere — yet its unguarded ``_polled`` is written by that pool
+    thread and read by callers."""
+
+    def __init__(self):
+        self._polled = 0
+
+    def drain(self):
+        while True:
+            self._polled += 1
+
+    def polled(self):
+        return self._polled

@@ -3,7 +3,7 @@ builds the consumer AND the thread that runs its loop."""
 
 import threading
 
-from .consumer import BusConsumer
+from .consumer import BusConsumer, SubmitConsumer
 
 
 class ConsumerOwner:
@@ -12,3 +12,14 @@ class ConsumerOwner:
         self._t = threading.Thread(target=self.consumer.loop,
                                    daemon=True)
         self._t.start()
+
+
+class StageOwner:
+    """The registering half of the executor-submit cross-class TP:
+    the owner builds the consumer and hops ``drain`` onto a pool
+    thread — a root the consumer's own class never shows."""
+
+    def __init__(self, pool):
+        self.stage = SubmitConsumer()
+        self._pool = pool
+        self._pool.submit(self.stage.drain)

@@ -168,6 +168,11 @@ def test_concurrency_true_positives(tmp_path):
     # self.consumer.loop); the finding lands on the CONSUMER's class.
     cc = by_anchor["BusConsumer._seen:cross-root"]
     assert "'loop'" in cc.message and cc.path.endswith("consumer.py")
+    # Executor form of the same blindness: the owner's
+    # pool.submit(self.stage.drain) makes drain a root on the
+    # consumer's class too.
+    sc = by_anchor["SubmitConsumer._polled:cross-root"]
+    assert "'drain'" in sc.message and sc.path.endswith("consumer.py")
     # Module-global lock, chained blocking (free functions only the
     # whole-program pass can see)...
     mg = by_anchor["publish->_settle:time.sleep()"]
@@ -550,6 +555,31 @@ def test_unguarded_cross_thread_write_fails_suite(tmp_path):
     cross = [f for f in report.new if f.code == "RTA106"]
     assert any(f.anchor == "_PersistStage._pending:cross-root"
                for f in cross), [f.render() for f in report.new]
+
+
+def test_unguarded_decode_admission_queue_fails_suite(tmp_path):
+    """r18 invariant: DecodeScheduler._pending is the ONE piece of
+    state shared between the serve-loop thread (submit) and the decode
+    loop — a thread the scheduler never constructs itself
+    (InferenceWorker registers Thread(target=self._gen_sched.loop)),
+    so only the cross-class root inventory can see the pair. Stripping
+    the Condition must turn the suite red via RTA106."""
+    for name, reps in (
+            ("clean", []),
+            ("mut", [("with self._cv:", "if True:")])):
+        root = _mutated_tree(tmp_path / name,
+                             "rafiki_tpu/worker/decode_scheduler.py",
+                             reps, dst_name="worker/decode_scheduler.py")
+        _mutated_tree(tmp_path / name, "rafiki_tpu/worker/inference.py",
+                      [], dst_name="worker/inference.py")
+        report = run_suite(root, only=["concurrency"])
+        cross = [f for f in report.new if f.code == "RTA106" and
+                 f.anchor == "DecodeScheduler._pending:cross-root"]
+        if name == "clean":
+            assert cross == [], [f.render() for f in cross]
+        else:
+            assert cross, [f.render() for f in report.new]
+            assert "'loop'" in cross[0].message
 
 
 def test_blocking_under_module_lock_fails_suite(tmp_path):

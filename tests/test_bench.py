@@ -167,3 +167,13 @@ def test_sweep_heavy_configs_run_on_cpu_mesh():
         assert sub["value"] > 0
         assert sub["platform"] == "cpu"
         assert sub["vs_baseline"] is None
+
+
+def test_lm_serving_config_registered_outside_sweep():
+    """lm-serving is a counter-judged gate (docs/serving.md
+    "Benchmarking it"), runnable via --config but never part of the
+    platform sweep — same policy as analysis/chaos/autoscale."""
+    fn, metric, unit = bench._CONFIGS["lm-serving"]
+    assert metric == "lm_serving_tokens_per_sec" and unit == "tokens/s"
+    assert fn is bench.main_lm_serving
+    assert "lm-serving" not in bench._SWEEP_ORDER

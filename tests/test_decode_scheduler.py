@@ -1,0 +1,314 @@
+"""Continuous-batching decode scheduler + the generative edge path.
+
+Three layers pinned here:
+
+- **DecodeScheduler** (worker/decode_scheduler.py): bus frame →
+  admission queue → engine steps → ordered token frames on the reply
+  queue, including per-step admission (short requests finish while a
+  long one is still resident) and worker-side prefix reuse.
+- **Metrics gating** (observe/lm.py): the ``rafiki_tpu_lm_*`` family
+  exists ONLY when ``RAFIKI_TPU_SERVING_GENERATE`` is on — the off
+  side exposes zero series (asserted FIRST, before any test registers
+  the family in the process registry).
+- **Edge streaming** (predictor/app.py + utils/service.py): ``POST
+  /generate`` streams NDJSON token frames as chunked HTTP while the
+  stream is still being produced (proven with a gated fake worker —
+  the client reads the first frame BEFORE the last one exists).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.bus.memory import MemoryBus
+from rafiki_tpu.cache import Cache
+from rafiki_tpu.models import JaxTransformerLM
+from rafiki_tpu.observe import lm as obs_lm
+from rafiki_tpu.observe import metrics as obs_metrics
+from rafiki_tpu.worker.decode_scheduler import DecodeScheduler
+
+TINY = {"d_model": 256, "n_layers": 2, "seq_len": 256, "batch_size": 2,
+        "learning_rate": 1e-3, "train_steps": 20, "vocab_size": 512,
+        "quick_train": False}
+
+LM_FAMILIES = (
+    "rafiki_tpu_lm_time_to_first_token_seconds",
+    "rafiki_tpu_lm_inter_token_seconds",
+    "rafiki_tpu_lm_tokens_total",
+    "rafiki_tpu_lm_decode_dispatches_total",
+    "rafiki_tpu_lm_prefill_total",
+    "rafiki_tpu_lm_preemptions_total",
+    "rafiki_tpu_lm_kv_pool_used_ratio",
+    "rafiki_tpu_lm_resident_tokens",
+)
+
+
+# --- gating: the OFF side first (no family registered yet) -----------
+
+
+def test_disabled_gate_exposes_zero_lm_series(monkeypatch):
+    monkeypatch.delenv(obs_lm.GENERATE_ENV, raising=False)
+    obs_lm.reset_for_tests()
+    assert not obs_lm.serving()
+    # Observations while off are free no-ops, not lazy registrations.
+    obs_lm.observe_ttft(0.1)
+    obs_lm.count_tokens(5)
+    obs_lm.set_pool_used(0.5)
+    for name in LM_FAMILIES:
+        assert obs_metrics.registry().find(name) is None, \
+            f"{name} registered while the gate is off"
+    obs_lm.reset_for_tests()
+
+
+def test_generate_enabled_spellings():
+    assert not obs_lm.generate_enabled("")
+    assert not obs_lm.generate_enabled("0")
+    assert not obs_lm.generate_enabled("false")
+    assert not obs_lm.generate_enabled("off")
+    assert obs_lm.generate_enabled("1")
+    assert obs_lm.generate_enabled("true")
+
+
+# --- scheduler over a real engine ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    m = JaxTransformerLM(**JaxTransformerLM.validate_knobs(TINY))
+    m._params = m._init_params()
+    yield m
+    m.destroy()
+
+
+@pytest.fixture()
+def sched(lm):
+    bus = MemoryBus()
+    cache = Cache(bus)
+    eng = lm.make_generator(page_size=4, n_pages=64, decode_batch=2,
+                            max_new_cap=16, prefix_cache_entries=4)
+    s = DecodeScheduler(eng, cache, "w1", idle_wait=0.005)
+    t = threading.Thread(target=s.loop, daemon=True)
+    t.start()
+    yield s, cache
+    s.close(join=t)
+
+
+def _submit(sched, cache, tokens, **kw):
+    """Client + worker-loop halves: enqueue a generate frame, pop it
+    the way InferenceWorker's serve loop would, hand it to the
+    scheduler. Returns the query id the frames stream to."""
+    qid = cache.send_generate("w1", tokens, **kw)
+    items = cache.pop_queries("w1", timeout=1.0)
+    assert len(items) == 1 and items[0].get("op") == "generate"
+    sched.submit(items[0])
+    return qid
+
+
+def _collect(cache, qid, timeout=60.0):
+    frames = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for fr in cache.pop_token_frames(qid, timeout=0.1):
+            frames.append(fr)
+            if fr.get("done"):
+                return frames
+    raise AssertionError(f"stream {qid} did not finish: {frames}")
+
+
+def test_stream_end_to_end_and_prefix_reuse(sched):
+    s, cache = sched
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, 512, size=9).tolist()
+
+    qid = _submit(s, cache, prompt, max_new=6, temperature=0.0)
+    frames = _collect(cache, qid)
+    assert [f["seq"] for f in frames] == list(range(len(frames)))
+    toks = [t for f in frames for t in f["tok"]]
+    assert len(toks) == 6  # max_new incl. the admit-time token
+    assert frames[-1]["done"] and frames[-1]["finish"] == "length"
+    assert frames[-1]["n_tokens"] == 6
+    assert all(not f["done"] for f in frames[:-1])
+
+    # Same prompt again: greedy determinism end to end AND the
+    # worker-side prefix cache skips the second prefill entirely.
+    skipped0 = s.engine.prefill_skipped_total
+    qid2 = _submit(s, cache, prompt, max_new=6, temperature=0.0)
+    frames2 = _collect(cache, qid2)
+    assert [t for f in frames2 for t in f["tok"]] == toks
+    assert s.engine.prefill_skipped_total == skipped0 + 1
+    assert s.served_total >= 2 and s.errors_total == 0
+
+
+def test_short_request_finishes_while_long_decodes(sched):
+    s, cache = sched
+    rng = np.random.default_rng(29)
+    p_long = rng.integers(0, 512, size=8).tolist()
+    p_short = rng.integers(0, 512, size=5).tolist()
+
+    qid_long = _submit(s, cache, p_long, max_new=14, temperature=0.0)
+    # Wait until the long request has produced at least one frame (it
+    # is resident), then admit the short one mid-decode.
+    first = _collect_partial(cache, qid_long, n=1)
+    qid_short = _submit(s, cache, p_short, max_new=3, temperature=0.0)
+    short = _collect(cache, qid_short)
+    # The short stream FINISHED; the long one is still incomplete
+    # (its remaining frames arrive afterwards) — continuous batching,
+    # not run-to-completion.
+    assert short[-1]["finish"] in ("length", "eos")
+    rest = _collect(cache, qid_long)
+    toks_long = [t for f in (first + rest) for t in f["tok"]]
+    assert len(toks_long) == 14
+    assert len([t for f in short for t in f["tok"]]) == 3
+
+
+def _collect_partial(cache, qid, n, timeout=60.0):
+    frames = []
+    deadline = time.monotonic() + timeout
+    while len(frames) < n and time.monotonic() < deadline:
+        frames.extend(cache.pop_token_frames(qid, timeout=0.1))
+    assert len(frames) >= n
+    return frames
+
+
+def test_malformed_request_answers_error_frame(sched):
+    s, cache = sched
+    s.submit({"query_id": "bad-1", "gen": {"tokens": []}})
+    frames = _collect(cache, "bad-1", timeout=5.0)
+    assert frames[-1]["finish"] == "error" and frames[-1]["done"]
+
+
+def test_enabled_gate_registers_and_counts(sched, monkeypatch):
+    monkeypatch.setenv(obs_lm.GENERATE_ENV, "1")
+    obs_lm.reset_for_tests()
+    try:
+        if not obs_metrics.metrics_enabled():
+            pytest.skip("metrics disabled in this environment")
+        assert obs_lm.serving()
+        s, cache = sched
+        prompt = list(range(40, 49))
+        qid = _submit(s, cache, prompt, max_new=4, temperature=0.0)
+        _collect(cache, qid)
+        reg = obs_metrics.registry()
+        tokens = reg.find("rafiki_tpu_lm_tokens_total")
+        dispatches = reg.find("rafiki_tpu_lm_decode_dispatches_total")
+        assert tokens is not None and dispatches is not None
+        n_tok = sum(v for _, v in tokens.samples())
+        n_disp = sum(v for _, v in dispatches.samples())
+        assert n_tok >= 4 and n_disp >= 1
+        assert reg.find(
+            "rafiki_tpu_lm_time_to_first_token_seconds") is not None
+    finally:
+        obs_lm.reset_for_tests()
+
+
+# --- the HTTP edge ----------------------------------------------------
+
+
+class _FakeGenWorker:
+    """A registration + reply-queue impersonation of a generative
+    worker: answers each generate frame with ``max_new`` token frames.
+    ``gate`` (when given) is waited on before the FINAL frame — the
+    streaming test uses it to prove frames reach the client before the
+    stream is complete."""
+
+    def __init__(self, bus, job_id, worker_id="gw1", gate=None):
+        self.cache = Cache(bus)
+        self.worker_id = worker_id
+        self.gate = gate
+        self.cache.register_worker(job_id, worker_id,
+                                   info={"gen": {"decode_batch": 2}})
+        self.stop_flag = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self.stop_flag.is_set():
+            for it in self.cache.pop_queries(self.worker_id,
+                                             timeout=0.1):
+                if it.get("op") != "generate":
+                    continue
+                qid = it["query_id"]
+                n = it["gen"]["max_new"]
+                for k in range(n):
+                    if k == n - 1 and self.gate is not None:
+                        assert self.gate.wait(timeout=10.0)
+                    fr = {"seq": k, "tok": [100 + k],
+                          "done": k == n - 1}
+                    if k == n - 1:
+                        fr.update(finish="length", n_tokens=n)
+                    self.cache.send_token_frame(qid, self.worker_id,
+                                                fr)
+
+    def stop(self):
+        self.stop_flag.set()
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture()
+def edge():
+    from rafiki_tpu.predictor.app import PredictorService
+
+    bus = MemoryBus()
+    svc = PredictorService("gsvc", "gjob", meta=None, bus=bus,
+                           host="127.0.0.1", microbatch=False)
+    svc.predictor.worker_wait_timeout = 5.0
+    svc.predictor.gather_timeout = 5.0
+    svc._http.start()
+    yield svc, bus
+    svc._http.stop()
+    svc.stats.close()
+    svc.predictor.close()
+
+
+def _post(port, path, payload, timeout=15.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_generate_route_streams_ndjson(edge):
+    svc, bus = edge
+    gate = threading.Event()
+    worker = _FakeGenWorker(bus, "gjob", gate=gate)
+    try:
+        resp = _post(svc.port, "/generate",
+                     {"tokens": [1, 2, 3], "max_new": 3})
+        assert resp.status == 200
+        assert "ndjson" in resp.headers.get("Content-Type", "")
+        # The FIRST frame arrives while the final one does not yet
+        # exist (the worker is gated): streaming, not buffering.
+        line1 = json.loads(resp.readline())
+        assert line1["tok"] == [100] and not line1["done"]
+        gate.set()
+        rest = [json.loads(ln) for ln in resp.read().splitlines()]
+        assert rest[-1]["done"] and rest[-1]["finish"] == "length"
+        assert [f["tok"][0] for f in [line1] + rest] == [100, 101, 102]
+    finally:
+        worker.stop()
+
+
+def test_generate_route_rejects_without_capable_worker(edge):
+    svc, bus = edge
+    # A classifier-only worker (no "gen" in its registration) must not
+    # be picked.
+    Cache(bus).register_worker("gjob", "plainw", info={"trial_id": "t"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(svc.port, "/generate", {"tokens": [1], "max_new": 2})
+    assert e.value.code == 503
+
+
+def test_generate_route_validates_body(edge):
+    svc, _bus = edge
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(svc.port, "/generate", {"tokens": []})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(svc.port, "/generate", {"tokens": [1], "max_new": "x"})
+    assert e.value.code == 400
