@@ -3402,6 +3402,314 @@ def main_replay() -> dict:
         ab_actions_predictive=predictive["actions"])
 
 
+def main_cluster() -> dict:
+    """Config[cluster]: the cluster serving fabric, counter-judged
+    (docs/cluster.md). Never joins the sweep — it is a topology + A/B
+    gate, not a throughput figure. Three phases, strict order (the
+    zero-series assertion must run before any phase registers cluster
+    series):
+
+    - **OFF baseline** (zero-series contract): fabric disabled, two
+      frontends each recompute every unique key themselves — cluster
+      recompute == frontends x uniques, and NO node/relay/fabric
+      series exist in the registry.
+    - **Relay**: two peered per-node brokers; a remote-node sharded
+      scatter pays exactly ONE inter-node hop per leg (the
+      ``rafiki_tpu_bus_relay_total{direction="out"}`` delta is 1 for
+      the query leg and 1 for the reply leg), and a dead peer degrades
+      to the local-fallback path without wedging the sender.
+    - **ON**: the same workload with the fabric armed — every unique
+      key is computed ONCE cluster-wide (the second frontend's misses
+      convert to peer hits), and a promote-path invalidation on one
+      frontend gossips to the other, whose next query provably MISSES
+      and rescatters.
+
+    Headline: recompute_off / recompute_on (2.0 for two frontends =
+    the fabric halved duplicate chip-seconds).
+    """
+    import threading
+    import urllib.request
+
+    import requests
+
+    from rafiki_tpu.bus import connect, serve_broker
+    from rafiki_tpu.bus.memory import MemoryBus
+    from rafiki_tpu.cache import Cache, encode_payload
+    from rafiki_tpu.observe.metrics import registry
+    from rafiki_tpu.predictor.app import PredictorService
+
+    fabric_env = "RAFIKI_TPU_CLUSTER_FABRIC"
+    saved_env = os.environ.pop(fabric_env, None)
+    uniques = 8
+    hot_tail = 6  # extra queries of the hottest key per frontend
+
+    def start_worker(cache: Cache, worker_id: str, served: dict,
+                     stop: threading.Event) -> threading.Thread:
+        def loop() -> None:
+            while not stop.is_set():
+                for it in cache.pop_queries(worker_id, timeout=0.1):
+                    n = len(it["queries"])
+                    served["n"] += n
+                    cache.send_prediction_batch(
+                        it["batch_id"], worker_id, [[0.8, 0.2]] * n,
+                        shard=it.get("shard"), compute_s=0.001 * n,
+                        origin_node=it.get("onode"))
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+    def make_frontend(bus, sid: str, job: str) -> PredictorService:
+        svc = PredictorService(sid, job, meta=None, bus=bus,
+                               host="127.0.0.1", cache_bytes=1 << 20,
+                               cache_admit_after=1, microbatch=False)
+        svc.predictor.worker_wait_timeout = 10.0
+        svc.predictor.gather_timeout = 10.0
+        svc._http.start()
+        if svc._fabric:  # what start() would do, minus the meta store
+            svc.predictor.cache.register_frontend(
+                job, svc.stats.service, f"127.0.0.1:{svc.port}")
+        return svc
+
+    def stop_frontend(svc: PredictorService, job: str) -> None:
+        # Manual teardown (stop() updates the meta store we don't have).
+        if svc._fabric:
+            svc.predictor.cache.unregister_frontend(
+                job, svc.stats.service)
+        svc._http.stop()
+        svc.stats.close()
+        svc.predictor.close()
+        svc.edge_cache.close()
+        if svc._m_fabric is not None:
+            svc._m_fabric.remove(service=svc.stats.service)
+
+    def post(svc: PredictorService, path: str, payload: dict) -> dict:
+        r = requests.post(f"http://127.0.0.1:{svc.port}{path}",
+                          json=payload, timeout=30)
+        r.raise_for_status()
+        return r.json()
+
+    def fabric_events(svc: PredictorService) -> dict:
+        c = registry().find("rafiki_tpu_serving_fabric_total")
+        if c is None:
+            return {}
+        return {lab["event"]: int(v) for lab, v in c.samples()
+                if lab.get("service") == svc.stats.service}
+
+    def run_workload(frontends, keys) -> None:
+        # Every frontend sees every key once (frontend-major, so the
+        # second frontend's first touch is always a fabric-probe
+        # opportunity), then a hot tail on the hottest key — the
+        # zipf head that dominates real serving traffic.
+        for svc in frontends:
+            for q in keys:
+                post(svc, "/predict", {"query": q})
+        for svc in frontends:
+            for _ in range(hot_tail):
+                post(svc, "/predict", {"query": keys[0]})
+
+    keys = [encode_payload([float(r), 1.0 + float(r)])
+            for r in range(uniques)]
+    record: dict = {}
+    try:
+        # --- Phase OFF: zero-series contract + per-frontend recompute
+        for name in ("rafiki_tpu_serving_fabric_total",
+                     "rafiki_tpu_bus_relay_total",
+                     "rafiki_tpu_node_peers"):
+            if registry().find(name) is not None:
+                raise RuntimeError(
+                    f"{name} exists before any cluster phase ran — "
+                    "the fabric-off zero-series contract is broken")
+        bus = MemoryBus()
+        wcache = Cache(bus)
+        served = {"n": 0}
+        stop = threading.Event()
+        wcache.register_worker("job-off", "w-off",
+                               info={"trial_id": "t", "score": 0.9})
+        wt = start_worker(wcache, "w-off", served, stop)
+        fa = fb = None
+        try:
+            fa = make_frontend(bus, "cfa-off", "job-off")
+            fb = make_frontend(bus, "cfb-off", "job-off")
+            assert not fa._fabric and not fb._fabric
+            run_workload([fa, fb], keys)
+            recompute_off = served["n"]
+        finally:
+            for svc in (fa, fb):
+                if svc is not None:
+                    stop_frontend(svc, "job-off")
+            stop.set()
+            wt.join(timeout=5)
+        if recompute_off != 2 * uniques:
+            raise RuntimeError(
+                f"fabric-off recompute {recompute_off} != frontends x "
+                f"uniques {2 * uniques} — the baseline is not the "
+                "per-frontend-duplicate shape the A/B assumes")
+        if registry().find("rafiki_tpu_serving_fabric_total") is not None:
+            raise RuntimeError("fabric-off frontends registered the "
+                               "fabric series (zero-series contract)")
+
+        # --- Phase Relay: one inter-node hop per leg ------------------
+        broker_a = serve_broker("127.0.0.1", 0, native=False,
+                                node_id="vm/a")
+        broker_b = serve_broker("127.0.0.1", 0, native=False,
+                                node_id="vm/b")
+        try:
+            broker_a.add_peer("vm/b", broker_b.uri)
+            broker_b.add_peer("vm/a", broker_a.uri)
+            bus_a, bus_b = connect(broker_a.uri), connect(broker_b.uri)
+            cache_a, cache_b = Cache(bus_a), Cache(bus_b)
+            rserved = {"n": 0}
+            rstop = threading.Event()
+            cache_b.register_worker("job-r", "wb",
+                                    info={"trial_id": "t", "score": 0.9})
+            rt = start_worker(cache_b, "wb", rserved, rstop)
+            relay = registry().find("rafiki_tpu_bus_relay_total")
+            if relay is None:
+                raise RuntimeError("node-scoped brokers registered no "
+                                   "relay series")
+
+            def relay_counts() -> dict:
+                return {lab["direction"]: int(v)
+                        for lab, v in relay.samples()}
+
+            base = relay_counts()
+            bid = cache_a.send_query_shards(
+                [("wb", 0, 1, 0)], [keys[0]],
+                worker_nodes={"wb": "vm/b"}, local_node="vm/a")
+            t0 = time.monotonic()
+            while relay_counts().get("out", 0) - base.get("out", 0) < 1:
+                if time.monotonic() - t0 > 10:
+                    raise RuntimeError("query leg never relayed")
+                time.sleep(0.01)
+            after_query = relay_counts()
+            replies = cache_a.gather_prediction_batches(bid, 1,
+                                                        timeout=10.0)
+            after_reply = relay_counts()
+            query_hops = (after_query.get("out", 0) - base.get("out", 0))
+            total_hops = (after_reply.get("out", 0) - base.get("out", 0))
+            if query_hops != 1 or total_hops != 2:
+                raise RuntimeError(
+                    f"remote scatter paid {query_hops} query-leg and "
+                    f"{total_hops - query_hops} reply-leg hops; the "
+                    "relay contract is exactly one per leg "
+                    f"(counts {base} -> {after_reply})")
+            if after_reply.get("fallback", 0):
+                raise RuntimeError("healthy-peer relay took the "
+                                   "fallback path")
+            if len(replies) != 1 or rserved["n"] != 1:
+                raise RuntimeError(
+                    f"remote scatter served {rserved['n']} and "
+                    f"gathered {len(replies)} replies, expected 1/1")
+            # Dead peer: the forward degrades to the LOCAL broker
+            # without wedging the sender.
+            rstop.set()
+            rt.join(timeout=5)
+            broker_b.stop()
+            t0 = time.monotonic()
+            bus_a.relay_push("vm/b", "dead-q", {"v": 42})
+            dead_elapsed = time.monotonic() - t0
+            fb_delta = (relay_counts().get("fallback", 0)
+                        - after_reply.get("fallback", 0))
+            landed = bus_a.pop("dead-q", timeout=2.0)
+            if fb_delta != 1 or landed != {"v": 42}:
+                raise RuntimeError(
+                    f"dead-peer relay: fallback delta {fb_delta}, "
+                    f"local delivery {landed!r} — expected 1 and the "
+                    "pushed frame")
+            relay_record = {
+                "relay_out": after_reply.get("out", 0),
+                "relay_in": after_reply.get("in", 0),
+                "relay_fallback_after_death": fb_delta,
+                "dead_peer_send_s": round(dead_elapsed, 3),
+            }
+        finally:
+            broker_b.stop()
+            broker_a.stop()
+
+        # --- Phase ON: fabric A/B over the same workload --------------
+        os.environ[fabric_env] = "1"
+        os.environ["RAFIKI_TPU_CLUSTER_PROBE_TIMEOUT_S"] = "2.0"
+        bus2 = MemoryBus()
+        wcache2 = Cache(bus2)
+        served2 = {"n": 0}
+        stop2 = threading.Event()
+        wcache2.register_worker("job-on", "w-on",
+                                info={"trial_id": "t", "score": 0.9})
+        wt2 = start_worker(wcache2, "w-on", served2, stop2)
+        ga = gb = None
+        try:
+            ga = make_frontend(bus2, "cfa-on", "job-on")
+            gb = make_frontend(bus2, "cfb-on", "job-on")
+            assert ga._fabric and gb._fabric
+            run_workload([ga, gb], keys)
+            recompute_on = served2["n"]
+            ev_a, ev_b = fabric_events(ga), fabric_events(gb)
+            peer_hits = ev_a.get("peer_hit", 0) + ev_b.get("peer_hit", 0)
+            if recompute_on >= 2 * uniques:
+                raise RuntimeError(
+                    f"fabric-on recompute {recompute_on} is not below "
+                    f"frontends x uniques {2 * uniques}")
+            if recompute_on != uniques:
+                raise RuntimeError(
+                    f"fabric-on recompute {recompute_on} != uniques "
+                    f"{uniques}: each key must be computed once "
+                    f"cluster-wide (events A={ev_a} B={ev_b})")
+            if peer_hits < uniques:
+                raise RuntimeError(
+                    f"only {peer_hits} peer hits for {uniques} uniques "
+                    "x 1 extra frontend — the second frontend did not "
+                    f"serve from its peer (A={ev_a} B={ev_b})")
+            # Promote-path invalidation on A gossips to B: B's next
+            # query of the hottest key must MISS and rescatter.
+            epoch_b = gb.edge_cache.epoch
+            post(ga, "/cache/invalidate", {})
+            t0 = time.monotonic()
+            while gb.edge_cache.epoch <= epoch_b:
+                if time.monotonic() - t0 > 5:
+                    raise RuntimeError("gossiped invalidation never "
+                                       "reached the peer frontend")
+                time.sleep(0.01)
+            before = served2["n"]
+            post(gb, "/predict", {"query": keys[0]})
+            if served2["n"] != before + 1:
+                raise RuntimeError(
+                    "promote-then-query on the non-promoting frontend "
+                    f"did not rescatter (served {served2['n']} vs "
+                    f"{before} + 1) — a stale entry survived the "
+                    "gossiped invalidation")
+            ev_a, ev_b = fabric_events(ga), fabric_events(gb)
+            if not ev_a.get("gossip_sent") or not ev_b.get("gossip_recv"):
+                raise RuntimeError(
+                    f"invalidation gossip not counter-proven: A={ev_a} "
+                    f"B={ev_b}")
+            record = {
+                "recompute_off": recompute_off,
+                "recompute_on": recompute_on,
+                "uniques": uniques,
+                "frontends": 2,
+                "peer_hits": peer_hits,
+                "fabric_events_a": ev_a,
+                "fabric_events_b": ev_b,
+                **relay_record,
+            }
+        finally:
+            for svc in (ga, gb):
+                if svc is not None:
+                    stop_frontend(svc, "job-on")
+            stop2.set()
+            wt2.join(timeout=5)
+    finally:
+        if saved_env is None:
+            os.environ.pop(fabric_env, None)
+        else:
+            os.environ[fabric_env] = saved_env
+        os.environ.pop("RAFIKI_TPU_CLUSTER_PROBE_TIMEOUT_S", None)
+
+    return _emit("cluster_fabric_recompute_ratio",
+                 record["recompute_off"] / record["recompute_on"],
+                 "ratio", **record)
+
+
 def make_synthetic_image_dataset_compat(tmp: str, n_train: int, n_val: int,
                                         image_shape=IMAGE_SHAPE):
     from rafiki_tpu.datasets import make_synthetic_image_dataset
@@ -3455,6 +3763,11 @@ _CONFIGS = {
     # predictive policy A/B in simulation; judged on the calibration
     # band + strictly-fewer simulated 429s, not a throughput figure.
     "replay": (main_replay, "replay_sim_live_p50_ratio", "ratio"),
+    # Not in _SWEEP_ORDER: the cluster config is a topology + A/B gate
+    # (zero-series contract, exactly-one-relay-hop, fabric peer hits,
+    # gossiped invalidation) judged entirely on counters — the ratio
+    # headline is structural (2.0 for two frontends), not a perf figure.
+    "cluster": (main_cluster, "cluster_fabric_recompute_ratio", "ratio"),
 }
 
 

@@ -668,20 +668,41 @@ class Admin:
         import json as _json
         from urllib.request import Request, urlopen
 
-        host = job.get("predictor_host")
-        if not host:
-            return  # no frontend deployed yet — nothing caches
+        # Cluster fabric (docs/cluster.md): with several frontends the
+        # job-row predictor_host names only the last-started one, so
+        # the synchronous invalidate fans out to EVERY frontend in the
+        # bus registry — each must acknowledge, or a peer could keep
+        # serving (or re-exporting, via peer probes) pre-promotion
+        # answers for its whole TTL. Single-node deploys have no
+        # registry entries and keep the one-host path.
+        hosts = []
         try:
-            req = Request(f"http://{host}/cache/invalidate",
-                          data=b"{}",
-                          headers={"Content-Type": "application/json"},
-                          method="POST")
-            with urlopen(req, timeout=10) as resp:
-                _json.loads(resp.read())
-        except OSError as e:
-            raise RuntimeError(
-                f"promotion applied but the predictor at {host} did "
-                f"not acknowledge cache invalidation: {e}") from None
+            from ..cache import Cache as _BusCache
+
+            hosts = sorted(_BusCache(self.services.serving_bus())
+                           .frontends(job["id"]).values())
+        except (ConnectionError, OSError, RuntimeError):
+            _log.warning("frontend registry unreachable; falling back "
+                         "to the job-row predictor host", exc_info=True)
+        if not hosts:
+            host = job.get("predictor_host")
+            if not host:
+                return  # no frontend deployed yet — nothing caches
+            hosts = [host]
+        for host in hosts:
+            try:
+                req = Request(f"http://{host}/cache/invalidate",
+                              data=b"{}",
+                              headers={"Content-Type":
+                                       "application/json"},
+                              method="POST")
+                with urlopen(req, timeout=10) as resp:
+                    _json.loads(resp.read())
+            except OSError as e:
+                raise RuntimeError(
+                    f"promotion applied but the predictor at {host} "
+                    f"did not acknowledge cache invalidation: {e}"
+                ) from None
 
     def get_inference_job_stats(self, inference_job_id: str,
                                 claims: Optional[Dict[str, Any]] = None,
@@ -833,6 +854,16 @@ class Admin:
             return {"enabled": False}
         return scaler.snapshot()
 
+    def get_nodes(self) -> Dict[str, Any]:
+        """The cluster node registry snapshot (the ``GET /nodes``
+        body; docs/cluster.md). Single-node deployments answer
+        ``enabled: false`` — the fabric is opt-in and the dashboard
+        renders the cluster view only when a registry exists."""
+        registry = getattr(self.services, "node_registry", None)
+        if registry is None:
+            return {"enabled": False}
+        return registry.snapshot()
+
     def get_slo(self) -> Dict[str, Any]:
         """The SLO engine's objective/instance snapshot (the
         ``GET /slo`` body; docs/observability.md "SLOs & alerting").
@@ -907,7 +938,7 @@ class Admin:
         if gauge is not None:
             for labels, value in gauge.samples():
                 mfu[labels.get("trial", "(unlabeled)")] = round(value, 4)
-        return {
+        out = {
             "n_chips": alloc.n_chips,
             "free_chips": alloc.free_chips,
             "chip_allocation": round(alloc.utilization(), 4),
@@ -916,6 +947,18 @@ class Admin:
             "nodes": nodes,
             "mfu": mfu,
         }
+        # Cluster fabric fold (docs/cluster.md): the meta-derived node
+        # view above only sees nodes with RUNNING services; the
+        # registry also counts idle-but-live peers, so operators see a
+        # joined-but-empty node here before it serves anything.
+        registry = getattr(self.services, "node_registry", None)
+        if registry is not None:
+            try:
+                out["cluster"] = registry.health()
+            except (ConnectionError, OSError, RuntimeError):
+                out["cluster"] = {"fabric": True, "error": "registry "
+                                  "unreachable"}
+        return out
 
     # --- User administration (ADMIN-only; enforced by the REST layer) ---
 

@@ -33,6 +33,11 @@ route-compatible so reference quickstart scripts port 1:1:
                                      replica targets (``enabled: false``
                                      on nodes without the control loop;
                                      see docs/autoscaling.md)
+- ``GET  /nodes``                    cluster node registry: per-node
+                                     identity, chip inventory, broker
+                                     URI, heartbeat age (``enabled:
+                                     false`` without the cluster
+                                     fabric; see docs/cluster.md)
 - ``GET  /slo``                      SLO objectives with live burn
                                      rates / error budgets per instance
                                      (``enabled: false`` when no
@@ -102,6 +107,7 @@ class AdminApp:
             ("GET", "/users", self._list_users),
             ("POST", "/users/<user_id>/ban", self._ban_user),
             ("GET", "/status", self._status),
+            ("GET", "/nodes", self._nodes),
             ("GET", "/trial_phases", self._trial_phases),
             ("GET", "/autoscale", self._autoscale),
             ("GET", "/slo", self._slo),
@@ -267,6 +273,10 @@ class AdminApp:
     def _autoscale(self, params, body, ctx):
         self._auth(ctx)
         return 200, self.admin.get_autoscale()
+
+    def _nodes(self, params, body, ctx):
+        self._auth(ctx)
+        return 200, self.admin.get_nodes()
 
     def _slo(self, params, body, ctx):
         self._auth(ctx)

@@ -746,6 +746,23 @@ class Autoscaler:
         one of its workers first — a time-sliced replica on saturated
         silicon adds latency, not capacity, so reclaiming a chip from
         training that isn't using it beats co-owning one."""
+        registry = getattr(self.services, "node_registry", None)
+        if registry is not None:
+            # Failure-domain spread (docs/cluster.md): with the cluster
+            # fabric on, replicas of one bin land round-robin across
+            # live nodes — a node death must never silence a bin's
+            # ensemble vote. The registry's deterministic vote picks
+            # exactly ONE placing node per pressure round; a deferring
+            # node records why and lets the elected peer (seeing the
+            # same shared meta rows + signals) act on ITS sweep.
+            counts: Dict[str, int] = {}
+            for w in by_bin.get(bin_id) or []:
+                svc = self.meta.get_service(w["service_id"])
+                nid = (svc or {}).get("node_id") or ""
+                counts[nid] = counts.get(nid, 0) + 1
+            if not registry.spread_ok(counts):
+                entry["deferred_to_peer"] = True
+                return False
         n_chips = self._bin_chips(by_bin.get(bin_id) or [])
         probe = f"autoscale-probe:{self.epoch}"
         group = self.services.allocator.allocate(n_chips, name=probe,

@@ -34,20 +34,67 @@ def fetch_endpoint(host: str, path: str, timeout: float = 5.0) -> Any:
 
 def worker_metrics_addrs(services, job_id: str) -> List[str]:
     """Advertised worker ``/metrics`` addresses for one inference job,
-    from the bus worker registry's ``metrics`` key (set by subprocess/
-    docker entrypoints after they bind a metrics server —
-    container/services.py). Resident-runner workers advertise nothing:
-    their series already live in the admin process's shared registry.
+    flattened across nodes (see :func:`worker_scrape_targets`)."""
+    by_node, _ = worker_scrape_targets(services, job_id)
+    return sorted({a for addrs in by_node.values() for a in addrs})
+
+
+def worker_scrape_targets(services, job_id: str
+                          ) -> Tuple[Dict[str, List[str]], int]:
+    """``(node -> advertised worker /metrics addrs, silent)`` for one
+    inference job, from the bus worker registry (``metrics`` +
+    ``node`` keys in each registration — worker/inference.py). The
+    node grouping is the cluster aggregator's unit: the admin merges
+    each node's worker registries so a whole-node scrape hole is
+    attributable, not just "some worker missing".
+
+    ``silent`` counts registered workers that advertise NO metrics
+    endpoint. Resident-runner workers are silent BY DESIGN (their
+    series live in the admin process's shared registry), so silent
+    alone is not a failure — but under subprocess/docker runners it is
+    exactly the population whose bin-scoped series the SLO plane
+    cannot see, and the engine publishes it as a coverage ratio
+    instead of silently reading "no data = healthy".
+
     Best-effort — a bus hiccup degrades to "no worker scrape this
     sweep", never into the supervise thread."""
     try:
         bus = services.serving_bus()
         prefix = f"w:{job_id}:"
-        addrs = {str((bus.get(k) or {}).get("metrics") or "")
-                 for k in bus.keys(prefix)}
-        return sorted(a for a in addrs if a)
+        by_node: Dict[str, set] = {}
+        silent = 0
+        for k in bus.keys(prefix):
+            info = bus.get(k) or {}
+            addr = str(info.get("metrics") or "")
+            if not addr:
+                silent += 1
+                continue
+            node = str(info.get("node") or "")
+            by_node.setdefault(node, set()).add(addr)
+        return ({n: sorted(a) for n, a in sorted(by_node.items())},
+                silent)
     except Exception:
-        return []
+        return ({}, 0)
+
+
+def merge_worker_expositions(fetch, by_node: Dict[str, List[str]]
+                             ) -> Tuple[str, int, int]:
+    """Concatenate every advertised worker exposition across all
+    nodes; returns ``(text, fetched, failed)``. The concatenation is
+    safe because frontend- and worker-owned families never share a
+    name+label set. A fetch failure skips that worker (a dead worker
+    must not blind the whole job) but is COUNTED — the caller turns
+    the tally into a coverage signal."""
+    parts: List[str] = []
+    fetched = failed = 0
+    for addrs in by_node.values():
+        for addr in addrs:
+            try:
+                parts.append(fetch(addr, "/metrics"))
+                fetched += 1
+            except (OSError, ValueError):
+                failed += 1
+    return ("\n".join(parts), fetched, failed)
 
 
 class ScrapeCache:

@@ -29,6 +29,7 @@ import math
 import os
 from typing import Any, Dict, List, Optional
 
+from .. import faults
 from ..constants import (BudgetOption, EnvVars, InferenceJobStatus,
                          ServiceStatus, ServiceType)
 from ..container.manager import ContainerManager
@@ -111,6 +112,14 @@ class ServicesManager:
         # ONLY when RAFIKI_TPU_SLO_RULES names objectives — same
         # disabled-means-free contract as the autoscaler.
         self.slo_engine = None
+        # Cluster node registry (admin/nodes.py), attached by the
+        # platform ONLY when RAFIKI_TPU_CLUSTER_FABRIC is on. None =
+        # single-node: heartbeat() pays one attribute check, no
+        # rafiki_tpu_node_* series, no registry bus traffic.
+        self.node_registry = None
+        # Chaos plane (faults.py): node.kill site — whole-node death.
+        # None when the fault plane is disarmed.
+        self._node_faults = faults.site_hook("node")
 
     # --- Launch plumbing ---
 
@@ -147,6 +156,16 @@ class ServicesManager:
         if "RAFIKI_TPU_ADVISOR_PREFETCH" in os.environ:
             env["RAFIKI_TPU_ADVISOR_PREFETCH"] = \
                 os.environ["RAFIKI_TPU_ADVISOR_PREFETCH"]
+        # Cluster fabric (docs/cluster.md): the PLACING node stamps its
+        # identity into every child it launches — workers echo it in
+        # their bus registration (locality-aware shard planning),
+        # frontends use it to route remote scatters through the relay.
+        # Identity, not a tunable: children must never invent their
+        # own, so it rides the service env like SERVICE_ID.
+        from ..config import _parse_bool as _pb
+
+        if _pb(os.environ.get("RAFIKI_TPU_CLUSTER_FABRIC", "0")):
+            env[EnvVars.NODE_ID] = self.node_id
         return env
 
     def _stop_service(self, service_id: str) -> None:
@@ -323,8 +342,16 @@ class ServicesManager:
 
     def heartbeat(self) -> None:
         """Refresh this node's liveness lease (called by the platform's
-        supervisor loop)."""
+        supervisor loop). The cluster node registry's announce rides
+        the same beat — same cadence, zero extra threads — and is
+        isolated so a broker outage cannot starve the meta lease."""
         self.meta.touch_node_services(self.node_id)
+        if self.node_registry is not None:
+            try:
+                self.node_registry.announce()
+            except (ConnectionError, OSError, RuntimeError):
+                _log.warning("node registry announce failed",
+                             exc_info=True)
 
     def train_services_active(self, train_job_id: str) -> bool:
         """True while any TRAIN worker of the job is alive.
@@ -724,7 +751,35 @@ class ServicesManager:
                 self.autoscaler.sweep(scrapes=scrapes)
             except Exception:
                 _log.exception("autoscale sweep failed")
+        if self._node_faults is not None:
+            # Chaos plane: node.kill (op matches this node's id). Fires
+            # at sweep END so the killed services stay dead until the
+            # NEXT sweep detects and respawns them — tests get an
+            # observable degraded window where only spread-placed
+            # sibling replicas keep a bin's vote alive.
+            act = self._node_faults(op=self.node_id)
+            if act is not None and act[0] == "kill":
+                self._kill_node_services()
         return restarted
+
+    def _kill_node_services(self) -> None:
+        """Whole-node death (chaos ``node.kill``): hard-kill every
+        RUNNING service this node owns. Deliberately NO meta updates
+        and NO chip release — a dying node can't tidy its own rows;
+        the next sweep's normal dead-service path (alive probe ->
+        ERRORED -> respawn) is what recovery exercises."""
+        victims = [svc for svc in self.meta.get_services()
+                   if svc["status"] == ServiceStatus.RUNNING
+                   and self._ownership(svc) == "local"]
+        _log.warning("node.kill fired on node %s: hard-killing %d "
+                     "running services", self.node_id, len(victims))
+        for svc in victims:
+            try:
+                self.container.kill_service(svc["container_id"]
+                                            or svc["id"])
+            except Exception:
+                _log.exception("node.kill: hard kill of %s failed",
+                               svc["id"][:8])
 
     def _note_restart(self, svc: Dict[str, Any],
                       new_svc: Optional[Dict[str, Any]],

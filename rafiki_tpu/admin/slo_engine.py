@@ -93,9 +93,20 @@ class SloEngine:
         self._webhook_wake = threading.Event()
         self._webhook_thread: Optional[threading.Thread] = None
         self._closed = False
+        # job_id -> last sweep's worker-scrape coverage accounting
+        # (advertised/fetched/failed/silent) — the /status and test
+        # surface behind the coverage gauge.
+        self.scrape_coverage: Dict[str, Dict[str, int]] = {}
         self._m_budget = self._m_burn = self._m_alerts = None
+        self._m_scrape = None
         if _metrics.metrics_enabled():
             reg = _metrics.registry()
+            self._m_scrape = reg.gauge(
+                "rafiki_tpu_slo_worker_scrape_ratio",
+                "Fraction of a job's metrics-advertising workers whose "
+                "exposition the SLO sweep actually merged (1 = full "
+                "bin-scope visibility; < 1 = objectives are judging "
+                "partial data, NOT proof of health)")
             self._m_budget = reg.gauge(
                 "rafiki_tpu_slo_budget_remaining_ratio",
                 "Error budget left in each objective's rolling window "
@@ -136,7 +147,8 @@ class SloEngine:
         t = self._webhook_thread
         if t is not None and t.is_alive():
             t.join(timeout=5)
-        for m in (self._m_budget, self._m_burn, self._m_alerts):
+        for m in (self._m_budget, self._m_burn, self._m_alerts,
+                  self._m_scrape):
             if m is not None:
                 m.remove()
 
@@ -197,13 +209,32 @@ class SloEngine:
         except (OSError, ValueError):
             self._labels.pop(job["id"], None)  # re-resolve on restart
             return None
-        from .scrape import worker_metrics_addrs
+        from .scrape import merge_worker_expositions, \
+            worker_scrape_targets
 
-        for addr in worker_metrics_addrs(self.services, job["id"]):
-            try:
-                text += "\n" + fetch(addr, "/metrics")
-            except (OSError, ValueError):
-                continue
+        by_node, silent = worker_scrape_targets(self.services,
+                                                job["id"])
+        worker_text, fetched, failed = merge_worker_expositions(
+            fetch, by_node)
+        if worker_text:
+            text += "\n" + worker_text
+        advertised = fetched + failed
+        self.scrape_coverage[job["id"]] = {
+            "advertised": advertised, "fetched": fetched,
+            "failed": failed, "silent": silent}
+        if self._m_scrape is not None:
+            # 1.0 when nothing advertises: resident-runner workers'
+            # series already live in this process's registry, so the
+            # frontend scrape IS full coverage.
+            self._m_scrape.set(
+                fetched / advertised if advertised else 1.0,
+                job=job["id"])
+        if failed:
+            _log.warning(
+                "slo sweep: job %s worker scrape incomplete (%d/%d "
+                "advertised endpoints merged) — bin-scoped objectives "
+                "are judging partial data", job["id"][:8], fetched,
+                advertised)
         return text
 
     def _scrape(self, host: str, path: str) -> Any:
@@ -464,6 +495,11 @@ class SloEngine:
         for job_id in [j for j in self._labels
                        if j not in live_job_ids]:
             del self._labels[job_id]
+        for job_id in [j for j in self.scrape_coverage
+                       if j not in live_job_ids]:
+            del self.scrape_coverage[job_id]
+            if self._m_scrape is not None:
+                self._m_scrape.remove(job=job_id)
 
     # --- Consumers -----------------------------------------------------
 

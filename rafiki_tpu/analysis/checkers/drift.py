@@ -50,15 +50,17 @@ PREFIX = "rafiki_tpu_"
 
 SUBSYSTEMS = {"bus", "serving", "http", "train", "trial", "trace",
               "node", "fault", "autoscale", "profile", "slo",
-              "workload", "capacity", "lm"}
+              "workload", "capacity", "lm", "relay"}
 
 # _total marks counters (Prometheus convention); everything else is the
 # physical unit of a gauge/histogram. "rate" is the SLO plane's burn
 # rate (budget fractions per window-length — dimensionless but not a
 # 0..1 ratio). "tokens" is the generative-serving unit (resident-KV
 # gauge; token counters end _total like every counter).
+# "peers" is the cluster registry's unit (live-peer-count gauge;
+# relay/fabric traffic counters end _total like every counter).
 UNITS = {"total", "seconds", "ratio", "bytes", "queries", "batches",
-         "info", "replicas", "rate", "tokens"}
+         "info", "replicas", "rate", "tokens", "peers"}
 
 NAME_RE = re.compile(r"^rafiki_tpu_[a-z0-9]+(?:_[a-z0-9]+)+$")
 

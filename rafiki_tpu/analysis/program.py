@@ -167,6 +167,19 @@ class _MethodWalker(ast.NodeVisitor):
                         item.context_expr.id in self.cls.module_locks:
                     token = MODULE_LOCK_TOKEN + \
                         self.cls.module_locks[item.context_expr.id]
+                if token is None and \
+                        isinstance(item.context_expr, ast.Attribute) \
+                        and isinstance(item.context_expr.value,
+                                       ast.Name):
+                    # Dotted module-global lock (``with mod._LOCK:``)
+                    # — the module_lock_names map keys the dotted
+                    # spelling, so it yields the same qualified token
+                    # as the bare-name form.
+                    dotted = (f"{item.context_expr.value.id}."
+                              f"{item.context_expr.attr}")
+                    if dotted in self.cls.module_locks:
+                        token = MODULE_LOCK_TOKEN + \
+                            self.cls.module_locks[dotted]
                 if token is not None:
                     entered.append(token)
                 else:
@@ -828,11 +841,29 @@ class Program:
         if mi is not None:
             for local, (modname, symbol) in mi.imports.items():
                 if symbol is None:
+                    # ``import pkg.mod as m`` — m's locks are only
+                    # reachable as DOTTED ``m.LOCK`` references; the
+                    # dotted spelling is the map key so both walkers
+                    # resolve it with one lookup.
+                    target = self.by_modname.get(modname)
+                    if target is not None:
+                        for name in target.global_locks:
+                            out[f"{local}.{name}"] = \
+                                f"{target.modname}.{name}"
                     continue
                 target = self.by_modname.get(modname)
                 if target is not None and \
                         symbol in target.global_locks:
                     out[local] = f"{target.modname}.{symbol}"
+                # ``from pkg import mod`` — a module imported as a
+                # SYMBOL: its locks are dotted ``mod.LOCK`` references
+                # exactly like the aliased-import case.
+                sub = self.by_modname.get(f"{modname}.{symbol}"
+                                          if modname else symbol)
+                if sub is not None:
+                    for name in sub.global_locks:
+                        out[f"{local}.{name}"] = \
+                            f"{sub.modname}.{name}"
             for name in mi.global_locks:
                 out[name] = f"{mi.modname}.{name}"
         self._module_locks[rel] = out
@@ -852,7 +883,9 @@ class Program:
         Only executor-shaped submit receivers (pool/executor/exec in
         the name), receivers whose type resolves through the bounded
         alias rules, and methods the target class actually defines,
-        register."""
+        register. Handler classes passed to a ``*Server`` ctor
+        (the socketserver shape — ``handle()`` runs per-connection
+        threads) register through the same inventory."""
         if self._extra_roots is None:
             self._extra_roots = {}
             for mi in self.modules.values():
@@ -913,6 +946,44 @@ class Program:
                     continue
                 self._extra_roots.setdefault(fk, {})[
                     f"{kind}:{meth}"] = (kind, meth)
+            self._collect_handler_roots(rel, node, func, leaf)
+
+    def _collect_handler_roots(self, rel, node: ast.Call, func,
+                               leaf: str) -> None:
+        """The socketserver shape: ``_Server((host, port), _Handler)``
+        — the server ctor takes the handler CLASS and calls its
+        ``handle()`` on a per-connection thread, so the handler class
+        never constructs a thread and both thread-root walks are blind
+        to it. Bounded: the called name must resolve to a repo class
+        with a ``*Server*`` base (socketserver.ThreadingTCPServer and
+        repo subclasses), the argument to a repo class that defines
+        ``handle``."""
+        called = self.resolve_class(rel, leaf) if leaf else None
+        if called is None or not self._is_server_class(called):
+            return
+        candidates = list(node.args) + [
+            kw.value for kw in node.keywords
+            if kw.arg and "handler" in kw.arg.lower()]
+        for arg in candidates:
+            if not isinstance(arg, ast.Name):
+                continue
+            hk = self.resolve_class(rel, arg.id)
+            if hk is None:
+                continue
+            hinfo = self._class_info_of(hk)
+            if hinfo is None or not any(m.name == "handle"
+                                        for m in hinfo.methods()):
+                continue
+            self._extra_roots.setdefault(hk, {})[
+                "handler:handle"] = ("handler", "handle")
+
+    def _is_server_class(self, cls_key: Tuple[str, str]) -> bool:
+        mi = self.modules.get(cls_key[0])
+        cnode = mi.classes.get(cls_key[1]) if mi else None
+        if cnode is None:
+            return False
+        return any("Server" in part
+                   for base in cnode.bases for part in _dotted(base))
 
     # -- method summaries + call resolution --
 
@@ -1309,6 +1380,15 @@ class _QualifiedWalker(ast.NodeVisitor):
             # free functions alike reach them by bare name).
             return self._module_locks.get(expr.id)
         if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name):
+                # ``with state._LOCK:`` — a module-global primitive
+                # reached through its module (import alias or
+                # from-imported module); same qualified id as the bare
+                # spelling, so the lock unifies across call styles.
+                qid = self._module_locks.get(
+                    f"{expr.value.id}.{expr.attr}")
+                if qid is not None:
+                    return qid
             owner = _self_attr(expr.value)
             fk = self.atypes.get(owner) if owner is not None else None
             if fk is None and isinstance(expr.value, ast.Name):

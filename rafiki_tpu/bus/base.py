@@ -51,6 +51,21 @@ def bus_reconnect_counter() -> Optional["metrics.Counter"]:
         "(backend is always tcp)")
 
 
+def bus_relay_counter() -> Optional["metrics.Counter"]:
+    """Inter-node relay frame counter, labelled by direction (out =
+    forwarded to a peer broker, in = executed here for a peer,
+    fallback = peer unreachable, inner op executed locally). None when
+    metrics are disabled. Callers must resolve this ONLY once a relay
+    topology is actually configured (a node_id + at least one peer) —
+    a single-node broker never registers the series (docs/cluster.md
+    zero-series contract)."""
+    if not metrics.metrics_enabled():
+        return None
+    return metrics.registry().counter(
+        "rafiki_tpu_bus_relay_total",
+        "Inter-node bus relay frames by direction (out/in/fallback)")
+
+
 class BaseBus(abc.ABC):
     # --- Queues ---
 
@@ -65,6 +80,18 @@ class BaseBus(abc.ABC):
         round-trips per request is the frontend's QPS ceiling."""
         for queue, value in items:
             self.push(queue, value)
+
+    def relay_push(self, node: str, queue: str, value: Any) -> None:
+        """Push toward the broker owning ``node``'s queues
+        (docs/cluster.md). The base bus is single-broker — every queue
+        is local — so this is a plain push; the tcp backend overrides
+        it to forward through its broker's inter-node relay."""
+        self.push(queue, value)
+
+    def relay_push_many(self, node: str,
+                        items: Sequence[Tuple[str, Any]]) -> None:
+        """Batch form of ``relay_push`` (one round-trip, one hop)."""
+        self.push_many(items)
 
     @abc.abstractmethod
     def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:

@@ -122,12 +122,18 @@ class NativeBusServer:
 
 
 def serve_broker(host: str = "127.0.0.1", port: int = 0, *,
-                 native: Optional[bool] = None):
+                 native: Optional[bool] = None, node_id: str = ""):
     """Start a broker, preferring the native one.
 
     ``native=None`` auto-selects: C++ broker when a toolchain/cached
     binary exists, Python ``BusServer`` otherwise. Returns the started
     server object (``.uri``, ``.stop()``).
+
+    ``node_id`` names the cluster node this broker serves queues for
+    (docs/cluster.md): a per-node broker with an inter-node relay. The
+    native broker predates the relay op, so naming a node forces the
+    Python broker — clients of an unnamed native broker still work in
+    a cluster via their negotiated relay fallback.
     """
     from ..observe import metrics
     from .tcp import BusServer
@@ -144,7 +150,11 @@ def serve_broker(host: str = "127.0.0.1", port: int = 0, *,
             ).set(1, backend=backend)
 
     if native is None:
-        native = NativeBusServer.available()
+        native = NativeBusServer.available() and not node_id
+    if native and node_id:
+        raise ValueError("native broker does not support the inter-node "
+                         "relay; start a node-scoped broker with "
+                         "native=False (or native=None)")
     if native:
         try:
             server = NativeBusServer(host, port).start()
@@ -153,6 +163,6 @@ def serve_broker(host: str = "127.0.0.1", port: int = 0, *,
         except RuntimeError:
             _log.warning("native broker unavailable; using Python broker",
                          exc_info=True)
-    server = BusServer(host, port).start()
+    server = BusServer(host, port, node_id=node_id).start()
     _mark("python")
     return server

@@ -25,12 +25,18 @@ from typing import Any, List, Optional
 import time
 
 from .base import (BaseBus, bus_op_histogram, bus_reconnect_counter,
-                   queue_kind)
+                   bus_relay_counter, queue_kind)
 from .memory import MemoryBus
 from .. import faults
 
 _HDR = struct.Struct(">I")
 _MAX_FRAME = 256 * 1024 * 1024
+
+#: Per-peer retry budget for broker→broker relay forwards, seconds. A
+#: dead peer must fail the forward FAST (the handler thread holds the
+#: sender's request open) and degrade to local execution — never the
+#: client-side 15 s default.
+_PEER_RETRY_TOTAL_S = 2.0
 
 #: Ops safe to retry even after their frame was FULLY sent (the broker
 #: may have executed them): pure reads, and writes whose replay is a
@@ -107,8 +113,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 except (ConnectionError, OSError, ValueError):
                     return
                 try:
-                    resp = {"ok": True,
-                            "value": self._dispatch(bus, req)}
+                    if req.get("op") == "relay":
+                        value = self._relay(req)
+                    else:
+                        value = self._dispatch(bus, req)
+                    resp = {"ok": True, "value": value}
                 except Exception as e:  # report, keep connection alive
                     resp = {"ok": False,
                             "error": f"{type(e).__name__}: {e}"}
@@ -154,21 +163,92 @@ class _Handler(socketserver.BaseRequestHandler):
             return "pong"
         raise ValueError(f"unknown op: {op!r}")
 
+    def _relay(self, req: dict) -> Any:
+        """Inter-node relay (docs/cluster.md): execute ``req["req"]``
+        on the broker owning node ``req["node"]``'s queues. A frame for
+        a remote node pays exactly ONE inter-node hop: the forwarded
+        frame carries ``hop=1`` and the receiving broker executes it
+        locally no matter what (never re-forwards). An unknown or
+        unreachable peer degrades to executing the inner op against
+        THIS broker — the pre-cluster single-broker behavior — so a
+        dead node never wedges the sender (the serving gather timeout
+        and resubmit own delivery from there)."""
+        srv = self.server  # type: ignore[assignment]
+        target = req.get("node")
+        inner = req.get("req") or {}
+        ctr = srv.relay_counter  # type: ignore[attr-defined]
+        if req.get("hop") or target == srv.node_id:  # type: ignore[attr-defined]
+            if ctr is not None:
+                ctr.inc(direction="in")
+            return self._dispatch(srv.bus, inner)  # type: ignore[attr-defined]
+        client = srv.peer_client(target)  # type: ignore[attr-defined]
+        if client is not None:
+            try:
+                value = client._call({"op": "relay", "node": target,
+                                      "hop": 1, "req": inner})
+                if ctr is not None:
+                    ctr.inc(direction="out")
+                return value
+            except (ConnectionError, OSError, BusOpError):
+                pass  # dead/old peer: fall through to local execution
+        if ctr is not None:
+            ctr.inc(direction="fallback")
+        return self._dispatch(srv.bus, inner)  # type: ignore[attr-defined]
+
 
 class _Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    # Relay topology (empty/None on a single-node broker): set up by
+    # ``BusServer`` at construction / ``add_peer``.
+    node_id = ""
+    relay_counter = None
+
+    def peer_client(self, node: Any) -> Optional["BusClient"]:
+        """Cached broker→broker client for a registered peer node, or
+        None when the node is unknown (never been ``add_peer``-ed)."""
+        if not isinstance(node, str):
+            return None
+        with self.peers_lock:  # type: ignore[attr-defined]
+            addr = self.peers.get(node)  # type: ignore[attr-defined]
+            if addr is None:
+                return None
+            cli = self.peer_clients.get(node)  # type: ignore[attr-defined]
+            if cli is None:
+                # Tight retry budget: the forward happens inside a
+                # handler thread holding the SENDER's request open.
+                cli = BusClient(addr[0], addr[1],
+                                retry_total_s=_PEER_RETRY_TOTAL_S)
+                self.peer_clients[node] = cli  # type: ignore[attr-defined]
+            return cli
 
 
 class BusServer:
-    """The broker process side. ``port=0`` picks a free port."""
+    """The broker process side. ``port=0`` picks a free port.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``node_id`` names the cluster node this broker serves queues for
+    (docs/cluster.md). Default "" keeps the single-node broker: no
+    relay topology, and the relay counter series is never registered.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 node_id: str = ""):
         self._server = _Server((host, port), _Handler)
         self._server.bus = MemoryBus()  # type: ignore[attr-defined]
         self._server.conns = set()  # type: ignore[attr-defined]
         self._server.conns_lock = (  # type: ignore[attr-defined]
             threading.Lock())
+        self._server.node_id = node_id  # type: ignore[attr-defined]
+        self._server.peers = {}  # type: ignore[attr-defined]
+        self._server.peer_clients = {}  # type: ignore[attr-defined]
+        self._server.peers_lock = (  # type: ignore[attr-defined]
+            threading.Lock())
+        # The relay series is born ONLY on a cluster-configured broker
+        # (named node now, or first add_peer later): a default broker
+        # keeps the zero-series contract for fabric-off deployments.
+        if node_id:
+            self._server.relay_counter = (  # type: ignore[attr-defined]
+                bus_relay_counter())
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="bus-server", daemon=True)
@@ -176,6 +256,30 @@ class BusServer:
     @property
     def uri(self) -> str:
         return f"tcp://{self.host}:{self.port}"
+
+    @property
+    def node_id(self) -> str:
+        return self._server.node_id  # type: ignore[attr-defined]
+
+    def add_peer(self, node_id: str, uri: str) -> None:
+        """Register a peer node's broker as the relay target for frames
+        addressed to ``node_id`` (``uri`` = ``tcp://host:port``).
+        Re-registering replaces the address (a respawned peer broker
+        moves ports) and drops the cached client to it."""
+        if not uri.startswith("tcp://"):
+            raise ValueError(f"unsupported peer uri: {uri!r}")
+        host, _, port = uri[len("tcp://"):].partition(":")
+        srv = self._server
+        with srv.peers_lock:  # type: ignore[attr-defined]
+            srv.peers[node_id] = (  # type: ignore[attr-defined]
+                host or "127.0.0.1", int(port or 6380))
+            stale = srv.peer_clients.pop(  # type: ignore[attr-defined]
+                node_id, None)
+        if stale is not None:
+            stale.close()
+        if srv.relay_counter is None:  # type: ignore[attr-defined]
+            srv.relay_counter = (  # type: ignore[attr-defined]
+                bus_relay_counter())
 
     def start(self) -> "BusServer":
         self._thread.start()
@@ -271,12 +375,17 @@ class BusClient(BaseBus):
         return sock
 
     def _call(self, req: dict) -> Any:
-        # push_many carries its queues inside "items"; label by the
-        # first one so the serving scatter records kind="query" exactly
-        # as the memory backend does.
+        # push_many carries its queues inside "items", relay inside its
+        # "req" envelope; label by the first one so the serving scatter
+        # records kind="query" exactly as the memory backend does.
         queue = req.get("queue")
         if queue is None and req.get("items"):
             queue = req["items"][0].get("queue")
+        if queue is None and req.get("op") == "relay":
+            inner = req.get("req") or {}
+            queue = inner.get("queue")
+            if queue is None and inner.get("items"):
+                queue = inner["items"][0].get("queue")
         if self._fault is not None:
             op = str(req.get("op"))
             try:
@@ -410,6 +519,46 @@ class BusClient(BaseBus):
             self._no_push_many = True
             for queue, value in items:
                 self.push(queue, value)
+
+    def relay_push(self, node: str, queue: str, value: Any) -> None:
+        """Push destined for ``node``'s broker, via OUR broker's
+        inter-node relay: one client round-trip, at most one inter-node
+        hop. A broker without the relay op (the cached native binary
+        predating it) negotiates a permanent fallback to plain local
+        pushes — the pre-cluster single-broker behavior."""
+        if not node or getattr(self, "_no_relay", False):
+            self.push(queue, value)
+            return
+        try:
+            self._call({"op": "relay", "node": node,
+                        "req": {"op": "push", "queue": queue,
+                                "value": value}})
+        except BusOpError as e:
+            if "unknown op" not in str(e):
+                raise
+            self._no_relay = True
+            self.push(queue, value)
+
+    def relay_push_many(self, node: str, items) -> None:
+        """Batch form of ``relay_push`` (the scatter path): the whole
+        remote portion of a shard fan-out is one frame to our broker
+        and ONE forwarded frame to the peer broker."""
+        items = list(items)
+        if not items:
+            return
+        if not node or getattr(self, "_no_relay", False):
+            self.push_many(items)
+            return
+        try:
+            self._call({"op": "relay", "node": node,
+                        "req": {"op": "push_many",
+                                "items": [{"queue": q, "value": v}
+                                          for q, v in items]}})
+        except BusOpError as e:
+            if "unknown op" not in str(e):
+                raise
+            self._no_relay = True
+            self.push_many(items)
 
     def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:
         return self._call({"op": "pop", "queue": queue, "timeout": timeout})

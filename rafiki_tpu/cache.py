@@ -426,6 +426,32 @@ class Cache:
             out[k[len(prefix):]] = self.bus.get(k) or {}
         return out
 
+    # --- Frontend registry (cluster cache fabric; docs/cluster.md) ---
+    #
+    # Predictor frontends of one job register their HTTP address under
+    # ``f:{job}:{instance}`` so peers can probe each other's edge cache
+    # and the admin's promotion invalidate can fan out to ALL of them.
+    # Written only when the cluster fabric is on — a single-node deploy
+    # never creates these keys.
+
+    def register_frontend(self, inference_job_id: str, instance: str,
+                          addr: str) -> None:
+        self.bus.set(f"f:{inference_job_id}:{instance}", addr)
+
+    def unregister_frontend(self, inference_job_id: str,
+                            instance: str) -> None:
+        self.bus.delete(f"f:{inference_job_id}:{instance}")
+
+    def frontends(self, inference_job_id: str) -> Dict[str, str]:
+        """instance -> HTTP addr of every registered frontend."""
+        prefix = f"f:{inference_job_id}:"
+        out: Dict[str, str] = {}
+        for k in self.bus.keys(prefix):
+            addr = self.bus.get(k)
+            if addr:
+                out[k[len(prefix):]] = str(addr)
+        return out
+
     # --- Queries (Predictor side) ---
 
     def send_query(self, worker_id: str, query: Any,
@@ -535,7 +561,9 @@ class Cache:
                           trace_ctxs: Optional[List] = None,
                           packed: Optional[PackedBatch] = None,
                           packed_ok: Collection[str] = (),
-                          tenants: Optional[List] = None) -> str:
+                          tenants: Optional[List] = None,
+                          worker_nodes: Optional[Dict[str, str]] = None,
+                          local_node: str = "") -> str:
         """Scatter per-SHARD slices of one pre-encoded batch — the
         data-parallel fanout behind ``Predictor``'s replica sharding.
 
@@ -561,15 +589,30 @@ class Cache:
         rides each shard frame SCALED to the shard's slice of the
         batch, so a worker prorating its burst's device time over the
         frame's counts attributes one shard's worth, not the whole
-        batch's."""
+        batch's.
+
+        ``worker_nodes`` + ``local_node`` (docs/cluster.md): with a
+        node map given, shards bound for a worker REGISTERED ON ANOTHER
+        NODE are grouped per node and forwarded through the bus relay
+        (one ``relay_push_many`` — one inter-node hop — per remote
+        node), stamped with ``"onode"`` so the worker relays its reply
+        back to this node's broker. Local/unknown-node shards keep the
+        plain ``push_many``. Default None = byte-identical single-node
+        behavior."""
         batch_id = batch_id or uuid.uuid4().hex
         env = _trace_envelope(trace_ctxs)
         n = packed.n if packed is not None else len(encoded_queries)
         counting = _wire.counting()
         frames = []
+        remote: Dict[str, List[tuple]] = {}
         for worker_id, start, count, shard_id in shards:
             frame: Dict[str, Any] = {"batch_id": batch_id,
                                      "shard": shard_id}
+            wnode = (worker_nodes or {}).get(worker_id, "")
+            if wnode and local_node and wnode != local_node:
+                # Remote worker: route via its node's broker and tell
+                # it where the reply queue lives.
+                frame["onode"] = local_node
             if tenants:
                 # FLOOR, no floor-of-one: a tenant whose scaled share
                 # of this shard truncates to zero is simply
@@ -599,8 +642,15 @@ class Cache:
                                       _payload_nbytes(qs))
             if env is not None:
                 frame[_trace.ENVELOPE_KEY] = env
-            frames.append((f"q:{worker_id}", frame))
-        self.bus.push_many(frames)
+            if "onode" in frame:
+                remote.setdefault(wnode, []).append(
+                    (f"q:{worker_id}", frame))
+            else:
+                frames.append((f"q:{worker_id}", frame))
+        if frames:
+            self.bus.push_many(frames)
+        for wnode, items in remote.items():
+            self.bus.relay_push_many(wnode, items)
         return batch_id
 
     def gather_prediction_batches(self, batch_id: str, n_workers: int,
@@ -750,7 +800,8 @@ class Cache:
                               shard: Optional[Any] = None,
                               confidence: Optional[List] = None,
                               compute_s: Optional[float] = None,
-                              packed_ok: bool = False) -> None:
+                              packed_ok: bool = False,
+                              origin_node: Optional[str] = None) -> None:
         """``shard`` echoes the query frame's shard id (when the frame
         carried one) so a sharded gather can match this reply to its
         plan entry; un-sharded frames reply without the key, which is
@@ -789,7 +840,14 @@ class Cache:
             frame["confidence"] = confidence
         if compute_s is not None:
             frame["compute_s"] = compute_s
-        self.bus.push(f"r:{batch_id}", frame)
+        if origin_node:
+            # Cross-node shard (the query frame carried "onode"): the
+            # reply queue lives on the ORIGIN node's broker — relay it
+            # back (one hop; a single-broker topology degrades to the
+            # local push via the relay fallback).
+            self.bus.relay_push(origin_node, f"r:{batch_id}", frame)
+        else:
+            self.bus.push(f"r:{batch_id}", frame)
 
     # --- Generative serving (token streaming) ---
     #

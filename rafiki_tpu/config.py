@@ -270,6 +270,26 @@ class NodeConfig:
     bus_retry_base_s: float = 0.05
     bus_retry_total_s: float = 15.0
 
+    # --- Cluster serving fabric (docs/cluster.md) ---
+    # Master gate for the multi-node serving plane: node registry rows
+    # on the bus (admin/nodes.py), frontend peer-cache probes +
+    # invalidation gossip (predictor/edge_cache.py), node-routed bus
+    # relay and node-aware shard locality. Default OFF — zero new
+    # metric series, zero extra threads, byte-identical single-node
+    # behavior (one attribute/env check per seam).
+    cluster_fabric: bool = False
+    # Bound on ONE peer-cache probe, seconds: a frontend miss consults
+    # at most one peer for at most this long before scattering to the
+    # workers (the probe is strictly additive latency on a cold key, so
+    # it must stay well under a scatter's own p50).
+    cluster_probe_timeout_s: float = 0.25
+    # Same-node replica preference in shard-plan weights: a replica
+    # whose chips live on THIS node gets its inverse-latency weight
+    # multiplied by this factor (EWMA latency still rules — a slow
+    # local replica loses to a fast remote one once the measured gap
+    # exceeds the boost). 1.0 = no locality preference.
+    cluster_locality_boost: float = 1.0
+
     # --- Observability (docs/observability.md) ---
     metrics: bool = True                   # /metrics route + bus/http
     #                                        instrumentation wiring
@@ -542,6 +562,13 @@ class NodeConfig:
         if self.bus_retry_total_s < 0:
             raise ValueError("bus_retry_total_s must be >= 0 "
                              "(0 disables the retry budget)")
+        if self.cluster_probe_timeout_s <= 0:
+            raise ValueError("cluster_probe_timeout_s must be positive "
+                             "(it bounds the single peer-cache probe)")
+        if self.cluster_locality_boost < 1.0:
+            raise ValueError("cluster_locality_boost must be >= 1 "
+                             "(1.0 = no locality preference; below 1 "
+                             "would PENALIZE same-node replicas)")
         if self.fault_plan.strip():
             # Parse now: a typo'd chaos plan must fail the node's
             # construction, not silently inject nothing.
@@ -742,6 +769,20 @@ class NodeConfig:
             str(self.bus_retry_base_s)
         os.environ[self.env_name("bus_retry_total_s")] = \
             str(self.bus_retry_total_s)
+        # Cluster fabric: Predictor / PredictorService / ServicesManager
+        # read the gate at construction; it pops when off so "absent =
+        # disabled" stays the contract for hand-launched children (zero
+        # node/relay/fabric series on an off node). The two tunables are
+        # read at construction alongside it, so RTA505 tracks them by
+        # name.
+        if self.cluster_fabric:
+            os.environ[self.env_name("cluster_fabric")] = "1"
+        else:
+            os.environ.pop(self.env_name("cluster_fabric"), None)
+        os.environ[self.env_name("cluster_probe_timeout_s")] = \
+            str(self.cluster_probe_timeout_s)
+        os.environ[self.env_name("cluster_locality_boost")] = \
+            str(self.cluster_locality_boost)
         # Observability: the /metrics route and bus/http instrumentation
         # check RAFIKI_TPU_METRICS at construction; the trace edges read
         # RAFIKI_TPU_TRACE_SAMPLE per request, the span sink its size

@@ -117,3 +117,9 @@ class EnvVars:
     # engine can read worker-owned families (never a config knob —
     # the bound port is only known at runtime).
     METRICS_ADDR = "RAFIKI_TPU_METRICS_ADDR"
+    # Identity of the node that placed this service (ServicesManager
+    # node_id, injected at spawn like SERVICE_ID): workers echo it in
+    # their bus registration so frontends can route shards and prefer
+    # same-node replicas (docs/cluster.md). Never a config knob — the
+    # placing node decides it.
+    NODE_ID = "RAFIKI_TPU_NODE_ID"

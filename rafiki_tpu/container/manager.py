@@ -37,6 +37,17 @@ class ContainerManager(abc.ABC):
     def destroy_service(self, container_id: str) -> None:
         pass
 
+    def kill_service(self, container_id: str) -> None:
+        """HARD kill (the chaos plane's ``node.kill`` site): the
+        service must die leaving its meta row RUNNING and its bus
+        registration stale — the wreckage a real node death leaves —
+        so the supervise sweep's detection path is what recovery
+        exercises. For process/docker runtimes ``destroy_service`` IS
+        hard already (the dying process cannot update meta rows; the
+        manager-side ``_stop_service`` meta update is simply not
+        called); thread mode overrides this."""
+        self.destroy_service(container_id)
+
     @abc.abstractmethod
     def service_alive(self, container_id: str) -> bool:
         pass
@@ -69,6 +80,23 @@ class ThreadContainerManager(ContainerManager):
         with self._lock:
             service = self._services.pop(container_id, None)
         if service is not None:
+            service.stop()
+
+    def kill_service(self, container_id: str) -> None:
+        """Thread-mode hard kill: a service exposing ``kill()`` (the
+        inference worker) dies through its injected-crash path — meta
+        row left RUNNING, registration stale. Services without one
+        (HTTP frontends, advisors) fall back to a graceful stop: a
+        thread can't be SIGKILLed, so this is the closest honest
+        emulation, and the chaos tests target the worker case."""
+        with self._lock:
+            service = self._services.pop(container_id, None)
+        if service is None:
+            return
+        kill = getattr(service, "kill", None)
+        if kill is not None:
+            kill()
+        else:
             service.stop()
 
     def service_alive(self, container_id: str) -> bool:

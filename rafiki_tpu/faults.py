@@ -33,6 +33,11 @@ site        kind         effect at the site
                          the worker thread dies HARD (meta row left
                          RUNNING, bus registration left stale), emulating
                          a kill -9 so ``supervise()`` must notice
+``node``    ``kill``     kill EVERY service the matching node owns at the
+                         end of its supervise sweep (hard: meta rows left
+                         RUNNING, registrations stale) — whole-node death;
+                         ``op=`` matches the node_id, so a plan can target
+                         one virtual node in a multi-node test
 ==========  ===========  ==================================================
 
 Selection params (exactly one per rule; default ``p=1``):
@@ -82,12 +87,13 @@ _log = logging.getLogger(__name__)
 PLAN_ENV = "RAFIKI_TPU_FAULT_PLAN"
 SEED_ENV = "RAFIKI_TPU_FAULT_SEED"
 
-SITES = ("bus", "http", "worker")
+SITES = ("bus", "http", "worker", "node")
 
 _KINDS = {
     "bus": ("delay", "drop", "disconnect"),
     "http": ("error", "timeout"),
     "worker": ("slow", "crash"),
+    "node": ("kill",),
 }
 
 #: Every param key a rule may carry (selection + match + effect).
@@ -263,6 +269,11 @@ class FaultPlan:
                     f"injected: {site}.disconnect ({op or route})")
             elif k == "crash":
                 raise InjectedCrash("injected: worker.crash")
+            elif k == "kill":
+                # A verdict, not an action: the supervise sweep owns
+                # the node-wide teardown (it knows which services the
+                # node holds); raising here would just kill the sweep.
+                out = ("kill", None)
             elif k == "error":
                 out = ("error", int(rule.params.get("code", 503)))
         return out

@@ -146,6 +146,29 @@ class LocalPlatform:
             self.slo_engine = SloEngine.from_env(self.services,
                                                  self.meta)
             self.services.slo_engine = self.slo_engine
+        # Cluster node registry (docs/cluster.md): constructed ONLY
+        # when RAFIKI_TPU_CLUSTER_FABRIC is on (NodeConfig apply_env
+        # exports it). Off = services.node_registry stays None: no
+        # rafiki_tpu_node_* series, no registry bus traffic, and the
+        # heartbeat loop pays one attribute check. The announce rides
+        # the EXISTING heartbeat cadence; the eager first announce
+        # makes the node visible before the first beat fires.
+        self.node_registry = None
+        if _pb(os.environ.get("RAFIKI_TPU_CLUSTER_FABRIC", "0")):
+            from .admin.nodes import NodeRegistry
+
+            self.node_registry = NodeRegistry(
+                self.services.serving_bus,
+                node_id=self.services.node_id,
+                n_chips=self.allocator.n_chips,
+                bus_uri=bus_uri, lease_s=self.services.NODE_LEASE)
+            self.services.node_registry = self.node_registry
+            try:
+                self.node_registry.announce()
+            except (ConnectionError, OSError, RuntimeError):
+                _log.warning("initial node registry announce failed; "
+                             "the heartbeat loop will retry",
+                             exc_info=True)
         self.app: Optional[AdminApp] = None
         if http:
             self.app = AdminApp(self.admin, port=admin_port).start()
@@ -207,6 +230,12 @@ class LocalPlatform:
         if self.slo_engine is not None:
             self.services.slo_engine = None
             self.slo_engine.close()  # drop the slo series
+        if self.node_registry is not None:
+            self.services.node_registry = None
+            try:
+                self.node_registry.close()  # withdraw + drop series
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # broker may already be gone at teardown
         if self.app is not None:
             self.app.stop()
         if self.stop_jobs_on_shutdown:

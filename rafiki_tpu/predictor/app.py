@@ -106,6 +106,27 @@ class PredictorService:
                     cache_admit_after if cache_admit_after is not None
                     else _env_knob("serving_cache_admit_after", "2")),
                 service=self.stats.service)
+        # Cluster cache fabric (docs/cluster.md): construction-time
+        # snapshot, active only when BOTH the fabric and the edge cache
+        # are on. Off (the default) = plain bool checks on the miss
+        # path, no frontend registration, zero fabric series — the
+        # bench's fabric-off side asserts exactly that.
+        self._fabric = False
+        self._fabric_probe_timeout = 0.25
+        self._m_fabric = None
+        if self.edge_cache is not None and _parse_bool(
+                _env_knob("cluster_fabric", "0")):
+            self._fabric = True
+            self._fabric_probe_timeout = float(
+                _env_knob("cluster_probe_timeout_s", "0.25") or 0.25)
+            from ..observe import metrics as obs_metrics
+
+            if obs_metrics.metrics_enabled():
+                self._m_fabric = obs_metrics.registry().counter(
+                    "rafiki_tpu_serving_fabric_total",
+                    "Cache-fabric events between peer frontends "
+                    "(event=peer_hit|peer_miss|probe_error|"
+                    "gossip_sent|gossip_recv)")
         if microbatch is None:
             microbatch = _parse_bool(_env_knob("serving_microbatch", "1"))
         self.microbatch = microbatch
@@ -171,6 +192,7 @@ class PredictorService:
             ("POST", "/predict", self._predict),
             ("POST", "/generate", self._generate),
             ("POST", "/cache/invalidate", self._cache_invalidate),
+            ("GET", "/cache/peek", self._cache_peek),
         ], host=host, port=port,
             # Same per-INSTANCE uniqueness rule as the stats label (and
             # sharing its suffix): a reused service id would merge two
@@ -191,9 +213,32 @@ class PredictorService:
                                  host="127.0.0.1", port=self.port)
         self.meta.update_inference_job(self.inference_job_id,
                                        predictor_host=host)
+        if self._fabric:
+            # Join the job's frontend registry so peers can probe this
+            # cache and the admin's invalidate fan-out can reach it.
+            # Keyed by the per-INSTANCE stats label (service ids are
+            # reused within one test process).
+            try:
+                self.predictor.cache.register_frontend(
+                    self.inference_job_id, self.stats.service, host)
+            except (ConnectionError, OSError, RuntimeError):
+                # Degraded but alive: this frontend still serves (and
+                # probes peers); peers just cannot find IT until a
+                # restart re-registers.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "cache-fabric frontend registration failed",
+                    exc_info=True)
         return self
 
     def stop(self) -> None:
+        if self._fabric:
+            try:
+                self.predictor.cache.unregister_frontend(
+                    self.inference_job_id, self.stats.service)
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # broker gone = registration gone with it
         self._http.stop()
         if self.batcher is not None:
             self.batcher.stop()
@@ -206,6 +251,9 @@ class PredictorService:
         self.predictor.close()
         if self.edge_cache is not None:
             self.edge_cache.close()
+        if self._m_fabric is not None:
+            # rta: disable=RTA106 handle bound once in __init__ and never rebound; remove()/inc() lock internally — a late fabric event racing stop-time series removal is benign
+            self._m_fabric.remove(service=self.stats.service)
         from ..observe import metrics as obs_metrics
 
         for name in ("rafiki_tpu_http_request_seconds",
@@ -266,11 +314,106 @@ class PredictorService:
         served a pre-promotion entry. Unauthenticated like every other
         predictor route (invalidation is a safe, idempotent act);
         answers ``enabled: false`` with no side effect when the cache
-        is off."""
+        is off.
+
+        Cluster fabric: a DIRECT invalidation is gossiped (best-effort)
+        to every peer frontend so a hot key invalidated here cannot be
+        served stale from a peer's cache for its whole TTL. A gossiped
+        frame carries ``{"gossip": true}`` and is NEVER re-forwarded —
+        the fan-out is one hop deep by construction, no storms."""
         if self.edge_cache is None:
             return 200, {"enabled": False}
-        return 200, {"enabled": True,
-                     "epoch": self.edge_cache.invalidate()}
+        gossip = bool(body and body.get("gossip"))
+        epoch = self.edge_cache.invalidate()
+        if self._fabric:
+            if gossip:
+                self._fabric_event("gossip_recv")
+            else:
+                self._gossip_invalidate()
+        return 200, {"enabled": True, "epoch": epoch}
+
+    def _cache_peek(self, params, body, ctx):
+        """Read-only cache-fabric probe (docs/cluster.md): a PEER
+        frontend asks whether this cache holds ``key`` before paying
+        its own scatter. Side-effect free — see ``EdgeCache.peek``."""
+        if self.edge_cache is None:
+            return 200, {"enabled": False, "found": False}
+        found, value = self.edge_cache.peek(ctx.query_one("key") or "")
+        return 200, {"enabled": True, "found": found,
+                     "value": value if found else None}
+
+    # --- Cache fabric (docs/cluster.md) ---
+
+    def _fabric_event(self, event: str) -> None:
+        if self._m_fabric is not None:
+            self._m_fabric.inc(service=self.stats.service, event=event)
+
+    def _fabric_peers(self) -> list:
+        """Sorted HTTP addrs of every OTHER registered frontend of this
+        job. Read from the bus per miss batch (not memoized): frontend
+        churn is deploy-rate, the kv read is one bus round-trip, and a
+        stale peer list would turn every miss into a probe_error for
+        the whole memo lifetime."""
+        try:
+            peers = self.predictor.cache.frontends(self.inference_job_id)
+        except (ConnectionError, OSError, RuntimeError):
+            return []
+        return sorted(addr for inst, addr in peers.items()
+                      if inst != self.stats.service)
+
+    def _peer_probe(self, key: str) -> Any:
+        """ONE bounded probe for a missed key: ask a single peer (picked
+        by key hash, so N frontends spread probe load instead of all
+        hammering peer[0]) whether it already holds the answer. Returns
+        the peer's value or None; never raises — the miss path falls
+        through to its own scatter, and the probe timeout
+        (cluster_probe_timeout_s) bounds the added latency."""
+        peers = self._fabric_peers()
+        if not peers:
+            return None
+        addr = peers[int(key[:8] or "0", 16) % len(peers)]
+        from urllib.parse import quote
+        from urllib.request import urlopen
+
+        try:
+            with urlopen(f"http://{addr}/cache/peek?key={quote(key)}",
+                         timeout=self._fabric_probe_timeout) as resp:
+                reply = json.loads(resp.read())
+        except (OSError, ValueError):
+            self._fabric_event("probe_error")
+            return None
+        if reply.get("found"):
+            self._fabric_event("peer_hit")
+            return reply.get("value")
+        self._fabric_event("peer_miss")
+        return None
+
+    def _gossip_invalidate(self) -> None:
+        """Best-effort one-hop invalidation fan-out to peer frontends.
+        The admin's synchronous promote-path fan-out is the correctness
+        mechanism; gossip covers direct invalidations so peers converge
+        within a probe timeout instead of a cache TTL. Failures are
+        logged, never raised — a dead peer's cache dies with it."""
+        from urllib.request import Request, urlopen
+
+        for addr in self._fabric_peers():
+            try:
+                req = Request(f"http://{addr}/cache/invalidate",
+                              data=b'{"gossip": true}',
+                              headers={"Content-Type":
+                                       "application/json"},
+                              method="POST")
+                with urlopen(req,
+                             timeout=self._fabric_probe_timeout) as r:
+                    r.read()
+            except OSError:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "cache-fabric gossip to %s failed", addr,
+                    exc_info=True)
+                continue
+            self._fabric_event("gossip_sent")
 
     def _run_queries(self, encoded_queries,
                      client: Optional[str] = None,
@@ -327,6 +470,23 @@ class PredictorService:
         # promotion) landing while the scatter is in flight bumps it,
         # and resolve() then drops the stale insert.
         epoch = cache.epoch
+        if misses and self._fabric:
+            # Cache fabric (docs/cluster.md): before paying a scatter,
+            # ask ONE peer whether it already holds the key — a hot key
+            # is then computed once per CLUSTER, not once per frontend.
+            # The epoch was captured ABOVE, before the probe: a
+            # gossiped invalidation racing the probe bumps it, and
+            # resolve() drops the stale insert (this request still gets
+            # the answer — same contract as an in-flight scatter).
+            still = []
+            for i, key, flight in misses:
+                value = self._peer_probe(key)
+                if value is not None:
+                    results[i] = value
+                    cache.resolve(key, value, epoch, flight=flight)
+                else:
+                    still.append((i, key, flight))
+            misses = still
         if misses:
             try:
                 sub = self._dispatch_queries(

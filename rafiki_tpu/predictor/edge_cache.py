@@ -216,6 +216,22 @@ class EdgeCache:
         self._event("miss")
         return "lead", flight
 
+    def peek(self, key: str) -> Tuple[bool, Any]:
+        """Read-only cache-fabric probe (docs/cluster.md): a PEER
+        frontend asks whether this cache already holds ``key``.
+        TTL-checked but otherwise side-effect free — no recency bump,
+        no admission counting, no hit/miss event — because a peer's
+        probe must not distort THIS frontend's eviction or admission
+        signals. Returns ``(found, value)``."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return False, None
+            value, _, expires = entry
+            if time.monotonic() >= expires:
+                return False, None
+            return True, value
+
     def resolve(self, key: str, value: Any, epoch: int,
                 flight: Optional[_Flight] = None) -> None:
         """Leader completion: insert (epoch- and admission-gated) and

@@ -251,6 +251,25 @@ class Predictor:
             shard_replicas = _parse_bool(os.environ.get(
                 "RAFIKI_TPU_SERVING_SHARD_REPLICAS", "1"))
         self.shard_replicas = shard_replicas
+        # Cluster fabric (docs/cluster.md), construction-time snapshot
+        # like every other knob here: this frontend's node identity
+        # (injected by the placing ServicesManager) and the same-node
+        # shard-weight boost. Fabric off = empty node, boost 1.0 —
+        # every cluster branch below is a falsy check, byte-identical
+        # single-node behavior.
+        from ..config import NodeConfig, _parse_bool
+        from ..constants import EnvVars as _EnvVars
+
+        cluster_on = _parse_bool(os.environ.get(
+            NodeConfig.env_name("cluster_fabric"), "0"))
+        self._node = (os.environ.get(_EnvVars.NODE_ID) or "") \
+            if cluster_on else ""
+        self._locality_boost = float(os.environ.get(
+            NodeConfig.env_name("cluster_locality_boost"), "1.0")
+            or 1.0) if cluster_on else 1.0
+        # worker_id -> node id from its registration ("" = unknown /
+        # pre-cluster worker). Memoized with _bins.
+        self._nodes: Dict[str, str] = {}
         self._rr = 0  # replica round-robin cursor
         # worker_id -> trial bin, memoized: registration info is
         # immutable per worker id, and per-request bus.get fan-out
@@ -390,6 +409,7 @@ class Predictor:
             self._bins[worker_id] = bin_id
             self._wire_ok[worker_id] = WIRE_NDBATCH in (
                 info.get("wire") or ())
+            self._nodes[worker_id] = str(info.get("node") or "")
             score = info.get("score")
             if isinstance(score, (int, float)):
                 self._bin_score[bin_id] = float(score)
@@ -416,6 +436,8 @@ class Predictor:
                               if w in live}
                 self._wire_ok = {w: v for w, v in self._wire_ok.items()
                                  if w in live}
+                self._nodes = {w: v for w, v in self._nodes.items()
+                               if w in live}
                 self._lat = {w: v for w, v in self._lat.items()
                              if w in live}
                 self._penalized = {w: t for w, t
@@ -578,7 +600,18 @@ class Predictor:
         the bin's batch is sliced across ALL its live replicas, sized
         inversely to each replica's gather-latency EWMA (even slices
         until latencies are known); a replica whose weighted slice
-        rounds to zero is skipped."""
+        rounds to zero is skipped.
+
+        Cluster locality (docs/cluster.md): with the fabric on and
+        ``cluster_locality_boost`` > 1, a same-node replica's weight is
+        multiplied by the boost — it takes the larger slice while the
+        measured latency gap stays under the boost factor, and the EWMA
+        still rules beyond that (a slow local replica loses to a fast
+        remote one)."""
+        nodes: Dict[str, str] = {}
+        if self._node and self._locality_boost > 1.0:
+            with self._state_lock:
+                nodes = dict(self._nodes)
         plan: List[_Shard] = []
         for bin_id, members in sorted(groups.items()):
             if not self.shard_replicas or len(members) == 1 or n == 1:
@@ -592,7 +625,9 @@ class Predictor:
             known = [v for w in order
                      if (v := lat.get(w)) is not None and v > 0]
             default = sum(known) / len(known) if known else 1.0
-            weights = [1.0 / max(lat.get(w, default), 1e-6)
+            weights = [(self._locality_boost
+                        if nodes.get(w) == self._node else 1.0)
+                       / max(lat.get(w, default), 1e-6)
                        for w in order]
             total_w = sum(weights)
             raw = [n * w / total_w for w in weights]
@@ -760,6 +795,18 @@ class Predictor:
                     if self._wire_ok.get(w))
         return _WirePayload(queries, pre_encoded, capable)
 
+    def _plan_nodes(self, plan: List["_Shard"],
+                    ) -> Optional[Dict[str, str]]:
+        """Per-worker node map for one plan's scatter (None with the
+        fabric off — the cache keeps its byte-identical single-broker
+        path). Memoized registration reads only; unknown workers map to
+        "" and stay on the local broker."""
+        if not self._node:
+            return None
+        with self._state_lock:
+            return {s.worker: self._nodes.get(s.worker, "")
+                    for s in plan}
+
     def _scatter(self, plan: List[_Shard], wire: _WirePayload,
                  trace_ctxs: Optional[List[Any]],
                  batch_id: Optional[str] = None,
@@ -783,7 +830,9 @@ class Predictor:
             [s.wire() for s in plan], enc,
             batch_id=batch_id, trace_ctxs=trace_ctxs,
             packed=packed, packed_ok=wire.capable,
-            tenants=tenants)
+            tenants=tenants,
+            worker_nodes=self._plan_nodes(plan),
+            local_node=self._node)
         if self._m_shards is not None:
             self._m_shards.inc(len(plan), service=self.service)
         bin_queries: Dict[str, int] = {}
@@ -987,7 +1036,9 @@ class Predictor:
                 self.cache.send_query_shards(
                     [s.wire() for s in retries], enc,
                     batch_id=batch_id, trace_ctxs=trace_ctxs,
-                    packed=packed, packed_ok=wire.capable)
+                    packed=packed, packed_ok=wire.capable,
+                    worker_nodes=self._plan_nodes(retries),
+                    local_node=self._node)
                 plan.extend(retries)
                 if self._m_resubmits is not None:
                     self._m_resubmits.inc(len(retries),

@@ -436,6 +436,10 @@ class InferenceWorker:
         self.max_op_errors = int(os.environ.get(
             "RAFIKI_TPU_WORKER_MAX_OP_ERRORS", "30"))
         self.stop_flag = threading.Event()
+        # node.kill (chaos plane): a hard kill must NOT run the clean
+        # shutdown tail — the run() loop re-checks this after the serve
+        # loop exits and dies through the injected-crash path instead.
+        self.hard_killed = False
         self._thread: Optional[threading.Thread] = None
         self._model: Optional[Any] = None
         self._bin_score: Optional[float] = None  # set by _load_model
@@ -462,6 +466,20 @@ class InferenceWorker:
         return self
 
     def stop(self, join_timeout: float = 10.0) -> None:
+        self.stop_flag.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+
+    def kill(self, join_timeout: float = 10.0) -> None:
+        """Hard kill: the serve loop exits at its next poll and dies
+        through the injected-crash path — meta row left RUNNING, bus
+        registration stale — the wreckage a real node death leaves. A
+        thread can't be pre-empted mid-burst, so an in-flight batch
+        still completes; "hard" here means the shutdown protocol
+        (pending flush aside) is skipped, not that the thread stops
+        instantly."""
+        # rta: disable=RTA106 monotonic one-way bool (False -> True once) read by the serve loop after it exits — the documented benign flag case
+        self.hard_killed = True
         self.stop_flag.set()
         if self._thread is not None:
             self._thread.join(timeout=join_timeout)
@@ -609,7 +627,15 @@ class InferenceWorker:
                               "gen": gen_info,
                               "staging": self._staging_mode,
                               "metrics": os.environ.get(
-                                  _EnvVars.METRICS_ADDR) or None}
+                                  _EnvVars.METRICS_ADDR) or None,
+                              # "node" identifies the cluster node that
+                              # placed this worker (docs/cluster.md):
+                              # frontends use it to route shards via
+                              # the per-node brokers and to prefer
+                              # same-node replicas. None on a
+                              # single-node deployment.
+                              "node": os.environ.get(
+                                  _EnvVars.NODE_ID) or None}
             self.cache.register_worker(self.inference_job_id,
                                        self.service_id,
                                        info=self._reg_info)
@@ -756,6 +782,9 @@ class InferenceWorker:
                         last_reg = _time.monotonic()
                     except (ConnectionError, OSError, RuntimeError):
                         pass  # broker still down; retry next iteration
+            if self.hard_killed:
+                raise faults.InjectedCrash(
+                    "injected: node.kill — hard node death")
             if pending is not None:
                 self._complete_batch(*pending)
             self._stop_profile()
@@ -1028,7 +1057,8 @@ class InferenceWorker:
                 self.cache.send_prediction_batch(
                     it["batch_id"], self.service_id,
                     [err] * max(1, int(it.get("n", 1) or 1)),
-                    shard=it.get("shard"))
+                    shard=it.get("shard"),
+                    origin_node=it.get("onode"))
             else:
                 good.append(it)
         finisher = None
@@ -1169,7 +1199,10 @@ class InferenceWorker:
                     confidence=(confidence[start:start + count]
                                 if confidence is not None else None),
                     compute_s=round(burst_s * count / max(n, 1), 6),
-                    packed_ok=WIRE_NDBATCH in (it.get("rw") or ()))
+                    packed_ok=WIRE_NDBATCH in (it.get("rw") or ()),
+                    # A cross-node shard carries its origin node: the
+                    # reply relays back to THAT node's broker.
+                    origin_node=it.get("onode"))
             else:
                 self.cache.send_prediction(it["query_id"], self.service_id,
                                            predictions[start],

@@ -1,0 +1,36 @@
+"""FP guard for DOTTED module-global locks: a collaborator module's
+lock reached as ``modlock._CACHE_LOCK`` guards exactly like the
+bare-name spelling — consistent holds with blocking only after
+release, and a cross-root pair fully under the lock, must all stay
+clean."""
+
+import threading
+
+from rafiki_tpu import modlock
+
+
+def export_remote(path):
+    with modlock._CACHE_LOCK:
+        snap = dict(modlock._cache)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(str(snap))
+
+
+class DottedLockedPoller:
+    """The ModuleLockedPoller shape through a module reference: the
+    loop thread and callers share ``_latest`` under the collaborator
+    module's lock — the dotted spelling must count as the guard."""
+
+    def __init__(self):
+        self._latest = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            with modlock._CACHE_LOCK:
+                self._latest = modlock._cache.get("k")
+
+    def peek(self):
+        with modlock._CACHE_LOCK:
+            return self._latest

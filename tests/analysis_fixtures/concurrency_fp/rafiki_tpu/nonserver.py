@@ -1,0 +1,24 @@
+"""FP guard for handler-class ctor args: only a ``*Server`` ctor
+makes a passed class's ``handle`` a per-connection thread root. A
+plain pipeline taking a worker class must NOT — ``Worker._count``
+then has a single (caller) side and stays clean."""
+
+
+class Pipeline:
+    def __init__(self, worker_cls):
+        self.worker_cls = worker_cls
+
+
+class Worker:
+    def __init__(self):
+        self._count = 0
+
+    def handle(self):
+        self._count += 1
+
+    def count(self):
+        return self._count
+
+
+def build():
+    return Pipeline(Worker)

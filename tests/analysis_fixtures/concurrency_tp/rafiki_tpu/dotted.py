@@ -1,0 +1,37 @@
+"""Dotted module-global-lock TPs: the lock lives in registry.py and
+is reached THROUGH the module (``registry._REG_LOCK``) — the spelling
+every OTHER module actually uses.
+
+- RTA105 (direct): ``flush`` sleeps inside ``with
+  registry._REG_LOCK:`` in a free function;
+- RTA104: ``Ledger.write`` takes ``Ledger._lock ->
+  registry._REG_LOCK`` while ``rewind`` orders them the other way —
+  the dotted reference must UNIFY with the bare-name spelling
+  registry.py itself uses, or the cycle is invisible.
+"""
+
+import threading
+import time
+
+from rafiki_tpu import registry
+
+
+def flush(name):
+    with registry._REG_LOCK:
+        time.sleep(0.01)
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def write(self, row):
+        with self._lock:
+            with registry._REG_LOCK:
+                self._rows.append(row)
+
+    def rewind(self):
+        with registry._REG_LOCK:
+            with self._lock:
+                self._rows.pop()

@@ -1,0 +1,23 @@
+"""socketserver handler-root TP: ``FrameHandler`` never constructs a
+thread itself, but ``serve`` passes the CLASS to a ``*Server`` ctor,
+which calls ``handle()`` on a per-connection thread. ``_hits`` is
+written there and read by callers with no lock anywhere — RTA106,
+visible only if the ctor argument registers as a thread root."""
+
+import socketserver
+
+
+class FrameHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        self._hits = getattr(self, "_hits", 0) + 1
+
+    def hits(self):
+        return self._hits
+
+
+class FrameServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+
+
+def serve(host, port):
+    return FrameServer((host, port), FrameHandler)

@@ -181,6 +181,19 @@ def test_concurrency_true_positives(tmp_path):
     assert "drain:time.sleep():direct" in by_anchor
     # ...and a lock-order cycle between a CLASS lock and a MODULE one.
     assert "Journal._lock<->rafiki_tpu.registry._REG_LOCK" in by_anchor
+    # r19 carry: the DOTTED spelling (``registry._REG_LOCK`` from a
+    # ``from rafiki_tpu import registry`` import) must unify with the
+    # bare name — a free function blocking under it...
+    dd = by_anchor["flush:time.sleep():direct"]
+    assert "rafiki_tpu.registry._REG_LOCK" in dd.message
+    assert dd.path.endswith("dotted.py")
+    # ...and a class-vs-module cycle reached only through the dotted
+    # reference.
+    assert "Ledger._lock<->rafiki_tpu.registry._REG_LOCK" in by_anchor
+    # socketserver shape: ``FrameServer((h, p), FrameHandler)`` makes
+    # handle() a per-connection thread root on the HANDLER class.
+    hh = by_anchor["FrameHandler._hits:cross-root"]
+    assert "'handle'" in hh.message and hh.path.endswith("server.py")
 
 
 def test_concurrency_false_positive_guard(tmp_path):
@@ -601,6 +614,33 @@ def test_blocking_under_module_lock_fails_suite(tmp_path):
     report = run_suite(mutated, only=["concurrency"])
     assert any(f.code == "RTA105" and
                f.anchor == "configure:time.sleep():direct"
+               for f in report.new), [f.render() for f in report.new]
+
+
+def test_handler_thread_root_fails_suite(tmp_path):
+    """r19 carry: the TCP broker's ``_Handler`` runs ``handle()`` on a
+    per-connection thread because ``_Server((host, port), _Handler)``
+    registers it — a root no ``threading.Thread`` scan can see.
+    Introducing an unguarded cross-root attribute on the handler must
+    turn the suite red via RTA106; the clean source must stay green."""
+    clean = _mutated_tree(tmp_path / "clean", "rafiki_tpu/bus/tcp.py", [])
+    report = run_suite(clean, only=["concurrency"])
+    assert not [f for f in report.new
+                if f.code == "RTA106" and "_Handler" in f.anchor], \
+        [f.render() for f in report.new]
+    mutated = _mutated_tree(
+        tmp_path / "mut", "rafiki_tpu/bus/tcp.py",
+        [("class _Handler(socketserver.BaseRequestHandler):\n"
+          "    def handle(self):",
+          "class _Handler(socketserver.BaseRequestHandler):\n"
+          "    def frames_served(self):\n"
+          "        return self._frames\n"
+          "\n"
+          "    def handle(self):\n"
+          "        self._frames = getattr(self, \"_frames\", 0) + 1")])
+    report = run_suite(mutated, only=["concurrency"])
+    assert any(f.code == "RTA106" and
+               f.anchor == "_Handler._frames:cross-root"
                for f in report.new), [f.render() for f in report.new]
 
 
