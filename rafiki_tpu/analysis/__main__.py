@@ -27,6 +27,13 @@ def main(argv=None) -> int:
     parser.add_argument("--changed", action="store_true",
                         help="only analyze files changed vs the "
                              "merge-base with main + uncommitted work")
+    parser.add_argument("--diff", metavar="BASE", default=None,
+                        help="incremental mode vs an explicit git "
+                             "base (commit/ref): file-local checkers "
+                             "see only changed files, whole-program "
+                             "checkers still run on the full model "
+                             "when triggered; prints per-checker "
+                             "wall time")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the full machine-readable report")
     parser.add_argument("--update-baseline", action="store_true",
@@ -65,17 +72,25 @@ def main(argv=None) -> int:
             # and exit 0 — a typo'd CI invocation must not go green.
             parser.error("unknown checker(s): %s (names: %s)"
                          % (", ".join(bad), ", ".join(sorted(known))))
-    if args.update_baseline and (args.changed or args.checker):
+    if args.update_baseline and (args.changed or args.checker
+                                 or args.diff):
         # A scoped run never produces findings for unscanned files or
         # checkers, so rewriting the baseline from it would silently
         # drop every frozen entry outside the scope.
         parser.error("--update-baseline requires a full run "
-                     "(drop --changed/--checker)")
+                     "(drop --changed/--checker/--diff)")
+    if args.changed and args.diff:
+        parser.error("--changed and --diff are the same mode with "
+                     "different bases; pick one")
 
     root = args.root or core.repo_root()
     bl_path = args.baseline or core.baseline_path()
     baseline = {} if args.no_baseline else core.load_baseline(bl_path)
-    changed = core.changed_files(root) if args.changed else None
+    changed = None
+    if args.diff is not None:
+        changed = core.changed_files(root, base=args.diff)
+    elif args.changed:
+        changed = core.changed_files(root)
 
     report = core.run_suite(root, changed=changed, baseline=baseline,
                             only=args.checker)
@@ -113,6 +128,11 @@ def main(argv=None) -> int:
               f"{len(report.findings)} findings "
               f"({n_base} baselined, {n_waived} waived) "
               f"[checkers: {', '.join(report.checkers)}]")
+        if args.diff is not None:
+            times = "  ".join(f"{k} {v:.2f}s" for k, v in
+                              sorted(report.timings.items(),
+                                     key=lambda kv: -kv[1]))
+            print(f"timings: {times}", file=sys.stderr)
     return 1 if report.new else 0
 
 

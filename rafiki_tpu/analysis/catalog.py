@@ -248,6 +248,65 @@ CATALOG: Dict[str, Dict[str, str]] = {
                 "from the bus path; the finding prints the import "
                 "chain.",
     },
+    "RTA701": {
+        "title": "bus queue-flow drift (orphan producer / dead "
+                 "consumer)",
+        "flags": "A queue-name family (the literal, or an f-string's "
+                 "literal prefix, through the first ':') pushed with "
+                 "no in-tree popper, or popped with no in-tree "
+                 "pusher; and a control-frame op token (__drain__ "
+                 "style) produced without a dispatcher or vice "
+                 "versa. Names forwarded through a helper's `queue` "
+                 "parameter resolve through the call graph to the "
+                 "real producer/consumer.",
+        "bug": "The bus is stringly-typed: renaming the worker input "
+               "queue on ONE side (cache push vs worker pop) "
+               "deadlocks serving with every unit test green — the "
+               "exact defect class the continuous-batching reply "
+               "queues (`r:`) and advisor RPC queues (`adv:`) ship "
+               "more of every PR.",
+        "hint": "Spell both sides from one shared helper/constant; "
+                "fully dynamic names (empty f-string prefix) are "
+                "exempt by design — prefer a literal family prefix "
+                "so the checker can see the seam.",
+    },
+    "RTA702": {
+        "title": "HTTP route drift (caller vs served route table)",
+        "flags": "An in-tree HTTP caller (client SDK `_call`, "
+                 "autoscaler/SLO `fetch` scrapes, peer "
+                 "urlopen/Request probes, session uploads, dashboard "
+                 "`api(...)`) whose method+path matches no served "
+                 "route tuple; or a served route no in-tree caller "
+                 "ever hits (waivable for operator-only surfaces). "
+                 "Dynamic path segments are wildcards on both sides.",
+        "bug": "The predictor admin split moved `/services/<id>/...` "
+               "handlers between apps more than once; a typo'd "
+               "client path 404s only at runtime, and a dead route "
+               "is untested attack surface that drifts silently.",
+        "hint": "Fix the caller's spelling or register the route; "
+                "for deliberately caller-less routes (health/debug "
+                "surfaces) waive at the route tuple with the reason.",
+    },
+    "RTA703": {
+        "title": "feature-flag off-path side effect",
+        "flags": "For a declared default-off flag (flow.FLAG_REGISTRY"
+                 "; seeded with RAFIKI_TPU_CLUSTER_FABRIC): a thread "
+                 "spawn, metric-series registration, bus subscription "
+                 "loop, or socket open reachable from import or "
+                 "construction without passing the flag gate — an "
+                 "ungated import-time effect in a flag-owned module, "
+                 "an ungated constructor call of a flag-owned class, "
+                 "an effect in an unprotected flag-owned function, "
+                 "or a flag-owned metric series registered ungated.",
+        "bug": "Disabled-means-free is a hard invariant (r11): a "
+               "scrape with the fabric flag off must show ZERO "
+               "fabric series and spawn zero fabric threads; one "
+               "ungated NodeRegistry construction silently puts the "
+               "whole fleet's off-path on the fabric heartbeat.",
+        "hint": "Gate the effect (or every call site of its "
+                "function) with the flag; new default-off subsystems "
+                "must add their entry to flow.FLAG_REGISTRY.",
+    },
 }
 
 

@@ -4,6 +4,7 @@ from . import (  # noqa: F401
     concurrency,
     donation,
     drift,
+    flow,
     guarded_state,
     import_hygiene,
     series_lifecycle,

@@ -148,8 +148,12 @@ class ConcurrencyChecker(Checker):
         for (a, b), (key, line, how) in sorted(edges.items()):
             if not (a < b and (b, a) in edges):
                 continue
-            if program.lock_owner(a) == program.lock_owner(b):
-                continue  # intra-class: RTA103's territory
+            owner = program.lock_owner(a)
+            if owner == program.lock_owner(b) and \
+                    program.by_modname.get(owner) is None:
+                continue  # intra-class: RTA103's territory. Two locks
+                # of one MODULE stay ours: free functions have no class
+                # walk, so nothing else would ever see the cycle.
             anchor = f"{a}<->{b}"
             if anchor in paired:
                 continue
@@ -177,7 +181,8 @@ class ConcurrencyChecker(Checker):
             if len(scc) < 3 or any(lock in paired for lock in scc):
                 continue
             owners = {program.lock_owner(x) for x in scc}
-            if len(owners) < 2:
+            if len(owners) < 2 and \
+                    program.by_modname.get(next(iter(owners))) is None:
                 continue
             cyc = sorted(scc)
             key, line, how = edges[next(

@@ -72,6 +72,7 @@ class GuardedStateChecker(Checker):
                     if not cls.lock_attrs:
                         continue
                     findings.extend(self._check_class(mod.rel, cls))
+            findings.extend(self._module_unguarded(mod.rel, program))
         return findings
 
     def _check_class(self, rel: str, cls: _ClassInfo) -> List[Finding]:
@@ -120,6 +121,47 @@ class GuardedStateChecker(Checker):
                 hint=f"wrap the access in `with {lock_list.split('/')[0]}:` "
                      f"or waive with the reason the race is benign",
                 anchor=f"{cls.name}.{acc.attr}@{acc.method}"))
+        return findings
+
+    # --- RTA101, module-global form ---
+
+    def _module_unguarded(self, rel: str, program) -> List[Finding]:
+        """Free functions sharing module globals under module-global
+        locks (the observe/* registry shape): a global guarded by
+        ``with _lock:`` at some accesses but touched bare elsewhere is
+        the same race RTA101 flags on classes. Guards are inferred the
+        same way — the union of module locks ever held at an access —
+        so consistently-bare globals (no lock discipline at all) never
+        flag; the module equivalent of an unlocked class is out of
+        scope by design."""
+        ms = program.module_state(rel)
+        if not ms.accesses:
+            return []
+        guards: Dict[str, Set[str]] = {}
+        for name, held, _func, _line, _w in ms.accesses:
+            guards.setdefault(name, set()).update(held)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, str]] = set()
+        stem = rel.rsplit("/", 1)[-1][:-3]
+        for name, held, func, line, is_write in ms.accesses:
+            g = guards.get(name)
+            if not g or held & g:
+                continue
+            key = (name, func)
+            if key in seen:
+                continue
+            seen.add(key)
+            lock_list = "/".join(sorted(g))
+            findings.append(Finding(
+                code="RTA101", path=rel, line=line,
+                message=f"module global {name} is guarded by "
+                        f"{lock_list} elsewhere but "
+                        f"{'written' if is_write else 'read'} in "
+                        f"{func}() without holding it",
+                hint=f"wrap the access in `with "
+                     f"{lock_list.split('/')[0].rsplit('.', 1)[-1]}:` "
+                     f"or waive with the reason the race is benign",
+                anchor=f"{stem}:{name}@{func}"))
         return findings
 
     # --- RTA102 ---

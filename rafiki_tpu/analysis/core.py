@@ -28,6 +28,7 @@ import json
 import os
 import re
 import subprocess
+import time
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -279,6 +280,10 @@ class Report:
     #: analysis records per-code counts; a zero for RTA104 is
     #: evidence the gate looked, absence would be ambiguous).
     covered_codes: List[str] = dataclasses.field(default_factory=list)
+    #: Per-checker wall time (seconds) — the --diff mode's cost
+    #: breakdown, so a checker that stops scaling is visible in CI
+    #: output instead of as a slowly rotting gate latency.
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def new(self) -> List[Finding]:
@@ -302,6 +307,8 @@ class Report:
             "by_status": by_status,
             "new": len(self.new),
             "stale_baseline": self.stale_baseline,
+            "timings_s": {k: round(v, 4)
+                          for k, v in self.timings.items()},
             "findings": [f.to_json() for f in self.findings],
         }
 
@@ -326,6 +333,7 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
 
     ran = []
     covered: List[str] = []
+    timings: Dict[str, float] = {}
     for checker in all_checkers():
         if only and checker.name not in only:
             continue
@@ -333,7 +341,9 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
             continue
         ran.append(checker.name)
         covered.extend(checker.codes)
+        t0 = time.perf_counter()
         findings.extend(checker.run(ctx))
+        timings[checker.name] = time.perf_counter() - t0
 
     # Reason-less waivers are findings in their own right, everywhere
     # (including modules no checker flagged).
@@ -426,7 +436,8 @@ def run_suite(root: str, changed: Optional[Set[str]] = None,
         stale = []
     return Report(root=ctx.root, findings=deduped,
                   n_files=len(ctx.modules), checkers=ran,
-                  stale_baseline=stale, covered_codes=covered)
+                  stale_baseline=stale, covered_codes=covered,
+                  timings=timings)
 
 
 def _waiver_covers(codes: Set[str], code: str) -> bool:
@@ -452,9 +463,12 @@ def _code_covered(code: str, covered: Sequence[str]) -> bool:
 
 # --- Git (--changed mode) --------------------------------------------
 
-def changed_files(root: str) -> Set[str]:
+def changed_files(root: str, base: Optional[str] = None) -> Set[str]:
     """Repo-relative paths touched since the merge-base with main plus
-    anything uncommitted/untracked — the fast pre-commit scope."""
+    anything uncommitted/untracked — the fast pre-commit scope. An
+    explicit ``base`` (``--diff <base>``) pins the comparison point
+    instead of discovering it (CI diffing a PR against its merge
+    target, or re-running against an arbitrary commit)."""
 
     def git(*args: str) -> List[str]:
         try:
@@ -468,12 +482,13 @@ def changed_files(root: str) -> Set[str]:
         return [ln.strip() for ln in out.stdout.splitlines()
                 if ln.strip()]
 
-    base = "HEAD"
-    for ref in ("origin/main", "origin/master", "main", "master"):
-        mb = git("merge-base", "HEAD", ref)
-        if mb:
-            base = mb[0]
-            break
+    if base is None:
+        base = "HEAD"
+        for ref in ("origin/main", "origin/master", "main", "master"):
+            mb = git("merge-base", "HEAD", ref)
+            if mb:
+                base = mb[0]
+                break
     changed: Set[str] = set()
     changed.update(git("diff", "--name-only", base))
     changed.update(git("diff", "--name-only"))           # worktree

@@ -645,7 +645,7 @@ def _toplevel_stmts(tree: ast.AST):
 
 class MethodSummary:
     __slots__ = ("key", "node", "cls_key", "direct_locks", "calls",
-                 "blocking", "held_blocking")
+                 "call_nodes", "blocking", "held_blocking")
 
     def __init__(self, key, node, cls_key):
         self.key = key          # (rel, clsname-or-None, methodname)
@@ -655,6 +655,11 @@ class MethodSummary:
         self.direct_locks: List[Tuple[str, frozenset, int]] = []
         #: (frozenset of qualified held, target key or None, line, label)
         self.calls: List[Tuple[frozenset, Optional[tuple], int, str]] = []
+        #: (target key or None, the ast.Call node) — the cross-process
+        #: checkers (RTA7xx) re-examine resolved call sites with their
+        #: actual argument expressions (queue-name forwarding, flag-gate
+        #: classification); the lock-graph tuple above stays lean.
+        self.call_nodes: List[Tuple[Optional[tuple], ast.Call]] = []
         #: (label, line) of the first direct blocking call, or None.
         self.blocking: Optional[Tuple[str, int]] = None
         #: Direct blocking calls made WITH a qualified lock held:
@@ -692,6 +697,7 @@ class Program:
                 self._class_module[id(cnode)] = mi.rel
         self._attr_types: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
         self._module_locks: Dict[str, Dict[str, str]] = {}
+        self._module_state: Dict[str, "ModuleState"] = {}
         self._extra_roots: Optional[
             Dict[Tuple[str, str], Dict[str, Tuple[str, str]]]] = None
         self._summaries: Optional[Dict[tuple, MethodSummary]] = None
@@ -869,6 +875,59 @@ class Program:
         self._module_locks[rel] = out
         return out
 
+    # -- module-global mutable state (free-function RTA101) --
+
+    def module_state(self, rel: str) -> "ModuleState":
+        """The module-level analog of ``_ClassInfo`` state tracking:
+        names bound at top level AND rebound via ``global`` in at
+        least one free function are the module's mutable state; every
+        free-function access is recorded with the module-lock held
+        set. Names a function assigns WITHOUT declaring ``global`` are
+        that function's locals (Python scoping) and are skipped there.
+        Depth-0 only — closures run later and inherit nothing."""
+        cached = self._module_state.get(rel)
+        if cached is not None:
+            return cached
+        ms = ModuleState()
+        mi = self.modules.get(rel)
+        if mi is None or mi.tree is None:
+            self._module_state[rel] = ms
+            return ms
+        top_bound: Set[str] = set()
+        for stmt, _guarded in _toplevel_stmts(mi.tree):
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        top_bound.add(tgt.id)
+        fn_globals: Dict[str, Set[str]] = {}
+        declared: Set[str] = set()
+        for fname, fnode in mi.functions.items():
+            g: Set[str] = set()
+            for sub in ast.walk(fnode):
+                if isinstance(sub, ast.Global):
+                    g.update(sub.names)
+            fn_globals[fname] = g
+            declared.update(g)
+        locks = self.module_lock_names(rel)
+        ms.candidates = (declared & top_bound) - mi.global_locks
+        if ms.candidates and locks:
+            for fname, fnode in mi.functions.items():
+                stored: Set[str] = set()
+                for sub in ast.walk(fnode):
+                    if isinstance(sub, ast.Name) and \
+                            isinstance(sub.ctx, (ast.Store, ast.Del)):
+                        stored.add(sub.id)
+                skip = (stored - fn_globals[fname]) & ms.candidates
+                walker = _ModuleStateWalker(locks, ms.candidates, skip,
+                                            fname, ms.accesses)
+                for stmt in fnode.body:
+                    walker.visit(stmt)
+        self._module_state[rel] = ms
+        return ms
+
     # -- cross-class thread roots --
 
     def extra_class_roots(self, cls_key: Tuple[str, str]
@@ -894,18 +953,90 @@ class Program:
                     atypes = self.attr_types((mi.rel, cname))
                     for m in info.methods():
                         self._collect_foreign_targets(
-                            mi.rel, atypes,
+                            mi.rel, (mi.rel, cname), atypes,
                             self._local_types(mi.rel, (mi.rel, cname),
                                               m, atypes), m)
                 for fnode in mi.functions.values():
                     self._collect_foreign_targets(
-                        mi.rel, {},
+                        mi.rel, None, {},
                         self._local_types(mi.rel, None, fnode, {}),
                         fnode)
         return self._extra_roots.get(cls_key, {})
 
-    def _collect_foreign_targets(self, rel, atypes, local_types,
-                                 fnode) -> None:
+    def spawn_params(self) -> Dict[tuple, Dict[str, str]]:
+        """method/function key -> {param name: kind} for SPAWNER
+        helpers: functions whose body hands one of their own
+        parameters to ``Thread(target=param)`` or an executor
+        ``submit(param)``. A callable passed to such a parameter runs
+        on another thread — the ``register_consumer`` shape, where the
+        class that OWNS the loop method hands it to a different
+        class's spawn helper and neither per-class walk sees a root."""
+        cached = getattr(self, "_spawn_params", None)
+        if cached is not None:
+            return cached
+        out: Dict[tuple, Dict[str, str]] = {}
+        for key, s in self.summaries().items():
+            params = {a.arg for a in s.node.args.args +
+                      s.node.args.kwonlyargs}
+            if not params:
+                continue
+            for node in ast.walk(s.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                leaf = func.attr if isinstance(func, ast.Attribute) \
+                    else (func.id if isinstance(func, ast.Name) else "")
+                if leaf == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target" and \
+                                isinstance(kw.value, ast.Name) and \
+                                kw.value.id in params:
+                            out.setdefault(key, {})[kw.value.id] = \
+                                "thread"
+                elif leaf == "submit" and node.args and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in params:
+                    owner = func.value \
+                        if isinstance(func, ast.Attribute) else None
+                    ownername = (_self_attr(owner) or
+                                 (owner.id if isinstance(owner, ast.Name)
+                                  else "")) if owner is not None else ""
+                    if "pool" in ownername or "executor" in ownername \
+                            or "exec" in ownername:
+                        out.setdefault(key, {})[node.args[0].id] = \
+                            "submit"
+        self._spawn_params = out
+        return out
+
+    def _spawned_args(self, rel, cls_key, node: ast.Call, atypes,
+                      local_types) -> List[Tuple[str, ast.AST]]:
+        """(kind, callable expression) for arguments this call hands
+        to a spawner helper's spawn parameter (``spawn_params``)."""
+        target, _label = self._resolve_call(rel, cls_key, node, atypes,
+                                            local_types)
+        if target is None:
+            return []
+        spawn = self.spawn_params().get(target)
+        if not spawn:
+            return []
+        s = self.summaries().get(target)
+        if s is None:
+            return []
+        params = [a.arg for a in s.node.args.args]
+        offset = 1 if target[1] is not None and params and \
+            params[0] == "self" else 0
+        out: List[Tuple[str, ast.AST]] = []
+        for i, arg in enumerate(node.args):
+            j = offset + i
+            if j < len(params) and params[j] in spawn:
+                out.append((spawn[params[j]], arg))
+        for kw in node.keywords:
+            if kw.arg in spawn:
+                out.append((spawn[kw.arg], kw.value))
+        return out
+
+    def _collect_foreign_targets(self, rel, cls_key, atypes,
+                                 local_types, fnode) -> None:
         for node in ast.walk(fnode):
             if not isinstance(node, ast.Call):
                 continue
@@ -930,6 +1061,11 @@ class Program:
                 if "pool" in ownername or "executor" in ownername \
                         or "exec" in ownername:
                     targets.append(("submit", node.args[0]))
+            # A callable handed to ANOTHER function's spawn parameter
+            # (``helper.register_consumer(self.consumer.loop)``) is a
+            # root exactly like a direct Thread(target=...) here.
+            targets.extend(self._spawned_args(rel, cls_key, node,
+                                              atypes, local_types))
             for kind, value in targets:
                 if not isinstance(value, ast.Attribute):
                     continue
@@ -1346,6 +1482,92 @@ class Program:
         return None
 
 
+class ModuleState:
+    """Module-global mutable names + free-function accesses with the
+    module-lock held set — :meth:`Program.module_state`."""
+
+    __slots__ = ("candidates", "accesses")
+
+    def __init__(self) -> None:
+        self.candidates: Set[str] = set()
+        #: (name, held qualified lock ids, function, line, is_write)
+        self.accesses: List[Tuple[str, frozenset, str, int, bool]] = []
+
+
+class _ModuleStateWalker(ast.NodeVisitor):
+    """Depth-0 walk of one free function tracking module-lock holds
+    (bare ``with _LOCK:`` and dotted ``with mod._LOCK:`` spellings)
+    and recording accesses to the module's mutable globals."""
+
+    def __init__(self, locks: Dict[str, str], candidates: Set[str],
+                 skip: Set[str], func: str, out: list):
+        self.locks = locks
+        self.candidates = candidates
+        self.skip = skip
+        self.func = func
+        self.out = out
+        self.held: Tuple[str, ...] = ()
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.locks.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            return self.locks.get(f"{expr.value.id}.{expr.attr}")
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        entered = []
+        for item in node.items:
+            qid = self._lock_of(item.context_expr)
+            if qid is not None:
+                entered.append(qid)
+            else:
+                self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        prior = self.held
+        self.held = tuple(self.held) + tuple(entered)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prior
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # closures run later, inherit nothing — out of scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _record(self, name: str, line: int, is_write: bool) -> None:
+        self.out.append((name, frozenset(self.held), self.func, line,
+                         is_write))
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.candidates and node.id not in self.skip:
+            self._record(node.id, node.lineno,
+                         isinstance(node.ctx, (ast.Store, ast.Del)))
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in self.candidates and \
+                node.value.id not in self.skip:
+            self._record(node.value.id, node.lineno, True)
+            self.visit(node.slice)
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # Container-mutator call on a global is a WRITE of it.
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in self.candidates and \
+                node.func.value.id not in self.skip:
+            self._record(node.func.value.id, node.lineno, True)
+        self.generic_visit(node)
+
+
 class _QualifiedWalker(ast.NodeVisitor):
     """Walks one method filling its :class:`MethodSummary` with
     CLASS-QUALIFIED lock ids: own locks (``with self._cond:``) and
@@ -1442,6 +1664,7 @@ class _QualifiedWalker(ast.NodeVisitor):
             self._local_types)
         self.summary.calls.append(
             (self._effective(), target, node.lineno, label))
+        self.summary.call_nodes.append((target, node))
         blabel = _blocking_label(self.info, node)
         if blabel is None:
             blabel = self.program._bus_blocking_label(

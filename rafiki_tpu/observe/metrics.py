@@ -66,6 +66,7 @@ def exemplars_enabled() -> bool:
     (``RAFIKI_TPU_METRICS_EXEMPLARS``, default off), rendered
     OpenMetrics-style in the exposition. Resolved once per process."""
     global _exemplars_flag
+    # rta: disable=RTA101 double-checked init: the bare read is the fast path; the write re-checks under _exemplars_lock
     flag = _exemplars_flag
     if flag is None:
         with _exemplars_lock:
@@ -564,6 +565,7 @@ def serve_metrics(host: str = "0.0.0.0", port: int = 0,
     from ..utils.service import JsonHttpServer
 
     server = JsonHttpServer(
+        # rta: disable=RTA702 exporter liveness stub for scrapers; /metrics is the real surface
         [("GET", "/", lambda params, body, ctx: (200, {"status": "ok"}))],
         host=host, port=port, name=name)
     return server.start()

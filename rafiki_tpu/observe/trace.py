@@ -230,6 +230,7 @@ def start_trace(header: Optional[str] = None) -> Optional[TraceContext]:
     if rate <= 0.0 or (rate < 1.0 and random.random() >= rate):
         return None
     ctx = TraceContext(new_trace_id())
+    # rta: disable=RTA101 benign racy read of the sink pointer (GIL-atomic reference); worst case one sample misses tail registration
     if tail_sample_rate() is not None and _sink_path is not None:
         ctx.tail = True
         _tail_register(ctx.trace_id)
@@ -372,6 +373,7 @@ def configure(log_dir: Optional[str]) -> None:
 
 
 def configured() -> bool:
+    # rta: disable=RTA101 lock-free liveness probe; a reference read is GIL-atomic
     return _sink_path is not None
 
 
@@ -1058,7 +1060,8 @@ def exemplar_ok(ctx: TraceContext) -> bool:
 def seed_tail(seed: int) -> None:
     """Deterministic tail-sampling decisions (tests / seeded bench)."""
     global _tail_rng
-    _tail_rng = random.Random(seed)
+    with _tail_lock:
+        _tail_rng = random.Random(seed)
 
 
 def reset_tail_for_tests() -> None:
@@ -1079,6 +1082,7 @@ def record_event(name: str, service: str,
     common case) records a NEW span parented on each context's span;
     ``child=False`` records the context's own span (the HTTP edge,
     which minted it)."""
+    # rta: disable=RTA101 hot-path early-out on the sink pointer (GIL-atomic reference read); the append path re-reads under _sink_lock
     if _sink_path is None:
         return
     lines: List[Tuple[Optional[TraceContext], str]] = []

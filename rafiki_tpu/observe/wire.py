@@ -164,6 +164,7 @@ _lock = threading.Lock()
 
 def _counters() -> Tuple:
     global _state
+    # rta: disable=RTA101 double-checked init: the bare read is the fast path; the write re-checks under _lock
     s = _state
     if s is None:
         with _lock:
@@ -218,6 +219,7 @@ def count_quant(n: int, mode: str) -> None:
     global _quant_counter
     if n <= 0 or not mode:
         return
+    # rta: disable=RTA101 double-checked init: the bare read is the fast path; the write re-checks under _lock
     c = _quant_counter
     if c is None:
         with _lock:
@@ -236,6 +238,7 @@ def count_quant(n: int, mode: str) -> None:
 
 def _stacked_counters() -> Tuple:
     global _stacked_state
+    # rta: disable=RTA101 double-checked init: the bare read is the fast path; the write re-checks under _lock
     s = _stacked_state
     if s is None:
         with _lock:

@@ -72,14 +72,15 @@ def configure(log_dir: Optional[str]) -> None:
     (called next to ``trace.configure`` — resident platform startup and
     the subprocess service entrypoint). ``None``/"" parks the sink."""
     global _log_dir
-    rec = _state[0] if _state is not None else None
     with _lock:
+        rec = _state[0] if _state is not None else None
         _log_dir = log_dir or None
         if rec is not None:
             rec.repoint(_log_dir)
 
 
 def configured() -> bool:
+    # rta: disable=RTA101 lock-free liveness probe; a reference read is GIL-atomic
     return _log_dir is not None
 
 
@@ -222,6 +223,7 @@ def _recorder() -> Optional[_Recorder]:
     """Resolve the env gate ONCE (attribution's ``_families`` shape):
     the off path after resolution is a tuple-load and a None check."""
     global _state
+    # rta: disable=RTA101 double-checked init: the bare read is the fast path; the write re-checks under _lock
     s = _state
     if s is None:
         with _lock:

@@ -1,0 +1,54 @@
+"""FP guards for the spawn-parameter root and the module<->module
+cycle arm: a worker handed through a spawn helper stays clean when it
+guards its own state, and two module locks taken in the SAME order
+everywhere must not read as a cycle."""
+
+import threading
+
+_A_LOCK = threading.Lock()
+_B_LOCK = threading.Lock()
+_staged = []
+
+
+def stage(row):
+    with _A_LOCK:
+        with _B_LOCK:
+            _staged.append(row)
+
+
+def commit():
+    with _A_LOCK:
+        with _B_LOCK:
+            _staged.clear()
+
+
+class CleanSpawner:
+    def register_consumer(self, fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        return t
+
+
+class GuardedParamWorker:
+    """The spawn-parameter root must honor held sets exactly like a
+    literal Thread root: every ``_seen`` touch is under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = 0
+
+    def loop(self):
+        while True:
+            with self._lock:
+                self._seen += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._seen
+
+
+class CleanParamOwner:
+    def __init__(self):
+        self.spawner = CleanSpawner()
+        self.worker = GuardedParamWorker()
+        self.spawner.register_consumer(self.worker.loop)

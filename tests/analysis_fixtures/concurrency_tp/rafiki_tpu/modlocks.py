@@ -1,0 +1,22 @@
+"""RTA104 TP (module<->module lock cycle): two MODULE-level locks
+acquired in opposite orders by free functions — no class anywhere, so
+only the module-owner arm of the whole-program cycle pass sees both
+directions."""
+
+import threading
+
+_INGEST_LOCK = threading.Lock()
+_FLUSH_LOCK = threading.Lock()
+_rows = []
+
+
+def ingest(row):
+    with _INGEST_LOCK:
+        with _FLUSH_LOCK:
+            _rows.append(row)
+
+
+def flush():
+    with _FLUSH_LOCK:
+        with _INGEST_LOCK:
+            _rows.clear()

@@ -1,0 +1,37 @@
+"""RTA106 TP (spawn-PARAMETER root): ``Spawner.register_consumer``
+hands its ``fn`` parameter to ``Thread(target=fn)`` — the callable an
+owner passes in runs on a thread, but neither the worker's class nor
+the owner ever spells ``Thread(target=self.worker.loop)``, so only
+the Program-level spawn-parameter attribution can register the root
+on ``ParamWorker.loop``."""
+
+import threading
+
+
+class Spawner:
+    def register_consumer(self, fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        return t
+
+
+class ParamWorker:
+    def __init__(self):
+        self._seen = 0
+
+    def loop(self):
+        while True:
+            self._seen += 1
+
+    def snapshot(self):
+        return self._seen
+
+
+class ParamOwner:
+    """Hands the worker's loop through the helper — two classes away
+    from any literal Thread() construction."""
+
+    def __init__(self):
+        self.spawner = Spawner()
+        self.worker = ParamWorker()
+        self.spawner.register_consumer(self.worker.loop)

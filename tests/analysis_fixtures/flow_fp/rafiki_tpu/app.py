@@ -1,0 +1,29 @@
+"""RTA703 false-positive guard: gate-derived attributes. ``_fabric``
+(every truthy assignment under the gate) and ``_node`` (IfExp on a
+gate-derived local) make later ``if self._fabric:`` tests count as
+flag gates; the owned-prefix series registers only under the gate."""
+
+import os
+
+from .observelike import registry
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw not in ("", "0")
+
+
+class EdgeApp:
+    def __init__(self):
+        self._fabric = False
+        self._m_fabric = None
+        cluster_on = _parse_bool(os.environ.get(
+            "RAFIKI_TPU_CLUSTER_FABRIC", "0"))
+        self._node = f"n-{os.getpid()}" if cluster_on else ""
+        if cluster_on:
+            self._fabric = True
+            self._m_fabric = registry().counter(
+                "rafiki_tpu_serving_fabric_total", "fabric requests")
+
+    def note(self):
+        if self._fabric:
+            self._m_fabric.inc()

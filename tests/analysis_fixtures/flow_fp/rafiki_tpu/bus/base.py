@@ -1,0 +1,39 @@
+"""Minimal bus for the flow fixtures — the queue-op surface the
+checker types receivers against."""
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Bus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues: Dict[str, List[Any]] = {}
+
+    def push(self, queue: str, value: Any) -> None:
+        with self._lock:
+            self._queues.setdefault(queue, []).append(value)
+
+    def push_many(self, items: List[Tuple[str, Any]]) -> None:
+        for queue, value in items:
+            self.push(queue, value)
+
+    def relay_push(self, node: str, queue: str, value: Any) -> None:
+        self.push(queue, value)
+
+    def pop(self, queue: str, timeout: float = 0.0) -> Optional[Any]:
+        with self._lock:
+            vals = self._queues.get(queue) or []
+            return vals.pop(0) if vals else None
+
+    def pop_all(self, queue: str) -> List[Any]:
+        with self._lock:
+            return self._queues.pop(queue, [])
+
+    def queue_len(self, queue: str) -> int:
+        with self._lock:
+            return len(self._queues.get(queue) or [])
+
+    def delete_queue(self, queue: str) -> None:
+        with self._lock:
+            self._queues.pop(queue, None)

@@ -1,0 +1,57 @@
+"""RTA701 false-positive guard: every family balances through the
+resolution machinery — a helper forwarding its ``queue`` parameter, a
+name-building helper function, a push_many tuple scan, and a fully
+dynamic name that is exempt by design."""
+
+from typing import Any, Dict, List, Tuple
+
+from .bus.base import Bus
+
+DRAIN = "__drain__"  # pushed AND dispatched below
+
+
+def _req_queue(sub_id: str) -> str:
+    return f"adv:{sub_id}:req"
+
+
+class Producer:
+    def __init__(self, bus: Bus):
+        self.bus = bus
+
+    def emit(self, wid: str) -> None:
+        self._forward(f"q:{wid}", {"x": 1})
+
+    def _forward(self, queue: str, frame: Dict[str, Any]) -> None:
+        # The q: name must attribute through this parameter to emit().
+        self.bus.push(queue, frame)
+
+    def emit_many(self, wids) -> None:
+        writes: List[Tuple[str, Any]] = []
+        for w in wids:
+            writes.append((f"q:{w}", {"w": w}))
+        self.bus.push_many(writes)
+
+    def ask(self, sub_id: str) -> None:
+        self.bus.push(_req_queue(sub_id), {"req": 1})
+
+    def drain(self, wid: str) -> None:
+        self.bus.push(f"q:{wid}", {DRAIN: 1})
+
+    def dynamic(self, name: str) -> None:
+        # Fully dynamic name (empty literal prefix): exempt.
+        self.bus.push(f"{name}", {"x": 1})
+
+
+class Consumer:
+    def __init__(self, bus: Bus):
+        self.bus = bus
+
+    def loop(self, wid: str) -> None:
+        for frame in self.bus.pop_all(f"q:{wid}"):
+            if DRAIN in frame:
+                return
+
+    def serve(self, sub_id: str) -> None:
+        req = self.bus.pop(_req_queue(sub_id), timeout=0.1)
+        if req:
+            pass

@@ -1,0 +1,21 @@
+"""RTA703 false-positive guard: the owned class is only constructed
+under the flag gate, so its methods are protected on-path code."""
+
+import os
+
+from .admin.nodes import NodeRegistry
+
+
+def _pb(raw: str) -> bool:
+    return raw.strip().lower() not in ("", "0", "false")
+
+
+class Platform:
+    def __init__(self):
+        self.node_registry = None
+        if _pb(os.environ.get("RAFIKI_TPU_CLUSTER_FABRIC", "0")):
+            self.node_registry = NodeRegistry("n0")
+
+    def shutdown(self):
+        if self.node_registry is not None:
+            self.node_registry.close()

@@ -1,0 +1,67 @@
+"""RTA702 false-positive guard: every served route has a caller and
+every caller resolves — via f-string paths (dynamic segment vs
+``<param>``), a locally built path with a query suffix, a session
+upload, a ``fetch`` scrape, and a peer ``urlopen`` probe."""
+
+from urllib.request import urlopen
+
+
+class Api:
+    def __init__(self, server_cls):
+        self._http = server_cls([
+            ("GET", "/stats", self._stats),
+            ("GET", "/items/<item_id>", self._item),
+            ("POST", "/items", self._create),
+            ("GET", "/peek", self._peek),
+        ])
+
+    def _stats(self, params, body, ctx):
+        return 200, {}
+
+    def _item(self, params, body, ctx):
+        return 200, {}
+
+    def _create(self, params, body, ctx):
+        return 200, {}
+
+    def _peek(self, params, body, ctx):
+        return 200, {}
+
+
+class _FakeSession:
+    def post(self, url, data=None):
+        return url
+
+
+class ApiClient:
+    def __init__(self, base: str):
+        self._base = base
+        self._session = _FakeSession()
+
+    def _call(self, method: str, path: str, **body):
+        return method, path
+
+    def stats(self):
+        return self._call("GET", "/stats")
+
+    def item(self, item_id: str):
+        return self._call("GET", f"/items/{item_id}")
+
+    def create(self, task=None):
+        path = "/items" + (f"?task={task}" if task else "")
+        return self._call("POST", path)
+
+    def upload(self, fh):
+        return self._session.post(self._base + "/items?src=upload",
+                                  data=fh)
+
+    def peek(self, addr: str, key: str):
+        return urlopen(f"http://{addr}/peek?key={key}", timeout=1.0)
+
+
+def fetch(host: str, path: str):
+    return host, path
+
+
+def scrape(host: str):
+    return fetch(host, "/stats")

@@ -1,0 +1,21 @@
+"""RTA703 true positives inside the flag-owned module: an import-time
+thread, and effects in never-gated functions."""
+
+import threading
+
+from ..observelike import registry
+
+_PINGER = threading.Thread(target=lambda: None, daemon=True)
+
+
+class NodeRegistry:
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._peers_gauge = registry().gauge(
+            "rafiki_tpu_node_peers", "live peers")
+
+
+def spawn_pinger():
+    t = threading.Thread(target=lambda: None, daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,15 @@
+"""RTA702 true positive: a typo'd client path (served is /things)."""
+
+
+class MiniClient:
+    def __init__(self, base: str):
+        self._base = base
+
+    def _call(self, method: str, path: str, **body):
+        return method, self._base + path, body
+
+    def ok(self):
+        return self._call("GET", "/things")
+
+    def things(self):
+        return self._call("GET", "/thingz")  # typo

@@ -1,0 +1,10 @@
+"""RTA703 true positive: a flag-owned series prefix registered
+outside the owned module with no gate."""
+
+from .observelike import registry
+
+
+class FabricStats:
+    def __init__(self):
+        self._m = registry().counter(
+            "rafiki_tpu_serving_fabric_total", "fabric requests")

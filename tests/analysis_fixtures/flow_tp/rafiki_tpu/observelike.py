@@ -1,0 +1,17 @@
+"""Stand-in metrics registry (the ``registry().counter(...)`` shape
+RTA703's series-effect detection keys on)."""
+
+
+class _Reg:
+    def counter(self, name: str, desc: str):
+        return object()
+
+    def gauge(self, name: str, desc: str):
+        return object()
+
+    def histogram(self, name: str, desc: str):
+        return object()
+
+
+def registry() -> _Reg:
+    return _Reg()

@@ -1,0 +1,9 @@
+"""RTA703 true positive: the owned class constructed without the flag
+gate — the off path would pay for the fabric."""
+
+from .admin.nodes import NodeRegistry
+
+
+class Platform:
+    def __init__(self):
+        self.node_registry = NodeRegistry("n0")

@@ -1,0 +1,24 @@
+"""RTA701 true positives: an orphan producer family, a dead consumer
+family, and one-sided control tokens."""
+
+from .bus.base import Bus
+
+FLUSH = "__flush__"    # pushed below, but nothing ever dispatches it
+DRAIN2 = "__drain2__"  # dispatched below, but nothing ever pushes it
+
+
+class WorkFan:
+    def __init__(self, bus: Bus):
+        self.bus = bus
+
+    def submit(self, i: int) -> None:
+        # Orphan producer: no in-tree consumer pops work:*.
+        self.bus.push(f"work:{i}", {"i": i})
+        self.bus.push(f"work:{i}", {FLUSH: 1})
+
+    def reap(self):
+        # Dead consumer: no in-tree producer pushes lost:*.
+        return self.bus.pop_all("lost:jobs")
+
+    def dispatch(self, frame) -> bool:
+        return DRAIN2 in frame

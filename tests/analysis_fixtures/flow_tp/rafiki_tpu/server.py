@@ -1,0 +1,15 @@
+"""RTA702 true positive: a served route no in-tree caller hits."""
+
+
+class MiniApp:
+    def __init__(self, server_cls):
+        self._http = server_cls([
+            ("GET", "/things", self._things),
+            ("POST", "/orphan", self._orphan),
+        ])
+
+    def _things(self, params, body, ctx):
+        return 200, {"things": []}
+
+    def _orphan(self, params, body, ctx):
+        return 200, {}

